@@ -1,0 +1,47 @@
+"""Test harness configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices so multi-chip sharding,
+mesh, and collective code paths run on any machine — the JAX analogue of the reference's
+Gloo-on-CPU multi-process fixtures (``tests/straggler/unit/_utils.py:42-80``).
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TPU_RESILIENCY_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+# A site-installed TPU plugin may have force-set jax_platforms at interpreter boot;
+# override it back to CPU before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def kv_server():
+    from tpu_resiliency.platform.store import KVServer
+
+    server = KVServer(host="127.0.0.1", port=0)
+    yield server
+    server.close()
+
+
+@pytest.fixture
+def coord_store(kv_server):
+    from tpu_resiliency.platform.store import CoordStore
+
+    store = CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+    yield store
+    store.close()
+
+
+@pytest.fixture
+def tmp_uds_path(tmp_path):
+    # Keep UDS paths short (108-byte sun_path limit).
+    return str(tmp_path / "s.sock")

@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+
+
+def test_platform_and_counts():
+    from tpu_resiliency.platform import device
+
+    assert device.platform_kind() == "cpu"  # forced in conftest
+    assert device.local_device_count() == 8
+    assert device.global_device_count() == 8
+
+
+def test_topology_probe():
+    from tpu_resiliency.platform import device
+
+    topo = device.probe_topology()
+    assert topo.num_devices == 8
+    assert topo.hosts() == [0]
+    assert len(topo.devices_on_host(0)) == 8
+    assert topo.host_of_device(topo.devices[0].device_id) == 0
+
+
+def test_make_mesh():
+    from tpu_resiliency.platform import device
+
+    mesh = device.make_mesh({"dp": 2, "tp": 4})
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
+    with pytest.raises(ValueError):
+        device.make_mesh({"dp": 3})
+
+
+def test_mesh_collective_runs():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_resiliency.platform import device
+
+    mesh = device.make_mesh({"dp": 8})
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    @jax.jit
+    def total(v):
+        return v.sum()
+
+    xs = jax.device_put(x, NamedSharding(mesh, P("dp", None)))
+    np.testing.assert_allclose(total(xs), x.sum())
+
+
+def test_device_liveness_probe():
+    from tpu_resiliency.platform import device
+
+    assert device.device_liveness_probe(timeout=60.0)

@@ -1,0 +1,47 @@
+import socket
+import threading
+import time
+
+from tpu_resiliency.platform import ipc
+
+
+def test_roundtrip_over_socketpair():
+    a, b = socket.socketpair()
+    ipc.write_object(a, {"msg": "hello", "n": [1, 2, 3]})
+    assert ipc.read_object(b) == {"msg": "hello", "n": [1, 2, 3]}
+    a.close()
+    b.close()
+
+
+def test_receiver_collects_messages(tmp_uds_path):
+    rx = ipc.IpcReceiver(tmp_uds_path)
+    rx.start()
+    try:
+        for i in range(3):
+            ipc.send_to(tmp_uds_path, {"i": i})
+        deadline = time.time() + 5.0
+        msgs = []
+        while len(msgs) < 3 and time.time() < deadline:
+            msgs += rx.fetch()
+            time.sleep(0.01)
+        assert sorted(m["i"] for m in msgs) == [0, 1, 2]
+    finally:
+        rx.stop()
+
+
+def test_receiver_callback(tmp_uds_path):
+    got = []
+    evt = threading.Event()
+
+    def cb(obj):
+        got.append(obj)
+        evt.set()
+
+    rx = ipc.IpcReceiver(tmp_uds_path, on_message=cb)
+    rx.start()
+    try:
+        ipc.send_to(tmp_uds_path, "ping")
+        assert evt.wait(5.0)
+        assert got == ["ping"]
+    finally:
+        rx.stop()

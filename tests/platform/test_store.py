@@ -1,0 +1,259 @@
+"""Store-contract tests — ported contract-first per SURVEY.md §7 'hard parts':
+reentrant barriers, interruption records, completing barriers for dead ranks."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import BarrierOverflow, BarrierTimeout, StoreTimeoutError
+from tpu_resiliency.platform.store import CoordStore, KVServer, host_store
+
+
+def test_basic_kv(coord_store):
+    coord_store.set("a", {"x": 1})
+    assert coord_store.get("a") == {"x": 1}
+    assert coord_store.try_get("missing") is None
+    assert coord_store.check(["a"])
+    assert not coord_store.check(["a", "b"])
+    assert coord_store.delete("a")
+    assert not coord_store.delete("a")
+
+
+def test_get_blocks_until_set(kv_server):
+    c1 = CoordStore("127.0.0.1", kv_server.port)
+    c2 = CoordStore("127.0.0.1", kv_server.port)
+    result = {}
+
+    def getter():
+        result["v"] = c1.get("late", timeout=10.0)
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.1)
+    c2.set("late", 42)
+    t.join(5.0)
+    assert result["v"] == 42
+    c1.close()
+    c2.close()
+
+
+def test_get_timeout(coord_store):
+    with pytest.raises(StoreTimeoutError):
+        coord_store.get("never", timeout=0.1)
+
+
+def test_add_and_cas(coord_store):
+    assert coord_store.add("ctr", 1) == 1
+    assert coord_store.add("ctr", 5) == 6
+    ok, val = coord_store.compare_set("state", None, "v1")
+    assert ok and val == "v1"
+    ok, val = coord_store.compare_set("state", "v0", "v2")
+    assert not ok and val == "v1"
+    ok, val = coord_store.compare_set("state", "v1", "v2")
+    assert ok and val == "v2"
+
+
+def test_lists_and_sets(coord_store):
+    coord_store.record_interrupted({"rank": 3, "why": "exc"})
+    coord_store.record_interrupted({"rank": 5, "why": "timeout"})
+    recs = coord_store.get_interruption_records()
+    assert [r["rank"] for r in recs] == [3, 5]
+    coord_store.clear_interruption_records()
+    assert coord_store.get_interruption_records() == []
+
+    coord_store.record_terminated_ranks([1, 2])
+    coord_store.record_terminated_ranks([2, 7])
+    assert coord_store.get_terminated_ranks() == {1, 2, 7}
+
+
+def test_heartbeats(coord_store):
+    coord_store.send_heartbeat(0, 123.0)
+    coord_store.send_heartbeat(3, 456.0)
+    assert coord_store.get_heartbeats() == {0: 123.0, 3: 456.0}
+
+
+def _run_barrier(port, name, rank, world, timeout=10.0):
+    c = CoordStore("127.0.0.1", port)
+    try:
+        c.barrier(name, rank, world, timeout)
+    finally:
+        c.close()
+
+
+def test_barrier_releases_all(kv_server):
+    world = 4
+    threads = [
+        threading.Thread(target=_run_barrier, args=(kv_server.port, "b0", r, world))
+        for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive()
+
+
+def test_barrier_reentrant(kv_server):
+    """Same barrier name usable across iterations (reference reentrant_barrier)."""
+    world = 3
+    errors = []
+
+    def worker(rank):
+        c = CoordStore("127.0.0.1", kv_server.port)
+        try:
+            for _ in range(5):
+                c.barrier("iter", rank, world, 10.0)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20.0)
+        assert not t.is_alive()
+    assert not errors
+
+
+def test_barrier_timeout(coord_store):
+    with pytest.raises(BarrierTimeout):
+        coord_store.barrier("lonely", 0, 2, timeout=0.2)
+
+
+def test_barrier_double_join_overflow(kv_server):
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.barrier_join("dj", rank=0, world_size=3, timeout=0.0, wait=False)
+    with pytest.raises(BarrierOverflow):
+        c.barrier_join("dj", rank=0, world_size=3, timeout=0.0, wait=False)
+    c.close()
+
+
+def test_complete_barrier_for_dead_rank(kv_server):
+    """A monitor completes the barrier on behalf of a dead rank
+    (reference monitor_process.py:260-282)."""
+    world = 3
+    done = []
+
+    def live(rank):
+        c = CoordStore("127.0.0.1", kv_server.port)
+        c.barrier("dead-rank", rank, world, 10.0)
+        done.append(rank)
+        c.close()
+
+    threads = [threading.Thread(target=live, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    assert not done  # still waiting on rank 2
+    monitor = CoordStore("127.0.0.1", kv_server.port)
+    monitor.complete_barrier_for("dead-rank", rank=2, world_size=world)
+    for t in threads:
+        t.join(10.0)
+        assert not t.is_alive()
+    assert sorted(done) == [0, 1]
+    monitor.close()
+
+
+def test_scoped_views_isolate(coord_store):
+    s0 = coord_store.scoped("iter0")
+    s1 = coord_store.scoped("iter1")
+    s0.set("k", "a")
+    s1.set("k", "b")
+    assert s0.get("k") == "a"
+    assert s1.get("k") == "b"
+    s0.record_terminated_ranks([1])
+    assert s1.get_terminated_ranks() == set()
+    # every key-based op must stay inside the view's namespace
+    assert s0.check(["k"]) and s1.check(["k"])
+    assert s0.prefix_get() == {"k": "a"}
+    assert s0.delete("k") and not s0.check(["k"])
+    assert s1.get("k") == "b"  # sibling namespace untouched
+    s0.list_append("l", 1)
+    assert s0.list_get("l") == [1] and s1.list_get("l") == []
+    s0.send_heartbeat(4, 9.0)
+    assert s0.get_heartbeats() == {4: 9.0} and s1.get_heartbeats() == {}
+
+
+def test_auth_handshake():
+    from tpu_resiliency.platform.store import KVServer
+
+    server = KVServer(host="127.0.0.1", port=0, auth_key="sekrit")
+    good = CoordStore("127.0.0.1", server.port, auth_key="sekrit", timeout=5.0)
+    good.set("x", 1)
+    assert good.get("x") == 1
+    with pytest.raises(Exception):
+        bad = CoordStore("127.0.0.1", server.port, auth_key="wrong", timeout=5.0,
+                         connect_retries=1)
+        bad.set("y", 2)  # server drops unauthenticated conns
+    with pytest.raises(Exception):
+        CoordStore("127.0.0.1", server.port, auth_key=None, timeout=5.0, connect_retries=1)
+    good.close()
+    server.close()
+
+
+def test_nonloopback_bind_requires_auth(monkeypatch):
+    from tpu_resiliency.platform.store import AUTH_KEY_ENV, KVServer
+
+    monkeypatch.delenv(AUTH_KEY_ENV, raising=False)
+    with pytest.raises(ValueError):
+        KVServer(host="0.0.0.0", port=0)
+
+
+def test_blocking_op_does_not_starve_fast_ops(kv_server):
+    """A long barrier join must not hold the shared socket's lock (heartbeats keep
+    flowing) — the reference's monitor cadence depends on this."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+
+    def join_slow():
+        try:
+            c.barrier("slow", 0, 2, 8.0)
+        except BarrierTimeout:
+            pass
+
+    t = threading.Thread(target=join_slow)
+    t.start()
+    time.sleep(0.3)
+    start = time.monotonic()
+    c.send_heartbeat(0)
+    elapsed = time.monotonic() - start
+    assert elapsed < 2.0, f"heartbeat starved behind blocking barrier: {elapsed:.1f}s"
+    # release the barrier so the thread exits quickly
+    c.complete_barrier_for("slow", 1, 2)
+    t.join(10.0)
+    assert not t.is_alive()
+    c.close()
+
+
+def test_host_store():
+    client, server = host_store(rank=0, host="127.0.0.1", port=0)
+    assert server is not None
+    client2, none = host_store(rank=1, host="127.0.0.1", port=server.port)
+    assert none is None
+    client.set("shared", 7)
+    assert client2.get("shared") == 7
+    client.close()
+    client2.close()
+    server.close()
+
+
+def test_concurrent_clients_hammer(kv_server):
+    """Many clients incrementing one counter — server-side atomicity."""
+    N, per = 8, 50
+
+    def worker():
+        c = CoordStore("127.0.0.1", kv_server.port)
+        for _ in range(per):
+            c.add("hammer", 1)
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    c = CoordStore("127.0.0.1", kv_server.port)
+    assert c.get("hammer") == N * per
+    c.close()

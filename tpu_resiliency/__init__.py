@@ -1,0 +1,25 @@
+"""tpu_resiliency — TPU-native resiliency framework for distributed JAX/XLA training.
+
+A from-scratch re-design of the capabilities of NVIDIA's Resiliency Extension (NVRx,
+reference: ajayvohra2005/nvidia-resiliency-ext-x) for TPUs:
+
+- ``platform``:   coordination KV store with server-side barriers, UDS IPC, mesh/topology
+                  introspection (the analogue of NVRx's TCPStore + device_utils substrate).
+- ``telemetry``:  straggler / slow-rank detection with on-device scoring — per-rank signals
+                  batched into a sharded ``[ranks, signals]`` array and reduced by a Pallas
+                  robust-z/EWMA kernel (the analogue of NVRx's straggler package + CUPTI ext).
+- ``watchdog``:   per-host rank monitor (heartbeats, timed sections, auto-calibrated
+                  timeouts) — the analogue of NVRx's fault_tolerance rank monitor.
+- ``checkpoint``: async background checkpointing + node-local checkpoints with clique
+                  replication — the analogue of NVRx's checkpointing package.
+- ``inprocess``:  restart of the training function without killing the process — the
+                  analogue of NVRx's inprocess.Wrapper.
+- ``launcher``:   per-host elastic agent + rendezvous + ``tpu-ft-launcher`` CLI — the
+                  analogue of NVRx's ft_launcher.
+- ``integrations``: train-loop callbacks wiring it all into a JAX training loop (the
+                  analogue of NVRx's ptl_resiliency).
+- ``models`` / ``parallel`` / ``ops``: flagship sharded transformer, mesh + ring-attention
+                  sequence parallelism, and Pallas kernels used by the framework.
+"""
+
+__version__ = "0.1.0"

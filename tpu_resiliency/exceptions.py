@@ -1,0 +1,55 @@
+"""Package-wide exception types.
+
+Analogue of the reference's ``inprocess/exception.py`` (RestartError / RestartAbort /
+HealthCheckError / InternalError / TimeoutError family).
+"""
+
+from __future__ import annotations
+
+
+class ResiliencyError(Exception):
+    """Base class for all tpu_resiliency errors."""
+
+
+class StoreError(ResiliencyError):
+    """Coordination-store protocol or transport failure."""
+
+
+class StoreTimeoutError(StoreError, TimeoutError):
+    """A blocking store operation (get/wait/barrier) timed out."""
+
+
+class BarrierTimeout(StoreTimeoutError):
+    """A distributed barrier did not complete within its timeout."""
+
+
+class BarrierOverflow(StoreError):
+    """More participants arrived at a barrier than its declared world size.
+
+    The reference detects the same condition in ``inprocess/store.py:200-202``.
+    """
+
+
+class RestartError(ResiliencyError):
+    """Base class for in-process restart errors."""
+
+
+class RestartAbort(RestartError):
+    """Terminal condition: the restart loop must stop retrying (reference
+    ``inprocess/initialize.py:53-93`` raises this from RetryController)."""
+
+
+class HealthCheckError(ResiliencyError):
+    """A rank failed its post-fault health check and must not rejoin."""
+
+
+class InternalError(ResiliencyError):
+    """Invariant violation inside the resiliency machinery itself."""
+
+
+class FaultToleranceError(ResiliencyError):
+    """Watchdog / rank-monitor protocol failure."""
+
+
+class CheckpointError(ResiliencyError):
+    """Checkpoint save/load/replication failure."""

@@ -1,0 +1,41 @@
+from tpu_resiliency.platform.store import (
+    CoordStore,
+    KVClient,
+    KVServer,
+    StoreView,
+    host_store,
+    store_addr_from_env,
+)
+from tpu_resiliency.platform.device import (
+    Topology,
+    DeviceInfo,
+    device_liveness_probe,
+    global_device_count,
+    local_device_count,
+    make_mesh,
+    platform_kind,
+    probe_topology,
+    process_count,
+    process_index,
+)
+from tpu_resiliency.platform import ipc
+
+__all__ = [
+    "CoordStore",
+    "KVClient",
+    "KVServer",
+    "StoreView",
+    "host_store",
+    "store_addr_from_env",
+    "Topology",
+    "DeviceInfo",
+    "device_liveness_probe",
+    "global_device_count",
+    "local_device_count",
+    "make_mesh",
+    "platform_kind",
+    "probe_topology",
+    "process_count",
+    "process_index",
+    "ipc",
+]

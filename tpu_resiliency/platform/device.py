@@ -1,0 +1,185 @@
+"""Device, platform, and mesh/topology introspection.
+
+TPU-native analogue of the reference's device shim (``common/device_utils.py:23-85``:
+``get_current_device`` / ``get_current_device_type`` / ``get_local_device_count`` /
+``get_distributed_backend`` / ``get_distributed_init_method``) plus the hardware-topology
+probing its health checks do via NVML/PCI (``shared_utils/health_check.py:352-465``).
+On TPU the probe-able topology is the ICI mesh: per-device chip coordinates and the
+host↔chip mapping, read from JAX's device list rather than the PCI tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+def platform_kind() -> str:
+    """'tpu' | 'gpu' | 'cpu' — the JAX default backend platform."""
+    import jax
+
+    plat = jax.default_backend()
+    # Experimental TPU transports (e.g. 'axon') still expose TPU devices.
+    if plat not in ("cpu", "gpu", "tpu"):
+        try:
+            kind = jax.devices()[0].device_kind.lower()
+            if "tpu" in kind:
+                return "tpu"
+        except Exception:
+            pass
+    return plat
+
+
+def local_device_count() -> int:
+    import jax
+
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def default_device():
+    import jax
+
+    return jax.devices()[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    """One accelerator device and where it lives."""
+
+    device_id: int
+    process_index: int
+    platform: str
+    device_kind: str
+    coords: Optional[tuple[int, ...]]  # ICI chip coordinates (TPU only)
+    core_on_chip: Optional[int]
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Snapshot of the device topology visible to this process' JAX runtime."""
+
+    devices: tuple[DeviceInfo, ...]
+    num_processes: int
+
+    @property
+    def num_devices(self) -> int:
+        return len(self.devices)
+
+    def devices_on_host(self, proc: int) -> list[DeviceInfo]:
+        return [d for d in self.devices if d.process_index == proc]
+
+    def host_of_device(self, device_id: int) -> int:
+        for d in self.devices:
+            if d.device_id == device_id:
+                return d.process_index
+        raise KeyError(device_id)
+
+    def hosts(self) -> list[int]:
+        return sorted({d.process_index for d in self.devices})
+
+
+def probe_topology() -> Topology:
+    """Read the global device topology from JAX."""
+    import jax
+
+    infos = []
+    for d in jax.devices():
+        coords = getattr(d, "coords", None)
+        infos.append(
+            DeviceInfo(
+                device_id=d.id,
+                process_index=d.process_index,
+                platform=d.platform,
+                device_kind=getattr(d, "device_kind", d.platform),
+                coords=tuple(coords) if coords is not None else None,
+                core_on_chip=getattr(d, "core_on_chip", None),
+            )
+        )
+    return Topology(devices=tuple(infos), num_processes=jax.process_count())
+
+
+def make_mesh(axis_shapes: dict[str, int], *, devices: Optional[Sequence[Any]] = None):
+    """Build a ``jax.sharding.Mesh`` with named axes.
+
+    ``axis_shapes`` maps axis name → size in declaration order, e.g.
+    ``{"dp": 2, "tp": 4}``. Uses ``mesh_utils.create_device_mesh`` for an ICI-friendly
+    physical layout when possible (keeps collectives riding ICI rather than DCN), falling
+    back to a plain reshape for virtual/CPU device sets.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    names = tuple(axis_shapes.keys())
+    shape = tuple(axis_shapes.values())
+    devs = list(devices) if devices is not None else jax.devices()
+    n = int(np.prod(shape))
+    if n != len(devs):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devs)}")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=devs)
+    except Exception:
+        arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, names)
+
+
+def device_liveness_probe(timeout: float = 30.0, device=None) -> bool:
+    """Check the accelerator still executes and completes work.
+
+    Direct analogue of the reference's ``CudaHealthCheck`` double
+    ``torch.cuda.synchronize`` under a timeout thread (``inprocess/health_check.py:70-110``):
+    submit a tiny computation twice and ``block_until_ready`` with a watchdog thread, so a
+    wedged device (hung ICI collective, dead runtime) turns into a ``False`` rather than a
+    forever-block.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dev = device if device is not None else default_device()
+    result: dict[str, bool] = {}
+
+    def _work():
+        try:
+            for _ in range(2):
+                x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
+                jax.block_until_ready(x + 1.0)
+            result["ok"] = True
+        except Exception:
+            result["ok"] = False
+
+    t = threading.Thread(target=_work, name="device-probe", daemon=True)
+    t.start()
+    t.join(timeout)
+    return result.get("ok", False)
+
+
+def visible_device_env() -> dict[str, str]:
+    """Environment variables that pin TPU visibility for spawned worker processes."""
+    out = {}
+    for key in ("TPU_VISIBLE_DEVICES", "TPU_PROCESS_BOUNDS", "JAX_PLATFORMS", "XLA_FLAGS"):
+        if key in os.environ:
+            out[key] = os.environ[key]
+    return out

@@ -1,0 +1,52 @@
+"""Shared wire framing: 4-byte big-endian length prefix + pickle payload.
+
+Single implementation used by both the TCP coordination store (``platform/store.py``)
+and the local UDS IPC (``platform/ipc.py``) so the wire protocol evolves in one place.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+from typing import Any
+
+LEN = struct.Struct("!I")
+DEFAULT_MAX_FRAME = 64 * 1024 * 1024
+
+
+def send_obj(sock: socket.socket, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise EOFError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_obj(sock: socket.socket, max_frame: int = DEFAULT_MAX_FRAME) -> Any:
+    (length,) = LEN.unpack(recv_exact(sock, LEN.size))
+    if length > max_frame:
+        raise ValueError(f"frame too large: {length} > {max_frame}")
+    return pickle.loads(recv_exact(sock, length))
+
+
+async def read_obj_stream(reader: asyncio.StreamReader, max_frame: int = DEFAULT_MAX_FRAME) -> Any:
+    header = await reader.readexactly(LEN.size)
+    (length,) = LEN.unpack(header)
+    if length > max_frame:
+        raise ValueError(f"frame too large: {length} > {max_frame}")
+    return pickle.loads(await reader.readexactly(length))
+
+
+async def write_obj_stream(writer: asyncio.StreamWriter, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    writer.write(LEN.pack(len(payload)) + payload)
+    await writer.drain()

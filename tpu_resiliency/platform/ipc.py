@@ -1,0 +1,132 @@
+"""Local inter-process messaging over Unix domain sockets.
+
+The analogue of the reference's AF_UNIX pickled IPC (``fault_tolerance/utils.py:121-179``
+sync + asyncio helpers, and ``fault_tolerance/ipc_connector.py:30`` one-way queue with a
+receiver thread). Used between a worker rank and its per-host monitor, and between ranks
+and the launcher — never for tensor data.
+
+Framing is shared with the TCP store protocol (``platform/framing.py``). Unix sockets are
+filesystem-permission-protected, so no auth handshake is needed here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import os
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from tpu_resiliency.platform import framing
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+# Environment variables carrying socket paths from launcher to workers; analogue of
+# FT_RANK_MONITOR_IPC_SOCKET / FT_LAUNCHER_IPC_SOCKET (reference ``data.py:27-30``).
+MONITOR_SOCKET_ENV = "TPU_FT_MONITOR_IPC_SOCKET"
+LAUNCHER_SOCKET_ENV = "TPU_FT_LAUNCHER_IPC_SOCKET"
+
+write_object = framing.send_obj
+read_object = functools.partial(framing.recv_obj, max_frame=_MAX_FRAME)
+read_object_stream = functools.partial(framing.read_obj_stream, max_frame=_MAX_FRAME)
+write_object_stream = framing.write_obj_stream
+
+
+def connect(path: str, timeout: float = 30.0) -> socket.socket:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    return sock
+
+
+class IpcReceiver:
+    """One-way message sink: listens on a UDS path, queues every received object.
+
+    Analogue of the reference's ``IpcConnector`` (``fault_tolerance/ipc_connector.py:30``):
+    the launcher listens here for ``WorkloadControlRequest``-style messages from ranks.
+    """
+
+    def __init__(self, path: str, on_message: Optional[Callable[[Any], None]] = None):
+        self.path = path
+        self._on_message = on_message
+        self._messages: list[Any] = []
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._server.bind(self.path)
+        self._server.listen(64)
+        self._thread = threading.Thread(target=self._loop, name="ipc-receiver", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        assert self._server is not None
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._drain_conn, args=(conn,), name="ipc-receiver-conn", daemon=True
+            ).start()
+
+    def _drain_conn(self, conn: socket.socket) -> None:
+        try:
+            while True:
+                obj = read_object(conn)
+                with self._lock:
+                    self._messages.append(obj)
+                if self._on_message is not None:
+                    try:
+                        self._on_message(obj)
+                    except Exception:
+                        log.exception("ipc on_message callback failed")
+        except (EOFError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def fetch(self) -> list[Any]:
+        """Return and clear all queued messages."""
+        with self._lock:
+            msgs, self._messages = self._messages, []
+        return msgs
+
+    def peek(self) -> list[Any]:
+        with self._lock:
+            return list(self._messages)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if os.path.exists(self.path):
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def send_to(path: str, obj: Any, timeout: float = 30.0) -> None:
+    """Fire-and-forget a single object at a UDS listener."""
+    sock = connect(path, timeout=timeout)
+    try:
+        write_object(sock, obj)
+    finally:
+        sock.close()

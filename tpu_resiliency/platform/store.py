@@ -1,0 +1,706 @@
+"""Coordination key-value store with first-class distributed barriers.
+
+This is the control-plane substrate of the framework — the TPU-native re-design of the
+reference's ``torch.distributed.TCPStore`` + ``StoreMixin`` barrier protocol
+(``inprocess/store.py:48-368``) and of the c10d store used by its rendezvous. Unlike the
+reference, which builds barriers *client-side* out of add/get primitives (and needs careful
+key hygiene, overflow checks, and "monitor completes the barrier for dead ranks" tricks),
+this store implements barriers, sets, lists and heartbeats as *server-side* operations:
+
+- reentrant generation-counted barriers (the reference's ``reentrant_barrier``,
+  ``iteration_barrier``, ``termination_barrier``; ``store.py:180-311``),
+- joining a barrier **on behalf of another rank** without blocking — how a monitor process
+  completes barriers for a dead main process (reference ``monitor_process.py:260-282``),
+- interruption records and terminated-rank sets (``store.py`` record APIs),
+- per-rank heartbeat timestamps with prefix scans (``sibling_monitor.py:26-57``).
+
+Security: frames are pickled, so deserialization is code execution. The server therefore
+binds loopback-only unless an ``auth_key`` is provided, in which case every connection
+must complete an HMAC-SHA256 challenge/response before any frame is processed (the
+analogue of the reference's ``AuthkeyMsg`` handshake, ``fault_tolerance/data.py:141``).
+The launcher generates the key and hands it to workers via ``TPU_RESILIENCY_STORE_KEY``.
+
+Concurrency: each client keeps one persistent socket for fast non-blocking ops; any
+operation that may block server-side for more than a few seconds (barrier joins, waiting
+``get``\\ s) runs on its own one-shot connection so heartbeats and other control traffic
+are never starved behind it. A transport error invalidates the persistent socket (framing
+can no longer be trusted); the next call transparently reconnects.
+
+Rank 0 hosts the server in-process, exactly as the reference's rank 0 hosts the TCPStore
+(``inprocess/store.py:311,345-353``). This store carries only small control messages
+(bytes–KBs at restart boundaries); per-step telemetry rides the ICI mesh as JAX
+collectives instead (see ``telemetry``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import hmac
+import os
+import secrets
+import socket
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from tpu_resiliency.exceptions import (
+    BarrierOverflow,
+    BarrierTimeout,
+    StoreError,
+    StoreTimeoutError,
+)
+from tpu_resiliency.platform import framing
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+AUTH_KEY_ENV = "TPU_RESILIENCY_STORE_KEY"
+
+# Ops whose server-side wait can exceed this run on a dedicated one-shot connection so
+# they never hold the persistent socket's lock across a long block.
+_BLOCKING_THRESHOLD_S = 5.0
+
+_LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1", "")
+
+
+def _hmac(key: str, nonce: bytes) -> bytes:
+    return hmac.new(key.encode(), nonce, hashlib.sha256).digest()
+
+
+@dataclasses.dataclass
+class _Barrier:
+    generation: int = 0
+    arrived: set = dataclasses.field(default_factory=set)
+    world_size: int = 0
+
+
+class KVServer:
+    """Threaded TCP server holding the coordination state.
+
+    One instance per job, hosted by the coordinator (rank 0 or the launcher). All
+    operations take the single state lock; requests are small and rare (control plane).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, auth_key: str | None = None):
+        if auth_key is None:
+            auth_key = os.environ.get(AUTH_KEY_ENV) or None
+        if host not in _LOOPBACK_HOSTS and not auth_key:
+            raise ValueError(
+                f"refusing to bind KVServer on non-loopback {host!r} without an auth_key "
+                f"(frames are pickled; unauthenticated exposure is remote code execution). "
+                f"Pass auth_key= or set ${AUTH_KEY_ENV}."
+            )
+        self.auth_key = auth_key
+        self._data: dict[str, Any] = {}
+        self._lists: dict[str, list] = {}
+        self._sets: dict[str, set] = {}
+        self._barriers: dict[str, _Barrier] = {}
+        self._cond = threading.Condition()
+        self._shutdown = threading.Event()
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(1024)
+        self.port = self._sock.getsockname()[1]
+        self.host = host
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="kvstore-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._cond:
+            self._cond.notify_all()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name="kvstore-conn", daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> bool:
+        """Server side of the connection hello: challenge/response when auth is on."""
+        nonce = secrets.token_bytes(16)
+        framing.send_obj(conn, {"v": 1, "auth": self.auth_key is not None, "nonce": nonce})
+        if self.auth_key is None:
+            return True
+        conn.settimeout(30.0)
+        reply = framing.recv_obj(conn, max_frame=1024)
+        ok = isinstance(reply, dict) and hmac.compare_digest(
+            reply.get("mac", b""), _hmac(self.auth_key, nonce)
+        )
+        if not ok:
+            log.warning("store: rejected connection with bad auth")
+        conn.settimeout(None)
+        return ok
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            try:
+                if not self._handshake(conn):
+                    return
+            except (ConnectionError, EOFError, OSError, ValueError):
+                return
+            while not self._shutdown.is_set():
+                try:
+                    req = framing.recv_obj(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                try:
+                    resp = self._dispatch(req)
+                except BarrierOverflow as e:
+                    resp = {"status": "overflow", "error": str(e)}
+                except TimeoutError:
+                    resp = {"status": "timeout"}
+                except Exception as e:  # surface server-side faults to the client
+                    resp = {"status": "error", "error": repr(e)}
+                try:
+                    framing.send_obj(conn, resp)
+                except (ConnectionError, OSError):
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- operation dispatch ------------------------------------------------
+
+    def _dispatch(self, req: dict) -> dict:
+        op = req["op"]
+        handler = getattr(self, f"_op_{op}", None)
+        if handler is None:
+            return {"status": "error", "error": f"unknown op {op!r}"}
+        return handler(req)
+
+    @staticmethod
+    def _ok(value: Any = None) -> dict:
+        return {"status": "ok", "value": value}
+
+    def _op_ping(self, req: dict) -> dict:
+        return self._ok("pong")
+
+    def _op_set(self, req: dict) -> dict:
+        with self._cond:
+            self._data[req["key"]] = req["value"]
+            self._cond.notify_all()
+        return self._ok()
+
+    def _op_get(self, req: dict) -> dict:
+        deadline = time.monotonic() + req.get("timeout", 0.0)
+        with self._cond:
+            while req["key"] not in self._data:
+                if self._shutdown.is_set():
+                    raise RuntimeError("store shut down")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=min(remaining, 1.0)):
+                    if time.monotonic() >= deadline:
+                        raise TimeoutError
+            return self._ok(self._data[req["key"]])
+
+    def _op_check(self, req: dict) -> dict:
+        with self._cond:
+            return self._ok(all(k in self._data for k in req["keys"]))
+
+    def _op_delete(self, req: dict) -> dict:
+        with self._cond:
+            existed = self._data.pop(req["key"], None) is not None
+        return self._ok(existed)
+
+    def _op_add(self, req: dict) -> dict:
+        with self._cond:
+            new = int(self._data.get(req["key"], 0)) + int(req["amount"])
+            self._data[req["key"]] = new
+            self._cond.notify_all()
+            return self._ok(new)
+
+    def _op_cas(self, req: dict) -> dict:
+        """Compare-and-set: set key to `desired` iff current == `expected`.
+
+        `expected=None` means "key must be absent". Returns (success, current_value).
+        Analogue of the c10d rendezvous backend's CAS state blob
+        (reference ``rendezvous/c10d_rendezvous_backend.py``).
+        """
+        with self._cond:
+            current = self._data.get(req["key"])
+            if current == req["expected"]:
+                self._data[req["key"]] = req["desired"]
+                self._cond.notify_all()
+                return self._ok((True, req["desired"]))
+            return self._ok((False, current))
+
+    def _op_prefix_get(self, req: dict) -> dict:
+        prefix = req["prefix"]
+        with self._cond:
+            return self._ok({k: v for k, v in self._data.items() if k.startswith(prefix)})
+
+    def _op_num_keys(self, req: dict) -> dict:
+        with self._cond:
+            return self._ok(len(self._data))
+
+    def _op_list_append(self, req: dict) -> dict:
+        with self._cond:
+            self._lists.setdefault(req["key"], []).append(req["value"])
+            self._cond.notify_all()
+        return self._ok()
+
+    def _op_list_get(self, req: dict) -> dict:
+        with self._cond:
+            return self._ok(list(self._lists.get(req["key"], [])))
+
+    def _op_list_clear(self, req: dict) -> dict:
+        with self._cond:
+            self._lists.pop(req["key"], None)
+        return self._ok()
+
+    def _op_set_add(self, req: dict) -> dict:
+        with self._cond:
+            s = self._sets.setdefault(req["key"], set())
+            s.update(req["values"])
+            self._cond.notify_all()
+            return self._ok(len(s))
+
+    def _op_set_get(self, req: dict) -> dict:
+        with self._cond:
+            return self._ok(set(self._sets.get(req["key"], set())))
+
+    def _op_barrier(self, req: dict) -> dict:
+        """Join barrier `name` as `rank`; release when `world_size` distinct ranks joined.
+
+        Reentrant: each completed round bumps the generation, so the same name can be
+        used every iteration (reference ``reentrant_barrier``, ``store.py:244``). With
+        ``wait=False`` the caller joins without blocking — used by monitors to complete
+        barriers on behalf of dead ranks (reference ``monitor_process.py:260-282``).
+        """
+        name, rank = req["name"], req["rank"]
+        world_size = int(req["world_size"])
+        deadline = time.monotonic() + req.get("timeout", 0.0)
+        with self._cond:
+            b = self._barriers.setdefault(name, _Barrier())
+            if b.world_size and b.world_size != world_size:
+                # A new round may legitimately shrink/grow the world (elastic restart);
+                # only flag mismatch within an in-progress round.
+                if b.arrived:
+                    raise BarrierOverflow(
+                        f"barrier {name!r}: world_size {world_size} != in-progress "
+                        f"round's {b.world_size}"
+                    )
+            b.world_size = world_size
+            gen = b.generation
+            if rank in b.arrived:
+                raise BarrierOverflow(f"barrier {name!r}: rank {rank} joined twice")
+            b.arrived.add(rank)
+            if len(b.arrived) > world_size:
+                raise BarrierOverflow(
+                    f"barrier {name!r}: {len(b.arrived)} arrivals > world {world_size}"
+                )
+            if len(b.arrived) == world_size:
+                b.generation += 1
+                b.arrived = set()
+                b.world_size = 0
+                self._cond.notify_all()
+                return self._ok(b.generation)
+            if not req.get("wait", True):
+                return self._ok(None)
+            while b.generation == gen:
+                if self._shutdown.is_set():
+                    raise RuntimeError("store shut down")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cond.wait(timeout=min(remaining, 1.0)):
+                    if time.monotonic() >= deadline:
+                        # Leave our arrival in place: a late joiner may still release
+                        # everyone; callers treat timeout as fatal anyway.
+                        raise TimeoutError
+            return self._ok(b.generation)
+
+    def _op_barrier_status(self, req: dict) -> dict:
+        with self._cond:
+            b = self._barriers.get(req["name"])
+            if b is None:
+                return self._ok(None)
+            return self._ok(
+                {"generation": b.generation, "arrived": set(b.arrived), "world_size": b.world_size}
+            )
+
+
+class KVClient:
+    """Client for :class:`KVServer`: one persistent connection for fast ops, one-shot
+    connections for long-blocking ops. Thread-safe."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout: float = 300.0,
+        connect_retries: int = 60,
+        auth_key: str | None = None,
+    ):
+        self.host, self.port = host, port
+        self.default_timeout = timeout
+        if auth_key is None:
+            auth_key = os.environ.get(AUTH_KEY_ENV) or None
+        self.auth_key = auth_key
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+        self._sock = self._connect(connect_retries)
+
+    def _connect(self, retries: int = 3) -> socket.socket:
+        delay = 0.05
+        last: Exception | None = None
+        for _ in range(max(1, retries)):
+            try:
+                sock = socket.create_connection((self.host, self.port), timeout=30.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._client_handshake(sock)
+                return sock
+            except (OSError, EOFError, StoreError, ValueError) as e:
+                last = e
+                time.sleep(delay)
+                delay = min(delay * 1.7, 2.0)
+        raise StoreError(f"cannot connect to store at {self.host}:{self.port}: {last!r}")
+
+    def _client_handshake(self, sock: socket.socket) -> None:
+        hello = framing.recv_obj(sock, max_frame=1024)
+        if not isinstance(hello, dict) or "auth" not in hello:
+            raise StoreError("malformed store hello")
+        if hello["auth"]:
+            if not self.auth_key:
+                raise StoreError(
+                    f"store requires authentication; set ${AUTH_KEY_ENV} or pass auth_key"
+                )
+            framing.send_obj(sock, {"mac": _hmac(self.auth_key, hello["nonce"])})
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _call(self, req: dict, *, op_timeout: float | None = None) -> Any:
+        """One request/response round-trip.
+
+        Fast ops share the persistent socket; ops whose server-side wait can be long run
+        on their own one-shot connection so they never starve concurrent control traffic
+        (e.g. a heartbeat behind a 300 s barrier join). The socket timeout exceeds the
+        server-side operation timeout so server waits surface as protocol timeouts.
+        Any transport error invalidates the persistent socket — a half-read frame means
+        framing can no longer be trusted — and the next call reconnects.
+        """
+        wait_s = op_timeout or 0.0
+        if wait_s > _BLOCKING_THRESHOLD_S:
+            return self._call_oneshot(req, wait_s)
+        with self._lock:
+            if self._closed:
+                raise StoreError("store client is closed")
+            if self._sock is None:
+                self._sock = self._connect()
+            self._sock.settimeout(wait_s + 60.0)
+            try:
+                framing.send_obj(self._sock, req)
+                resp = framing.recv_obj(self._sock)
+            except (ConnectionError, EOFError, OSError) as e:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+                raise StoreError(f"store transport failure: {e!r}") from e
+        return self._parse(req, resp)
+
+    def _call_oneshot(self, req: dict, wait_s: float) -> Any:
+        sock = self._connect()
+        try:
+            sock.settimeout(wait_s + 60.0)
+            try:
+                framing.send_obj(sock, req)
+                resp = framing.recv_obj(sock)
+            except (ConnectionError, EOFError, OSError) as e:
+                raise StoreError(f"store transport failure: {e!r}") from e
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        return self._parse(req, resp)
+
+    @staticmethod
+    def _parse(req: dict, resp: Any) -> Any:
+        if not isinstance(resp, dict):
+            raise StoreError("malformed store response")
+        status = resp.get("status")
+        if status == "ok":
+            return resp.get("value")
+        if status == "timeout":
+            raise StoreTimeoutError(f"store op {req.get('op')} timed out")
+        if status == "overflow":
+            raise BarrierOverflow(resp.get("error", ""))
+        raise StoreError(f"store op {req.get('op')} failed: {resp.get('error')}")
+
+    # -- primitive ops -----------------------------------------------------
+
+    def ping(self) -> bool:
+        return self._call({"op": "ping"}) == "pong"
+
+    def set(self, key: str, value: Any) -> None:
+        self._call({"op": "set", "key": key, "value": value})
+
+    def get(self, key: str, timeout: float | None = None) -> Any:
+        t = self.default_timeout if timeout is None else timeout
+        return self._call({"op": "get", "key": key, "timeout": t}, op_timeout=t)
+
+    def try_get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self.get(key, timeout=0.0)
+        except StoreTimeoutError:
+            return default
+
+    def check(self, keys: Iterable[str]) -> bool:
+        return self._call({"op": "check", "keys": list(keys)})
+
+    def delete(self, key: str) -> bool:
+        return self._call({"op": "delete", "key": key})
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._call({"op": "add", "key": key, "amount": amount})
+
+    def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
+        return tuple(self._call({"op": "cas", "key": key, "expected": expected, "desired": desired}))
+
+    def prefix_get(self, prefix: str) -> dict[str, Any]:
+        return self._call({"op": "prefix_get", "prefix": prefix})
+
+    def num_keys(self) -> int:
+        return self._call({"op": "num_keys"})
+
+    def list_append(self, key: str, value: Any) -> None:
+        self._call({"op": "list_append", "key": key, "value": value})
+
+    def list_get(self, key: str) -> list:
+        return self._call({"op": "list_get", "key": key})
+
+    def list_clear(self, key: str) -> None:
+        self._call({"op": "list_clear", "key": key})
+
+    def set_add(self, key: str, values: Iterable) -> int:
+        return self._call({"op": "set_add", "key": key, "values": list(values)})
+
+    def set_get(self, key: str) -> set:
+        return self._call({"op": "set_get", "key": key})
+
+    def barrier_join(
+        self,
+        name: str,
+        rank: int,
+        world_size: int,
+        timeout: float,
+        wait: bool = True,
+    ) -> Optional[int]:
+        try:
+            return self._call(
+                {
+                    "op": "barrier",
+                    "name": name,
+                    "rank": rank,
+                    "world_size": world_size,
+                    "timeout": timeout,
+                    "wait": wait,
+                },
+                op_timeout=timeout if wait else 0.0,
+            )
+        except StoreTimeoutError as e:
+            raise BarrierTimeout(f"barrier {name!r} timed out after {timeout}s") from e
+
+    def barrier_status(self, name: str) -> Optional[dict]:
+        return self._call({"op": "barrier_status", "name": name})
+
+
+class StoreView:
+    """A prefix-scoped coordination API over a :class:`KVClient`.
+
+    Implements the reference ``StoreMixin`` surface (``inprocess/store.py:48-311``):
+    named reentrant barriers, interruption records, terminated-rank sets, per-rank
+    heartbeats — every key-based operation consistently namespaced under ``prefix``.
+    ``scoped()`` derives a deeper view, the per-restart-iteration namespace pattern
+    (reference ``store.py:360 PrefixStore``, ``wrap.py:417``).
+    """
+
+    INTERRUPTION_RECORDS = "interruption_records"
+    TERMINATED_RANKS = "terminated_ranks"
+    HEARTBEAT_PREFIX = "heartbeat/"
+
+    def __init__(self, client: KVClient, prefix: str = ""):
+        self.client = client
+        self.prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self.prefix}{key}"
+
+    def scoped(self, prefix: str) -> "StoreView":
+        return StoreView(self.client, f"{self.prefix}{prefix}/")
+
+    # -- namespaced primitives --------------------------------------------
+
+    def ping(self) -> bool:
+        return self.client.ping()
+
+    def set(self, key: str, value: Any) -> None:
+        self.client.set(self._k(key), value)
+
+    def get(self, key: str, timeout: float | None = None) -> Any:
+        return self.client.get(self._k(key), timeout)
+
+    def try_get(self, key: str, default: Any = None) -> Any:
+        return self.client.try_get(self._k(key), default)
+
+    def check(self, keys: Iterable[str]) -> bool:
+        return self.client.check([self._k(k) for k in keys])
+
+    def delete(self, key: str) -> bool:
+        return self.client.delete(self._k(key))
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self.client.add(self._k(key), amount)
+
+    def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
+        return self.client.compare_set(self._k(key), expected, desired)
+
+    def prefix_get(self, prefix: str = "") -> dict[str, Any]:
+        """Scan keys under this view; returned keys are relative to the view."""
+        full = self._k(prefix)
+        raw = self.client.prefix_get(full)
+        start = len(self.prefix)
+        return {k[start:]: v for k, v in raw.items()}
+
+    def list_append(self, key: str, value: Any) -> None:
+        self.client.list_append(self._k(key), value)
+
+    def list_get(self, key: str) -> list:
+        return self.client.list_get(self._k(key))
+
+    def list_clear(self, key: str) -> None:
+        self.client.list_clear(self._k(key))
+
+    def set_add(self, key: str, values: Iterable) -> int:
+        return self.client.set_add(self._k(key), values)
+
+    def set_get(self, key: str) -> set:
+        return self.client.set_get(self._k(key))
+
+    def barrier_join(self, name, rank, world_size, timeout, wait=True):
+        return self.client.barrier_join(self._k(name), rank, world_size, timeout, wait)
+
+    def barrier_status(self, name: str) -> Optional[dict]:
+        return self.client.barrier_status(self._k(name))
+
+    # -- restart-coordination API -----------------------------------------
+
+    def barrier(self, name: str, rank: int, world_size: int, timeout: float) -> None:
+        self.barrier_join(name, rank, world_size, timeout)
+
+    def complete_barrier_for(self, name: str, rank: int, world_size: int) -> None:
+        """Join `name` on behalf of (possibly dead) `rank` without blocking."""
+        self.barrier_join(name, rank, world_size, timeout=0.0, wait=False)
+
+    def record_interrupted(self, record) -> None:
+        self.list_append(self.INTERRUPTION_RECORDS, record)
+
+    def get_interruption_records(self) -> list:
+        return self.list_get(self.INTERRUPTION_RECORDS)
+
+    def clear_interruption_records(self) -> None:
+        self.list_clear(self.INTERRUPTION_RECORDS)
+
+    def record_terminated_ranks(self, ranks: Iterable[int]) -> int:
+        return self.set_add(self.TERMINATED_RANKS, ranks)
+
+    def get_terminated_ranks(self) -> set[int]:
+        return self.set_get(self.TERMINATED_RANKS)
+
+    def send_heartbeat(self, rank: int, timestamp: float | None = None) -> None:
+        self.set(
+            f"{self.HEARTBEAT_PREFIX}{rank}",
+            time.time() if timestamp is None else timestamp,
+        )
+
+    def get_heartbeats(self) -> dict[int, float]:
+        raw = self.prefix_get(self.HEARTBEAT_PREFIX)
+        out = {}
+        for k, v in raw.items():
+            try:
+                out[int(k.rsplit("/", 1)[-1])] = v
+            except ValueError:
+                continue
+        return out
+
+
+class CoordStore(StoreView):
+    """A :class:`StoreView` that owns its connection — the usual entry point."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        prefix: str = "",
+        timeout: float = 300.0,
+        connect_retries: int = 60,
+        auth_key: str | None = None,
+    ):
+        client = KVClient(
+            host, port, timeout=timeout, connect_retries=connect_retries, auth_key=auth_key
+        )
+        super().__init__(client, prefix)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def host_store(
+    rank: int,
+    host: str,
+    port: int,
+    *,
+    prefix: str = "",
+    timeout: float = 300.0,
+    auth_key: str | None = None,
+) -> tuple[CoordStore, Optional[KVServer]]:
+    """Rank 0 hosts a :class:`KVServer` and every rank connects a :class:`CoordStore`.
+
+    Mirrors the reference pattern where rank 0 hosts the TCPStore
+    (``inprocess/store.py:311,345-353``). Single-host jobs bind loopback; multi-host
+    jobs must provide ``auth_key`` (or ``$TPU_RESILIENCY_STORE_KEY``) and a reachable
+    ``host``. Returns ``(client, server_or_None)``.
+    """
+    server = None
+    if rank == 0:
+        effective_key = auth_key or os.environ.get(AUTH_KEY_ENV) or None
+        bind_host = "0.0.0.0" if effective_key else "127.0.0.1"
+        server = KVServer(host=bind_host, port=port, auth_key=effective_key)
+        host = "127.0.0.1"
+        port = server.port
+    client = CoordStore(host, port, prefix=prefix, timeout=timeout, auth_key=auth_key)
+    return client, server
+
+
+def store_addr_from_env() -> tuple[str, int]:
+    """Read the coordinator address from the environment (set by the launcher)."""
+    host = os.environ.get("TPU_RESILIENCY_STORE_HOST", os.environ.get("MASTER_ADDR", "127.0.0.1"))
+    port = int(os.environ.get("TPU_RESILIENCY_STORE_PORT", os.environ.get("MASTER_PORT", "29511")))
+    return host, port

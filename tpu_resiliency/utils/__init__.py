@@ -1,0 +1,3 @@
+from tpu_resiliency.utils.logging import get_logger, RankLoggerAdapter
+
+__all__ = ["get_logger", "RankLoggerAdapter"]

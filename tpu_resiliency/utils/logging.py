@@ -1,0 +1,42 @@
+"""Rank-annotated logging helpers.
+
+Analogue of the reference's ``RankMonitorLogger`` rank-prefixed format
+(``fault_tolerance/rank_monitor_server.py:48-95``) generalized for the whole package.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FMT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def get_logger(name: str, level: int | str | None = None) -> logging.Logger:
+    """Return a package logger, configuring a stderr handler once per process."""
+    root = logging.getLogger("tpu_resiliency")
+    if not root.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FMT))
+        root.addHandler(handler)
+        env_level = os.environ.get("TPU_RESILIENCY_LOG_LEVEL", "INFO")
+        root.setLevel(env_level)
+    logger = logging.getLogger(name if name.startswith("tpu_resiliency") else f"tpu_resiliency.{name}")
+    if level is not None:
+        logger.setLevel(level)
+    return logger
+
+
+class RankLoggerAdapter(logging.LoggerAdapter):
+    """Prefixes every message with the rank (and optional role) emitting it."""
+
+    def __init__(self, logger: logging.Logger, rank: int | None = None, role: str = ""):
+        super().__init__(logger, {})
+        self.rank = rank
+        self.role = role
+
+    def process(self, msg, kwargs):
+        rank = self.rank if self.rank is not None else os.environ.get("RANK", "?")
+        prefix = f"[{self.role}]" if self.role else ""
+        return f"{prefix}[rank={rank}] {msg}", kwargs
