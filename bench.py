@@ -1,0 +1,165 @@
+"""North-star benchmark (BASELINE.json config 4): score 4096-rank heartbeat+perf fused
+telemetry — per-rank per-signal timing windows reduced to straggler scores — on one TPU
+chip, vs a host-side emulation of the reference's Python scoring path.
+
+Baseline emulation re-implements, from the spec in SURVEY.md §2.5/§3.5 (NOT copied), what
+the reference's ``ReportGenerator.generate_report`` does on host per report: per-rank
+dicts of per-signal sample lists → per-signal medians + totals (Python loop over dict
+entries), pack medians to a flat vector, min-reduce across ranks, unpack, weighted score
+loop, straggler thresholding. The device path is ``telemetry.scoring.score_round`` (and
+the Pallas fused-median variant) running as one compiled program.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}; details go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+R, S, W = 4096, 64, 32
+SLOW_FRACTION = 0.05
+SLOWDOWN = 1.6
+ITERS = 50
+
+
+def make_telemetry(seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(0.8, 1.2, size=(1, S, 1)).astype(np.float32)
+    data = base * (1.0 + 0.05 * rng.standard_normal((R, S, W)).astype(np.float32))
+    n_slow = int(R * SLOW_FRACTION)
+    slow_ranks = rng.choice(R, size=n_slow, replace=False)
+    data[slow_ranks] *= SLOWDOWN
+    counts = np.full((R, S), W, dtype=np.int32)
+    truth = np.zeros(R, dtype=bool)
+    truth[slow_ranks] = True
+    return data, counts, truth
+
+
+def baseline_host_scoring(data, counts, threshold=0.75):
+    """Reference-style host scoring: dict-of-lists telemetry, Python pack/unpack loops."""
+    # per-rank summaries as the reference holds them: dict rank -> {signal_name: samples}
+    telemetry = {
+        r: {f"sig{s}": data[r, s, : counts[r, s]].tolist() for s in range(S)} for r in range(R)
+    }
+    t0 = time.perf_counter()
+    medians, totals = {}, {}
+    for r, sigs in telemetry.items():
+        med_r, tot_r = {}, {}
+        for name, samples in sigs.items():
+            arr = np.asarray(samples)
+            med_r[name] = float(np.median(arr))
+            tot_r[name] = float(arr.sum())
+        medians[r] = med_r
+        totals[r] = tot_r
+    # pack → min-reduce across ranks → unpack (the all_reduce(MIN) emulation)
+    names = sorted(medians[0])
+    packed = np.array([[medians[r][n] for n in names] for r in range(R)])
+    ref = packed.min(axis=0)
+    # weighted per-rank score loop
+    scores = {}
+    for r in range(R):
+        num = den = 0.0
+        for j, n in enumerate(names):
+            w = totals[r][n]
+            num += w * (ref[j] / medians[r][n])
+            den += w
+        scores[r] = num / den
+    stragglers = {r for r, sc in scores.items() if sc < threshold}
+    elapsed = time.perf_counter() - t0
+    return elapsed, scores, stragglers
+
+
+def f1(pred_mask, truth):
+    tp = int((pred_mask & truth).sum())
+    fp = int((pred_mask & ~truth).sum())
+    fn = int((~pred_mask & truth).sum())
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    return 2 * prec * rec / max(prec + rec, 1e-9)
+
+
+def device_scoring(data, counts, use_pallas):
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_resiliency.telemetry import scoring
+
+    if use_pallas:
+        from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+        def run(d, c, e, h):
+            mw = fused_median_weights(d, c)
+            return scoring.score_round(d, c, e, h, medians_and_weights=mw)
+
+        fn = jax.jit(run)
+    else:
+        def run(d, c, e, h):
+            return scoring.score_round(d, c, e, h)
+
+        fn = jax.jit(run)
+
+    d = jnp.asarray(data)
+    c = jnp.asarray(counts)
+    ewma = jnp.ones((R,))
+    hist = jnp.full((R, S), jnp.inf)
+    out = fn(d, c, ewma, hist)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        # chain each step on the previous round's EWMA so steps are data-dependent
+        # (no overlap artifacts in the timing)
+        out = fn(d, c, out.ewma, hist)
+    jax.block_until_ready(out)
+    per_step = (time.perf_counter() - t0) / ITERS
+    return per_step, out
+
+
+def main():
+    data, counts, truth = make_telemetry()
+
+    base_s, base_scores, base_stragglers = baseline_host_scoring(data, counts)
+    base_mask = np.zeros(R, dtype=bool)
+    base_mask[list(base_stragglers)] = True
+    print(
+        f"baseline host scoring: {base_s * 1e3:.1f} ms/report, "
+        f"F1={f1(base_mask, truth):.3f}",
+        file=sys.stderr,
+    )
+
+    import jax
+
+    print(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}", file=sys.stderr)
+    on_tpu = jax.default_backend() == "tpu"
+
+    results = {}
+    variants = [("xla", False)] + ([("pallas", True)] if on_tpu else [])
+    for name, use_pallas in variants:
+        try:
+            per_step, out = device_scoring(data, counts, use_pallas)
+            mask = np.asarray(out.straggler)
+            results[name] = (per_step, f1(mask, truth))
+            print(
+                f"device[{name}]: {per_step * 1e3:.3f} ms/step, F1={results[name][1]:.3f}",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(f"device[{name}] failed: {e!r}", file=sys.stderr)
+
+    best_name, (best_s, best_f1) = min(results.items(), key=lambda kv: kv[1][0])
+    print(f"best variant: {best_name}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": f"fused telemetry scoring latency, {R} ranks x {S} signals x {W} window (F1={best_f1:.3f})",
+                "value": round(best_s * 1e3, 4),
+                "unit": "ms/step",
+                "vs_baseline": round(base_s / best_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+import numpy as np
+import pytest
+
+from tpu_resiliency.telemetry import DeviceRings, HostRingBuffer, NameRegistry
+
+
+def test_host_ring_wraps():
+    rb = HostRingBuffer(4)
+    for v in range(6):
+        rb.push(float(v))
+    assert len(rb) == 4
+    np.testing.assert_array_equal(rb.linearize(), [2.0, 3.0, 4.0, 5.0])
+    rb.reset()
+    assert len(rb) == 0
+    rb.push(9.0)
+    np.testing.assert_array_equal(rb.linearize(), [9.0])
+
+
+def test_host_ring_partial():
+    rb = HostRingBuffer(8)
+    rb.extend([1, 2, 3])
+    np.testing.assert_array_equal(rb.linearize(), [1.0, 2.0, 3.0])
+
+
+def test_device_rings_push_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    rings = DeviceRings.create(n_signals=3, capacity=4)
+
+    @jax.jit
+    def step(r, vals):
+        return r.push_row(vals)
+
+    for i in range(6):
+        rings = step(rings, jnp.asarray([i, 10 + i, 100 + i], jnp.float32))
+    assert int(rings.cursor) == 6
+    np.testing.assert_array_equal(np.asarray(rings.counts), [4, 4, 4])
+    # signal 0 holds last 4 values in ring order [4, 5, 2, 3]
+    assert set(np.asarray(rings.data)[0].tolist()) == {2.0, 3.0, 4.0, 5.0}
+    mask = np.asarray(rings.valid_mask())
+    assert mask.all()
+
+
+def test_device_rings_valid_mask_partial():
+    import jax.numpy as jnp
+
+    rings = DeviceRings.create(n_signals=2, capacity=4)
+    rings = rings.push_row(jnp.asarray([1.0, 2.0]))
+    mask = np.asarray(rings.valid_mask())
+    np.testing.assert_array_equal(mask.sum(axis=1), [1, 1])
+
+
+def test_name_registry():
+    reg = NameRegistry(3)
+    assert reg.get("a") == 0
+    assert reg.get("b") == 1
+    assert reg.get("a") == 0
+    assert reg.names() == ("a", "b")
+    reg.get("c")
+    with pytest.raises(ValueError):
+        reg.get("d")
+
+
+def test_name_registry_store_sync(coord_store):
+    r0 = NameRegistry(8)
+    r1 = NameRegistry(8)
+    r0.get("x")
+    r1.get("y")
+    # publish-all then merge-all (the barrier-separated pattern the Detector uses)
+    r0.publish(coord_store)
+    r1.publish(coord_store)
+    r0.merge(coord_store)
+    r1.merge(coord_store)
+    assert r0.index_map() == {"x": 0, "y": 1}
+    assert r1.index_map() == {"y": 0, "x": 1}
+    # convergence: next round both publish their full sets and agree on membership
+    assert set(r0.index_map()) == set(r1.index_map())

@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_resiliency.telemetry import scoring
+
+
+def _mk_windows(rng, r, s, w, base=10.0):
+    data = base + rng.standard_normal((r, s, w)).astype(np.float32) * 0.1
+    counts = np.full((r, s), w, dtype=np.int32)
+    return data, counts
+
+
+def test_masked_median_matches_numpy():
+    rng = np.random.default_rng(0)
+    data = rng.uniform(1, 5, size=(4, 3, 9)).astype(np.float32)
+    counts = np.array([[9, 5, 1], [2, 9, 4], [0, 3, 9], [9, 9, 9]], dtype=np.int32)
+    med = np.asarray(scoring.masked_median(jnp.asarray(data), jnp.asarray(counts)))
+    for i in range(4):
+        for j in range(3):
+            c = counts[i, j]
+            if c == 0:
+                assert np.isinf(med[i, j])
+            else:
+                np.testing.assert_allclose(med[i, j], np.median(data[i, j, :c]), rtol=1e-6)
+
+
+def test_masked_total():
+    data = jnp.asarray([[[1.0, 2.0, 100.0]]])
+    counts = jnp.asarray([[2]], dtype=jnp.int32)
+    assert float(scoring.masked_total(data, counts)[0, 0]) == 3.0
+
+
+def test_relative_scores_flag_slow_rank():
+    rng = np.random.default_rng(1)
+    r, s, w = 8, 4, 16
+    data, counts = _mk_windows(rng, r, s, w)
+    data[3] *= 2.0  # rank 3 is 2x slower on every signal
+    res = scoring.score_round(
+        jnp.asarray(data),
+        jnp.asarray(counts),
+        prev_ewma=jnp.ones(r),
+        historical_min=jnp.full((r, s), jnp.inf),
+    )
+    perf = np.asarray(res.perf)
+    assert perf[3] == pytest.approx(0.5, abs=0.05)
+    assert np.all(perf[np.arange(r) != 3] > 0.9)
+    straggler = np.asarray(res.straggler)
+    assert straggler[3]
+    assert not straggler[np.arange(r) != 3].any()
+
+
+def test_robust_z_detects_outlier_even_above_threshold():
+    """A rank only mildly slow (score above 0.75) is still caught by robust-z."""
+    rng = np.random.default_rng(2)
+    r, s, w = 64, 4, 16
+    data, counts = _mk_windows(rng, r, s, w)
+    data[10] *= 1.15  # 15% slow: score ~0.87 > 0.75 threshold
+    res = scoring.score_round(
+        jnp.asarray(data),
+        jnp.asarray(counts),
+        prev_ewma=jnp.ones(r),
+        historical_min=jnp.full((r, s), jnp.inf),
+    )
+    assert float(np.asarray(res.perf)[10]) > scoring.DEFAULT_THRESHOLD
+    assert np.asarray(res.straggler)[10]  # caught by z
+    assert np.asarray(res.straggler).sum() == 1
+
+
+def test_individual_scores_track_historical_min():
+    r, s, w = 2, 1, 4
+    fast = np.full((r, s, w), 1.0, dtype=np.float32)
+    counts = np.full((r, s), w, dtype=np.int32)
+    res1 = scoring.score_round(
+        jnp.asarray(fast),
+        jnp.asarray(counts),
+        prev_ewma=jnp.ones(r),
+        historical_min=jnp.full((r, s), jnp.inf),
+    )
+    np.testing.assert_allclose(np.asarray(res1.individual_section_scores), 1.0)
+    slow = fast * 4.0
+    res2 = scoring.score_round(
+        jnp.asarray(slow),
+        jnp.asarray(counts),
+        prev_ewma=res1.ewma,
+        historical_min=res1.historical_min,
+    )
+    np.testing.assert_allclose(np.asarray(res2.individual_section_scores), 0.25)
+    # relative scores see all ranks equally slow -> 1.0
+    np.testing.assert_allclose(np.asarray(res2.section_scores), 1.0)
+
+
+def test_empty_signals_score_neutral():
+    r, s, w = 4, 3, 8
+    rng = np.random.default_rng(3)
+    data, counts = _mk_windows(rng, r, s, w)
+    counts[:, 2] = 0  # nobody measured signal 2
+    counts[1, 1] = 0  # rank 1 missed signal 1
+    res = scoring.score_round(
+        jnp.asarray(data),
+        jnp.asarray(counts),
+        prev_ewma=jnp.ones(r),
+        historical_min=jnp.full((r, s), jnp.inf),
+    )
+    sec = np.asarray(res.section_scores)
+    assert np.all(np.isfinite(np.asarray(res.perf)))
+    np.testing.assert_allclose(sec[:, 2], 1.0)
+    np.testing.assert_allclose(sec[1, 1], 1.0)
+    assert not np.asarray(res.straggler).any()
+
+
+def test_ewma_smoothing():
+    r, s, w = 2, 1, 4
+    data = np.ones((r, s, w), dtype=np.float32)
+    counts = np.full((r, s), w, dtype=np.int32)
+    res = scoring.score_round(
+        jnp.asarray(data),
+        jnp.asarray(counts),
+        prev_ewma=jnp.zeros(r),
+        historical_min=jnp.full((r, s), jnp.inf),
+        alpha=0.5,
+    )
+    np.testing.assert_allclose(np.asarray(res.ewma), 0.5)
+
+
+def test_pallas_kernel_matches_reference_pipeline():
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(4)
+    r, s, w = 16, 8, 16
+    data, counts = _mk_windows(rng, r, s, w)
+    counts[0, 0] = 5
+    counts[2, 3] = 0
+    counts[5, 1] = 1
+    med_k, wt_k = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), rank_tile=8, interpret=True
+    )
+    med_ref = scoring.masked_median(jnp.asarray(data), jnp.asarray(counts))
+    wt_ref = scoring.masked_total(jnp.asarray(data), jnp.asarray(counts))
+    np.testing.assert_allclose(np.asarray(med_k), np.asarray(med_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(wt_k), np.asarray(wt_ref), rtol=1e-5)
+
+
+def test_pallas_kernel_with_duplicates():
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    data = np.full((4, 2, 8), 3.0, dtype=np.float32)
+    counts = np.full((4, 2), 8, dtype=np.int32)
+    med, wt = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), rank_tile=4, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(med), 3.0)
+    np.testing.assert_allclose(np.asarray(wt), 24.0)

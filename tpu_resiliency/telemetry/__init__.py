@@ -1,0 +1,36 @@
+from tpu_resiliency.telemetry.detector import CallableId, Detector
+from tpu_resiliency.telemetry.interval_tracker import ReportIntervalTracker
+from tpu_resiliency.telemetry.name_registry import NameRegistry
+from tpu_resiliency.telemetry.reporting import Report, ReportGenerator, StragglerId, Stragglers
+from tpu_resiliency.telemetry.ring_buffer import DeviceRings, HostRingBuffer
+from tpu_resiliency.telemetry.scoring import (
+    TelemetryScores,
+    masked_median,
+    masked_total,
+    robust_z,
+    score_round,
+    score_round_jit,
+)
+from tpu_resiliency.telemetry.statistics import ALL_STATISTICS, Statistic, compute_stats
+
+__all__ = [
+    "CallableId",
+    "Detector",
+    "ReportIntervalTracker",
+    "NameRegistry",
+    "Report",
+    "ReportGenerator",
+    "StragglerId",
+    "Stragglers",
+    "DeviceRings",
+    "HostRingBuffer",
+    "TelemetryScores",
+    "masked_median",
+    "masked_total",
+    "robust_z",
+    "score_round",
+    "score_round_jit",
+    "Statistic",
+    "ALL_STATISTICS",
+    "compute_stats",
+]

@@ -1,0 +1,300 @@
+"""User-facing straggler-detection API: timed sections + callable wrapping + reports.
+
+The API surface mirrors the reference's ``straggler.Detector`` class-singleton
+(``straggler/straggler.py:86-408``): ``initialize`` / ``detection_section`` /
+``wrap_callables`` / ``generate_report`` / ``generate_report_if_interval_elapsed`` /
+``shutdown``. Differences, by TPU design:
+
+- **Device timing semantics.** CUPTI per-kernel wall times don't exist under XLA —
+  kernels are fused into whole compiled programs. The device-side signal here is the
+  *blocked section time*: a section (or wrapped callable) can observe the jax arrays it
+  produced, and every ``profiling_interval``-th entry the section blocks on them with
+  ``jax.block_until_ready``, yielding true device-inclusive duration. Host-only wall
+  time is recorded for every entry (the reference's CPU sections,
+  ``straggler.py:288-349``). This semantic change is deliberate — see SURVEY.md §7
+  "Matching CUPTI fidelity".
+- **Aggregation.** Cross-rank aggregation happens through the coordination store at
+  report boundaries (host control plane, rare), then the global ``[R, S]`` summary
+  matrix is scored by the on-device pipeline (``telemetry/scoring.py``). In
+  single-process simulations the matrix is scored directly with zero host transfers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from tpu_resiliency.exceptions import ResiliencyError
+from tpu_resiliency.telemetry.interval_tracker import ReportIntervalTracker
+from tpu_resiliency.telemetry.name_registry import NameRegistry
+from tpu_resiliency.telemetry.reporting import Report, ReportGenerator
+from tpu_resiliency.telemetry.ring_buffer import HostRingBuffer
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+SECTION_PREFIX = "sec/"
+DEVICE_PREFIX = "dev/"
+
+
+@dataclasses.dataclass(frozen=True)
+class CallableId:
+    """Identifies a method to wrap (reference ``straggler.py:34``)."""
+
+    obj: Any
+    name: str
+
+    @property
+    def display_name(self) -> str:
+        owner = getattr(self.obj, "__name__", None) or type(self.obj).__name__
+        return f"{owner}.{self.name}"
+
+
+class _Section:
+    """Yielded by ``detection_section``; lets user code register device outputs."""
+
+    __slots__ = ("_observed",)
+
+    def __init__(self):
+        self._observed: list = []
+
+    def observe(self, value):
+        """Register jax arrays produced in this section for device-time blocking."""
+        self._observed.append(value)
+        return value
+
+
+class Detector:
+    """Class-level singleton, like the reference (``straggler/straggler.py:86``)."""
+
+    initialized: bool = False
+    rank: int = 0
+    world_size: int = 1
+    store = None
+    profiling_interval: int = 1
+    gather_on_rank0: bool = True
+    scores_to_compute: tuple = ("relative_perf_scores", "individual_perf_scores")
+    window: int = 128
+    max_signals: int = 64
+
+    _registry: Optional[NameRegistry] = None
+    _rings: dict = {}
+    _entry_counts: dict = {}
+    _interval_tracker: Optional[ReportIntervalTracker] = None
+    _generator: Optional[ReportGenerator] = None
+    _wrapped: list = []
+    _use_pallas: bool = False
+    _node_name: Optional[str] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def initialize(
+        cls,
+        scores_to_compute: Iterable[str] = ("relative_perf_scores", "individual_perf_scores"),
+        gather_on_rank0: bool = True,
+        profiling_interval: int = 1,
+        report_time_interval: float = 60.0,
+        *,
+        rank: int = 0,
+        world_size: int = 1,
+        store=None,
+        window: int = 128,
+        max_signals: int = 64,
+        use_pallas: bool = False,
+        node_name: Optional[str] = None,
+    ) -> None:
+        if cls.initialized:
+            raise ResiliencyError("Detector already initialized")
+        cls.initialized = True
+        cls.scores_to_compute = tuple(scores_to_compute)
+        cls.gather_on_rank0 = gather_on_rank0
+        cls.profiling_interval = max(1, profiling_interval)
+        cls.rank = rank
+        cls.world_size = world_size
+        cls.store = store
+        cls.window = window
+        cls.max_signals = max_signals
+        cls._use_pallas = use_pallas
+        cls._node_name = node_name
+        cls._registry = NameRegistry(max_signals)
+        cls._rings = {}
+        cls._entry_counts = {}
+        cls._wrapped = []
+        cls._interval_tracker = ReportIntervalTracker(
+            report_time_interval, store=store, world_size=world_size, rank=rank
+        )
+        cls._generator = ReportGenerator(
+            world_size=world_size, max_signals=max_signals, use_pallas=use_pallas
+        )
+
+    @classmethod
+    def shutdown(cls) -> None:
+        for obj, name, orig in cls._wrapped:
+            setattr(obj, name, orig)
+        cls._wrapped = []
+        cls._rings = {}
+        cls._entry_counts = {}
+        cls._registry = None
+        cls._generator = None
+        cls._interval_tracker = None
+        cls.store = None
+        cls.initialized = False
+
+    # -- recording ---------------------------------------------------------
+
+    @classmethod
+    def _ring(cls, signal: str) -> HostRingBuffer:
+        ring = cls._rings.get(signal)
+        if ring is None:
+            cls._registry.get(signal)  # reserve the column
+            ring = cls._rings[signal] = HostRingBuffer(cls.window)
+        return ring
+
+    @classmethod
+    def _record(cls, signal: str, seconds: float) -> None:
+        cls._ring(signal).push(seconds)
+
+    @classmethod
+    @contextmanager
+    def detection_section(cls, name: str, profile_device: bool = True):
+        """Time a block of code; optionally block on observed device outputs.
+
+        Reference: ``detection_section`` ctx manager (``straggler.py:288-349``).
+        """
+        if not cls.initialized:
+            raise ResiliencyError("Detector.initialize() must be called first")
+        count = cls._entry_counts.get(name, 0)
+        cls._entry_counts[name] = count + 1
+        profile_now = profile_device and (count % cls.profiling_interval == 0)
+        section = _Section()
+        start = time.perf_counter_ns()
+        try:
+            yield section
+        finally:
+            host_elapsed = (time.perf_counter_ns() - start) * 1e-9
+            cls._record(SECTION_PREFIX + name, host_elapsed)
+            if profile_now and section._observed:
+                import jax
+
+                jax.block_until_ready(section._observed)
+                dev_elapsed = (time.perf_counter_ns() - start) * 1e-9
+                cls._record(DEVICE_PREFIX + name, dev_elapsed)
+
+    @classmethod
+    def wrap_callables(cls, callable_ids: Iterable[CallableId], profile_device: bool = True):
+        """Monkey-patch methods into detection sections (reference ``straggler.py:368-400``).
+
+        Wrapped callables auto-observe any jax arrays in their return value, so every
+        ``profiling_interval``-th call records a device-inclusive duration.
+        """
+        for cid in callable_ids:
+            orig = getattr(cid.obj, cid.name)
+            section_name = cid.display_name
+
+            def make_wrapper(orig_fn, sname):
+                def wrapper(*args, **kwargs):
+                    with cls.detection_section(sname, profile_device=profile_device) as sec:
+                        out = orig_fn(*args, **kwargs)
+                        if profile_device:
+                            sec.observe(out)
+                        return out
+
+                wrapper.__name__ = getattr(orig_fn, "__name__", sname)
+                wrapper.__wrapped__ = orig_fn
+                return wrapper
+
+            setattr(cid.obj, cid.name, make_wrapper(orig, section_name))
+            cls._wrapped.append((cid.obj, cid.name, orig))
+
+    # -- summaries ---------------------------------------------------------
+
+    @classmethod
+    def local_summary(cls) -> dict[str, dict[str, float | int]]:
+        """Per-signal {median, total, count} from the host rings."""
+        out = {}
+        for name, ring in cls._rings.items():
+            samples = ring.linearize()
+            if samples.size:
+                out[name] = {
+                    "median": float(np.median(samples)),
+                    "total": float(samples.sum()),
+                    "count": int(samples.size),
+                }
+        return out
+
+    @classmethod
+    def _reset_rings(cls) -> None:
+        for ring in cls._rings.values():
+            ring.reset()
+        # entry counts persist: profiling cadence continues across reports
+
+    # -- report generation -------------------------------------------------
+
+    @classmethod
+    def generate_report(cls) -> Optional[Report]:
+        """Aggregate summaries across ranks and run the device scoring round.
+
+        Multi-rank: every rank publishes its summary to the store, joins a barrier,
+        then scores the global summary matrix on device (every rank gets the global
+        view; ``gather_on_rank0`` only controls whether non-zero ranks build the full
+        Report or return None, for API parity with the reference).
+        Reference: ``generate_report`` (``straggler.py:228-245``).
+        """
+        if not cls.initialized:
+            raise ResiliencyError("Detector.initialize() must be called first")
+        import jax.numpy as jnp
+
+        local = cls.local_summary()
+        if cls.store is not None and cls.world_size > 1:
+            round_idx = cls._generator.iteration
+            ns = f"telemetry/round/{round_idx}"
+            cls._registry.publish(cls.store, key=f"{ns}/names")
+            cls.store.set(f"{ns}/summary/{cls.rank}", local)
+            cls.store.barrier(f"{ns}/publish", cls.rank, cls.world_size, 300.0)
+            cls._registry.merge(cls.store, key=f"{ns}/names")
+            summaries = [
+                cls.store.get(f"{ns}/summary/{r}", timeout=60.0)
+                for r in range(cls.world_size)
+            ]
+        else:
+            summaries = [local]
+
+        names = cls._registry.names()
+        s = len(names)
+        if s == 0:
+            return None
+        r_world = max(cls.world_size, 1)
+        medians = np.full((r_world, s), np.inf, dtype=np.float32)
+        weights = np.zeros((r_world, s), dtype=np.float32)
+        counts = np.zeros((r_world, s), dtype=np.int32)
+        col = {n: j for j, n in enumerate(names)}
+        for r, summary in enumerate(summaries):
+            for n, st in summary.items():
+                j = col.get(n)
+                if j is None:
+                    continue
+                medians[r, j] = st["median"]
+                weights[r, j] = st["total"]
+                counts[r, j] = st["count"]
+
+        report = cls._generator.generate_summary_report(
+            jnp.asarray(medians), jnp.asarray(weights), jnp.asarray(counts), names,
+            rank=cls.rank,
+        )
+        cls._reset_rings()
+        if cls.gather_on_rank0 and cls.rank != 0:
+            return None
+        return report
+
+    @classmethod
+    def generate_report_if_interval_elapsed(cls) -> Optional[Report]:
+        """Per-iteration hook (reference ``straggler.py:247-262``)."""
+        cls._interval_tracker.iter_increase()
+        if not cls._interval_tracker.is_interval_elapsed():
+            return None
+        return cls.generate_report()

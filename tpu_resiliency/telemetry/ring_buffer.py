@@ -1,0 +1,128 @@
+"""Fixed-capacity ring buffers for timing samples — host-side and device-resident.
+
+The host ring is the analogue of the reference's C++ ``CircularBuffer<float>`` +
+``BufferPool`` feeding CUPTI kernel timings (``straggler/cupti_src/CircularBuffer.h:22-70``,
+``BufferPool.h:24-38``). The device ring is the TPU-first redesign: a pytree of arrays
+updated *inside* the jitted step function (donated, so updates are in-place in HBM),
+letting telemetry accumulate with zero host-side Python until a report boundary
+(BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+
+class HostRingBuffer:
+    """Bounded ring of float samples with O(1) append and linearized readout."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._buf = np.zeros(capacity, dtype=np.float64)
+        self._next = 0
+        self._count = 0
+
+    def push(self, value: float) -> None:
+        self._buf[self._next] = value
+        self._next = (self._next + 1) % self.capacity
+        self._count = min(self._count + 1, self.capacity)
+
+    def extend(self, values) -> None:
+        for v in np.asarray(values, dtype=np.float64).ravel():
+            self.push(float(v))
+
+    def __len__(self) -> int:
+        return self._count
+
+    def linearize(self) -> np.ndarray:
+        """Samples oldest→newest (reference ``CircularBuffer.linearize()``)."""
+        if self._count < self.capacity:
+            return self._buf[: self._count].copy()
+        return np.concatenate([self._buf[self._next :], self._buf[: self._next]])
+
+    def reset(self) -> None:
+        self._next = 0
+        self._count = 0
+
+
+@dataclasses.dataclass
+class DeviceRings:
+    """Device-resident rings for ``n_signals`` timing streams.
+
+    A pytree ``(data [n_signals, capacity], cursor [], counts [n_signals])`` designed to
+    be carried through a jitted train step with donation:
+
+        rings = DeviceRings.create(n_signals=..., capacity=...)
+        ...
+        rings = rings.push_row(step_durations)        # inside jit
+
+    ``push_row`` writes one sample per signal (a step's timings for every signal at
+    once) using a shared cursor — static shapes, no data-dependent control flow, so XLA
+    keeps the whole update on device.
+    """
+
+    data: Any  # f32 [n_signals, capacity]
+    cursor: Any  # i32 []
+    counts: Any  # i32 [n_signals]
+
+    @staticmethod
+    def create(n_signals: int, capacity: int, dtype=None):
+        import jax.numpy as jnp
+
+        dtype = dtype or jnp.float32
+        return DeviceRings(
+            data=jnp.zeros((n_signals, capacity), dtype),
+            cursor=jnp.zeros((), jnp.int32),
+            counts=jnp.zeros((n_signals,), jnp.int32),
+        )
+
+    def push_row(self, values) -> "DeviceRings":
+        import jax
+        import jax.numpy as jnp
+
+        values = jnp.asarray(values, self.data.dtype).reshape(-1, 1)
+        capacity = self.data.shape[1]
+        idx = self.cursor % capacity
+        data = jax.lax.dynamic_update_slice(self.data, values, (0, idx))
+        return DeviceRings(
+            data=data,
+            cursor=self.cursor + 1,
+            counts=jnp.minimum(self.counts + 1, capacity),
+        )
+
+    def valid_mask(self):
+        """[n_signals, capacity] bool — True where a real sample exists."""
+        import jax.numpy as jnp
+
+        pos = jnp.arange(self.data.shape[1])[None, :]
+        return pos < self.counts[:, None]
+
+    def reset(self) -> "DeviceRings":
+        import jax.numpy as jnp
+
+        return DeviceRings(
+            data=self.data,  # stale data is masked out by counts
+            cursor=jnp.zeros((), jnp.int32),
+            counts=jnp.zeros_like(self.counts),
+        )
+
+
+def register_pytrees() -> None:
+    import jax
+
+    try:
+        jax.tree_util.register_pytree_node(
+            DeviceRings,
+            lambda r: ((r.data, r.cursor, r.counts), None),
+            lambda _, c: DeviceRings(*c),
+        )
+    except ValueError:
+        pass  # already registered
+
+
+register_pytrees()
