@@ -1,0 +1,197 @@
+"""Flagship model: a Llama-style decoder-only transformer, pure JAX, mesh-shardable.
+
+The resiliency framework's exercise workload (the reference exercises NVRx against
+NeMo/Lightning Llama-3 jobs, ``tests/ptl_resiliency/func/nemo20/``). Built TPU-first:
+
+- parameters are a plain pytree with stacked layer weights, so the layer stack runs as
+  one ``lax.scan`` (single trace/compile per layer body, MXU-sized matmuls),
+- bfloat16 activations / float32 params + optimizer, RoPE, GQA, SwiGLU, RMSNorm,
+- shardable over the canonical (dp, tp, sp) mesh via ``parallel/mesh.py`` specs; with
+  ``sp > 1`` attention runs as ring attention over the sequence axis
+  (``parallel/ring_attention.py``),
+- no Python control flow on data inside jit; static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def tiny(**kw) -> "TransformerConfig":
+        base = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128,
+        )
+        base.update(kw)
+        return TransformerConfig(**base)
+
+    @staticmethod
+    def llama3_8b() -> "TransformerConfig":
+        return TransformerConfig(
+            vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+            d_ff=14336, max_seq_len=8192, rope_theta=500000.0,
+        )
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Parameter pytree with layer weights stacked on a leading [L] axis."""
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    d, h, hkv, dh, f, L = (
+        cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
+    )
+
+    def norm_init(*shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def dense_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in))
+
+    ks = jax.random.split(k_layers, 7)
+    return {
+        "embed": dense_init(k_embed, (cfg.vocab_size, d), d),
+        "layers": {
+            "attn_norm": norm_init(L, d),
+            "wq": dense_init(ks[0], (L, d, h * dh), d),
+            "wk": dense_init(ks[1], (L, d, hkv * dh), d),
+            "wv": dense_init(ks[2], (L, d, hkv * dh), d),
+            "wo": dense_init(ks[3], (L, h * dh, d), h * dh),
+            "mlp_norm": norm_init(L, d),
+            "w_gate": dense_init(ks[4], (L, d, f), d),
+            "w_up": dense_init(ks[5], (L, d, f), d),
+            "w_down": dense_init(ks[6], (L, f, d), f),
+        },
+        "final_norm": norm_init(d),
+        "lm_head": dense_init(k_head, (d, cfg.vocab_size), d),
+    }
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def rope_tables(cfg: TransformerConfig, seq_len: int, offset: int = 0):
+    dh = cfg.head_dim
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, dh, 2, jnp.float32) / dh))
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    angles = pos[:, None] * inv_freq[None, :]  # [T, dh/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: [B, T, H, dh]; cos/sin: [T, dh/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, causal_offset: int = 0):
+    """Plain causal attention. q: [B, T, H, dh], k/v: [B, T, H, dh] (kv pre-repeated)."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(dh)
+    tq, tk = q.shape[1], k.shape[1]
+    qpos = jnp.arange(tq)[:, None] + causal_offset
+    kpos = jnp.arange(tk)[None, :]
+    mask = qpos >= kpos
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) -> jax.Array:
+    b, t, d = x.shape
+    h, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    # attention block
+    y = rms_norm(x, lp["attn_norm"])
+    q = (y @ lp["wq"].astype(y.dtype)).reshape(b, t, h, dh)
+    k = (y @ lp["wk"].astype(y.dtype)).reshape(b, t, hkv, dh)
+    v = (y @ lp["wv"].astype(y.dtype)).reshape(b, t, hkv, dh)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    reps = h // hkv
+    k = jnp.repeat(k, reps, axis=2)
+    v = jnp.repeat(v, reps, axis=2)
+    attn = attn_fn(q, k, v).reshape(b, t, h * dh)
+    x = x + attn @ lp["wo"].astype(attn.dtype)
+
+    # MLP block (SwiGLU)
+    y = rms_norm(x, lp["mlp_norm"])
+    gate = jax.nn.silu(y @ lp["w_gate"].astype(y.dtype))
+    up = y @ lp["w_up"].astype(y.dtype)
+    x = x + (gate * up) @ lp["w_down"].astype(y.dtype)
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: TransformerConfig,
+    *,
+    attn_fn=None,
+    position_offset: int = 0,
+) -> jax.Array:
+    """tokens [B, T] int32 → logits [B, T, V] (float32)."""
+    attn_fn = attn_fn or functools.partial(_attention, causal_offset=position_offset)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_tables(cfg, tokens.shape[1], position_offset)
+
+    def body(x, lp):
+        return _layer(cfg, x, lp, cos, sin, attn_fn), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig, **kw) -> jax.Array:
+    """Next-token cross-entropy over tokens [B, T]."""
+    logits = forward(params, tokens[:, :-1], cfg, **kw)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: TransformerConfig, optimizer=None):
+    """Returns ``(train_step, init_opt_state)`` — jit-ready pure functions."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state

@@ -1,0 +1,104 @@
+"""Mesh axis conventions and sharding-spec helpers for the framework's models.
+
+Axis names used throughout:
+
+- ``dp``: data parallel (batch axis; gradients all-reduced over ICI),
+- ``tp``: tensor parallel (attention heads / MLP hidden sharded; activations
+  all-gathered / reduce-scattered by XLA where needed),
+- ``sp``: sequence/context parallel (long-context: sequence axis sharded, attention
+  runs as a ring over ``sp`` — see ``parallel/ring_attention.py``).
+
+The reference implements no parallelism (SURVEY.md §2.7 checklist) — these exist because
+a TPU-native resiliency framework must be *exercised* against real sharded workloads,
+and its rank topology components (Tree layers, replication cliques) key off mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+DP, TP, SP = "dp", "tp", "sp"
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+):
+    """Build a ``Mesh`` with the framework's canonical axes (dp, tp, sp).
+
+    If ``n_devices`` is given without explicit axis sizes, all devices go to ``dp``.
+    """
+    import jax
+
+    from tpu_resiliency.platform.device import make_mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    total = dp * tp * sp
+    if total == 1 and n_devices:
+        dp, total = len(devs), len(devs)
+    if total != len(devs):
+        raise ValueError(f"dp*tp*sp = {total} != {len(devs)} devices")
+    return make_mesh({DP: dp, TP: tp, SP: sp}, devices=devs)
+
+
+def default_split(n_devices: int) -> dict[str, int]:
+    """A sensible (dp, tp, sp) split for n devices: tp up to 4, rest dp."""
+    tp = 1
+    for cand in (4, 2):
+        if n_devices % cand == 0:
+            tp = cand
+            break
+    return {"dp": n_devices // tp, "tp": tp, "sp": 1}
+
+
+def param_specs(cfg) -> dict:
+    """PartitionSpecs for the transformer parameter pytree (see models/transformer.py).
+
+    Layout follows the megatron-style convention: column-parallel then row-parallel —
+    wq/wk/wv and w_gate/w_up shard their output dim over ``tp``; wo and w_down shard
+    their input dim over ``tp``; embeddings shard vocab over ``tp``; norms replicate.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "embed": P(TP, None),  # [V, D]
+        "layers": {
+            "attn_norm": P(None, None),  # [L, D]
+            "wq": P(None, None, TP),  # [L, D, H*dh]
+            "wk": P(None, None, TP),  # [L, D, Hkv*dh]
+            "wv": P(None, None, TP),  # [L, D, Hkv*dh]
+            "wo": P(None, TP, None),  # [L, H*dh, D]
+            "mlp_norm": P(None, None),  # [L, D]
+            "w_gate": P(None, None, TP),  # [L, D, F]
+            "w_up": P(None, None, TP),  # [L, D, F]
+            "w_down": P(None, TP, None),  # [L, F, D]
+        },
+        "final_norm": P(None),  # [D]
+        "lm_head": P(None, TP),  # [D, V]
+    }
+
+
+def batch_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P(DP, SP)  # tokens [B, T]
+
+
+def tree_shardings(mesh, specs):
+    """Map a spec pytree to NamedShardings on ``mesh``."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
