@@ -1,0 +1,72 @@
+import argparse
+import signal
+
+import pytest
+
+from tpu_resiliency.watchdog import FaultToleranceConfig
+
+
+def test_defaults_match_reference_envelope():
+    cfg = FaultToleranceConfig()
+    assert cfg.initial_rank_heartbeat_timeout == 3600.0
+    assert cfg.rank_heartbeat_timeout == 2700.0
+    assert cfg.workload_check_interval == 5.0
+    assert cfg.safety_factor == 5.0
+    assert cfg.rank_termination_signal == int(signal.SIGKILL)
+
+
+def test_yaml_nested_section(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        """
+trainer:
+  exp:
+    fault_tolerance:
+      rank_heartbeat_timeout: 120
+      safety_factor: 3.0
+      rank_termination_signal: SIGTERM
+"""
+    )
+    cfg = FaultToleranceConfig.from_yaml_file(str(p))
+    assert cfg.rank_heartbeat_timeout == 120
+    assert cfg.safety_factor == 3.0
+    assert cfg.rank_termination_signal == int(signal.SIGTERM)
+
+
+def test_yaml_missing_section(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("foo: {bar: 1}\n")
+    with pytest.raises(ValueError):
+        FaultToleranceConfig.from_yaml_file(str(p))
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ValueError):
+        FaultToleranceConfig.from_dict({"not_a_knob": 1})
+
+
+def test_cli_overrides():
+    args = argparse.Namespace(
+        ft_param_rank_heartbeat_timeout="90",
+        ft_param_safety_factor="2.5",
+        ft_param_enable_health_checks="true",
+        other_arg=7,
+    )
+    cfg = FaultToleranceConfig.from_args(args)
+    assert cfg.rank_heartbeat_timeout == 90
+    assert cfg.safety_factor == 2.5
+    assert cfg.enable_health_checks is True
+
+
+def test_cli_unknown_param():
+    args = argparse.Namespace(ft_param_bogus="1")
+    with pytest.raises(ValueError):
+        FaultToleranceConfig.from_args(args)
+
+
+def test_roundtrip_yaml(tmp_path):
+    cfg = FaultToleranceConfig(rank_heartbeat_timeout=42.0)
+    p = tmp_path / "out.yaml"
+    cfg.to_yaml_file(str(p))
+    cfg2 = FaultToleranceConfig.from_yaml_file(str(p))
+    assert cfg2.rank_heartbeat_timeout == 42.0

@@ -1,0 +1,147 @@
+"""Live monitor-server/client integration: real forked monitor process, real UDS."""
+
+import multiprocessing as mp
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import FaultToleranceError
+from tpu_resiliency.watchdog import (
+    FaultToleranceConfig,
+    HeartbeatTimeouts,
+    RankInfo,
+    RankMonitorClient,
+    RankMonitorServer,
+)
+
+
+@pytest.fixture
+def monitor(tmp_uds_path):
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=None,
+        rank_heartbeat_timeout=None,
+        workload_check_interval=0.2,
+    )
+    proc = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+    yield tmp_uds_path, cfg
+    proc.terminate()
+    proc.join(5.0)
+
+
+def _client(path, rank=0):
+    c = RankMonitorClient()
+    c.init_workload_monitoring(
+        socket_path=path,
+        rank_info=RankInfo(global_rank=rank, local_rank=rank, host="h", pid=os.getpid()),
+    )
+    return c
+
+
+def test_init_and_heartbeats(monitor):
+    path, _ = monitor
+    c = _client(path)
+    assert c.cfg.workload_check_interval == 0.2
+    for _ in range(5):
+        c.send_heartbeat()
+        time.sleep(0.01)
+    assert c.timeouts_calc.hb_count == 5
+    c.shutdown_workload_monitoring()
+
+
+def test_sections_roundtrip(monitor):
+    path, _ = monitor
+    c = _client(path)
+    c.start_section("setup")
+    c.end_section("setup")
+    c.start_section("step")
+    c.end_all_sections()
+    with pytest.raises(FaultToleranceError):
+        c.end_section("step")  # already closed by end_all
+    c.shutdown_workload_monitoring()
+
+
+def test_calculated_timeouts_update_server(monitor):
+    path, _ = monitor
+    c = _client(path)
+    c.send_heartbeat()
+    time.sleep(0.05)
+    c.send_heartbeat()
+    t = c.calculate_and_set_hb_timeouts()
+    assert t.calculated and t.are_valid
+    # state dict round trip
+    state = c.state_dict()
+    c2 = RankMonitorClient()
+    c2.load_state_dict(state)
+    assert c2._loaded_state["hb_timeouts"].calculated
+    c.shutdown_workload_monitoring()
+
+
+def _hang_victim(path, ready_q):
+    """Child process: connects, heartbeats once with tight timeouts, then hangs."""
+    from tpu_resiliency.watchdog import HeartbeatTimeouts, RankInfo, RankMonitorClient
+    from tpu_resiliency.watchdog.data import UpdateTimeoutsMsg
+
+    c = RankMonitorClient()
+    c.init_workload_monitoring(
+        socket_path=path,
+        rank_info=RankInfo(global_rank=0, local_rank=0, host="h", pid=os.getpid()),
+    )
+    c._request(
+        UpdateTimeoutsMsg(
+            hb_timeouts=HeartbeatTimeouts(initial=0.5, subsequent=0.5, calculated=True)
+        )
+    )
+    c.send_heartbeat()
+    ready_q.put(os.getpid())
+    time.sleep(60)  # simulated hang: no more heartbeats
+    sys.exit(0)
+
+
+def test_hang_detection_kills_rank(tmp_uds_path):
+    """The reference heartbeat-path contract (SURVEY §3.2): monitor detects the missed
+    heartbeat and terminates the rank with the configured signal."""
+    cfg = FaultToleranceConfig(workload_check_interval=0.2, rank_termination_signal=signal.SIGTERM)
+    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+    ctx = mp.get_context("fork")
+    ready_q = ctx.Queue()
+    victim = ctx.Process(target=_hang_victim, args=(tmp_uds_path, ready_q))
+    victim.start()
+    ready_q.get(timeout=10.0)
+    victim.join(15.0)
+    assert not victim.is_alive(), "hung rank was not terminated by the monitor"
+    assert victim.exitcode == -signal.SIGTERM
+    mon.terminate()
+    mon.join(5.0)
+
+
+def test_section_timeout_detection(tmp_uds_path):
+    """A section left open past its timeout triggers termination."""
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=None,
+        rank_heartbeat_timeout=None,
+        rank_section_timeouts={"step": 0.4},
+        workload_check_interval=0.1,
+        rank_termination_signal=signal.SIGTERM,
+    )
+    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+
+    def victim_main(path):
+        c = RankMonitorClient()
+        c.init_workload_monitoring(
+            socket_path=path,
+            rank_info=RankInfo(global_rank=0, local_rank=0, host="h", pid=os.getpid()),
+        )
+        c.start_section("step")
+        time.sleep(60)
+
+    ctx = mp.get_context("fork")
+    victim = ctx.Process(target=victim_main, args=(tmp_uds_path,))
+    victim.start()
+    victim.join(15.0)
+    assert not victim.is_alive()
+    assert victim.exitcode == -signal.SIGTERM
+    mon.terminate()
+    mon.join(5.0)

@@ -1,0 +1,57 @@
+import logging
+
+import pytest
+
+from tpu_resiliency.exceptions import InternalError
+from tpu_resiliency.watchdog import LOG_MARKER, RestarterState, RestarterStateMachine
+
+
+def test_happy_path_transitions(caplog):
+    sm = RestarterStateMachine("InJob")
+    with caplog.at_level(logging.INFO, logger="tpu_resiliency"):
+        sm.initialize()
+        sm.handling_start("reason='hb timeout'")
+        sm.handling_processing()
+        sm.handling_completed()
+        sm.handling_start()  # another fault round
+        sm.handling_processing()
+        sm.handling_completed()
+        sm.finalized()
+    lines = [r.message for r in caplog.records if LOG_MARKER in r.message]
+    assert len(lines) == 8
+    # the machine-parseable contract used by layered restart
+    assert lines[0] == f"{LOG_MARKER} name=[InJob] state=initialize"
+    assert lines[1].startswith(f"{LOG_MARKER} name=[InJob] state=handling_start reason=")
+
+
+def test_illegal_transition_strict():
+    sm = RestarterStateMachine("InJob", strict=True)
+    with pytest.raises(InternalError):
+        sm.handling_processing()  # from UNINITIALIZED
+
+
+def test_illegal_transition_lenient(caplog):
+    sm = RestarterStateMachine("InJob", strict=False)
+    with caplog.at_level(logging.WARNING, logger="tpu_resiliency"):
+        sm.handling_processing()
+    assert sm.state is RestarterState.HANDLING_PROCESSING
+
+
+def test_health_checks(tmp_path):
+    from tpu_resiliency.watchdog import CallbackHealthCheck, SysfsCounterCheck
+
+    ok = CallbackHealthCheck(lambda: True, "ok")
+    bad = CallbackHealthCheck(lambda: 1 / 0, "raises")
+    assert ok() and not bad()
+
+    counter = tmp_path / "dev0" / "link_downed"
+    counter.parent.mkdir()
+    counter.write_text("0")
+    check = SysfsCounterCheck(str(tmp_path / "*" / "link_downed"))
+    assert check()  # baseline
+    assert check()  # unchanged
+    counter.write_text("1")
+    assert not check()  # counter increased
+    assert not check()  # sticky
+    check.reset()
+    assert check()

@@ -1,0 +1,87 @@
+import pytest
+
+from tpu_resiliency.exceptions import FaultToleranceError
+from tpu_resiliency.watchdog import HeartbeatTimeouts, TimeoutsCalc
+
+
+def test_hb_gap_tracking_injected_times():
+    """Injected timestamps, no sleeping (reference test_timeouts_calc.py pattern)."""
+    calc = TimeoutsCalc(safety_factor=5.0)
+    calc.start_time = 100.0
+    calc.update_on_heartbeat(103.0)  # initial gap 3
+    calc.update_on_heartbeat(104.0)  # subsequent 1
+    calc.update_on_heartbeat(106.5)  # subsequent 2.5
+    t = calc.get_hb_timeouts()
+    assert t.initial == pytest.approx(5.0 * 3.0)
+    assert t.subsequent == pytest.approx(5.0 * 2.5)
+    assert t.calculated
+
+
+def test_initial_timeout_covers_subsequent_gap():
+    calc = TimeoutsCalc(safety_factor=2.0)
+    calc.start_time = 0.0
+    calc.update_on_heartbeat(1.0)
+    calc.update_on_heartbeat(11.0)  # subsequent gap 10 > initial gap 1
+    t = calc.get_hb_timeouts()
+    assert t.initial == pytest.approx(20.0)
+
+
+def test_needs_two_heartbeats():
+    calc = TimeoutsCalc()
+    calc.start_time = 0.0
+    calc.update_on_heartbeat(1.0)
+    with pytest.raises(FaultToleranceError):
+        calc.get_hb_timeouts()
+
+
+def test_ema_merge_with_previous():
+    calc = TimeoutsCalc(safety_factor=1.0)
+    calc.start_time = 0.0
+    calc.update_on_heartbeat(4.0)
+    calc.update_on_heartbeat(6.0)
+    prev = HeartbeatTimeouts(initial=8.0, subsequent=4.0, calculated=True)
+    t = calc.get_hb_timeouts(previous=prev)
+    assert t.initial == pytest.approx(0.5 * 4.0 + 0.5 * 8.0)
+    assert t.subsequent == pytest.approx(0.5 * 2.0 + 0.5 * 4.0)
+
+
+def test_sections():
+    calc = TimeoutsCalc(safety_factor=2.0)
+    calc.update_on_section_open("step", 10.0)
+    calc.update_on_section_close("step", 11.5)
+    calc.update_on_section_open("step", 20.0)  # out-of-section gap 8.5
+    calc.update_on_section_close("step", 21.0)
+    st = calc.get_section_timeouts()
+    assert st.section["step"] == pytest.approx(2.0 * 1.5)
+    assert st.out_of_section == pytest.approx(2.0 * 8.5)
+    with pytest.raises(FaultToleranceError):
+        calc.update_on_section_close("never-opened")
+
+
+def test_store_synchronize_max(kv_server):
+    import threading
+
+    from tpu_resiliency.platform.store import CoordStore
+
+    world = 3
+    results = {}
+
+    def run(rank):
+        store = CoordStore("127.0.0.1", kv_server.port)
+        calc = TimeoutsCalc(safety_factor=1.0)
+        calc.start_time = 0.0
+        calc.update_on_heartbeat(1.0 + rank)  # rank 2 has largest initial gap 3
+        calc.update_on_heartbeat(2.0 + rank * 2)  # rank 2: gap 3
+        calc.synchronize_all(store, rank, world)
+        results[rank] = calc.get_hb_timeouts()
+        store.close()
+
+    threads = [threading.Thread(target=run, args=(r,)) for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    # all ranks agree on the MAX-merged gaps
+    assert results[0].initial == results[1].initial == results[2].initial
+    assert results[0].initial == pytest.approx(3.0)
+    assert results[0].subsequent == pytest.approx(3.0)

@@ -1,0 +1,9 @@
+from tpu_resiliency.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+)
+
+__all__ = ["TransformerConfig", "forward", "init_params", "loss_fn", "make_train_step"]

@@ -1,0 +1,3 @@
+from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+__all__ = ["fused_median_weights"]

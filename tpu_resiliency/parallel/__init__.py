@@ -1,0 +1,3 @@
+from tpu_resiliency.parallel import mesh
+
+__all__ = ["mesh"]

@@ -1,0 +1,43 @@
+from tpu_resiliency.watchdog.config import FaultToleranceConfig
+from tpu_resiliency.watchdog.data import (
+    HeartbeatTimeouts,
+    RankInfo,
+    SectionTimeouts,
+    WorkloadAction,
+    WorkloadControlRequest,
+)
+from tpu_resiliency.watchdog.health import (
+    CallbackHealthCheck,
+    DeviceLivenessCheck,
+    HealthCheck,
+    PeriodicHealthMonitor,
+    SysfsCounterCheck,
+)
+from tpu_resiliency.watchdog.monitor_client import RankMonitorClient
+from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
+from tpu_resiliency.watchdog.state_machine import (
+    LOG_MARKER,
+    RestarterState,
+    RestarterStateMachine,
+)
+from tpu_resiliency.watchdog.timeouts import TimeoutsCalc
+
+__all__ = [
+    "FaultToleranceConfig",
+    "HeartbeatTimeouts",
+    "RankInfo",
+    "SectionTimeouts",
+    "WorkloadAction",
+    "WorkloadControlRequest",
+    "CallbackHealthCheck",
+    "DeviceLivenessCheck",
+    "HealthCheck",
+    "PeriodicHealthMonitor",
+    "SysfsCounterCheck",
+    "RankMonitorClient",
+    "RankMonitorServer",
+    "LOG_MARKER",
+    "RestarterState",
+    "RestarterStateMachine",
+    "TimeoutsCalc",
+]

@@ -1,0 +1,127 @@
+"""Wire messages and shared types for the rank↔monitor↔launcher control plane.
+
+Analogue of the reference's ``fault_tolerance/data.py`` (RankInfo ``:34``, timeout
+bundles ``:71-138``, Init/Heartbeat/Section/UpdateConfig/Ok/Error messages ``:141-233``,
+WorkloadAction + WorkloadControlRequest ``:236-260``). Messages travel as pickled frames
+over filesystem-protected Unix sockets (``platform/ipc.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RankInfo:
+    global_rank: int
+    local_rank: int
+    host: str
+    pid: int
+
+    @staticmethod
+    def of_current_process(global_rank: int, local_rank: int) -> "RankInfo":
+        import os
+        import socket
+
+        return RankInfo(
+            global_rank=global_rank,
+            local_rank=local_rank,
+            host=socket.gethostname(),
+            pid=os.getpid(),
+        )
+
+
+@dataclasses.dataclass
+class HeartbeatTimeouts:
+    """Effective heartbeat timeouts; ``calculated`` marks auto-calibrated values
+    (reference ``data.py:71``)."""
+
+    initial: Optional[float] = None
+    subsequent: Optional[float] = None
+    calculated: bool = False
+
+    @property
+    def are_valid(self) -> bool:
+        return self.initial is not None and self.subsequent is not None
+
+
+@dataclasses.dataclass
+class SectionTimeouts:
+    """Per-section + out-of-section timeouts (reference ``data.py:104``)."""
+
+    section: dict[str, Optional[float]] = dataclasses.field(default_factory=dict)
+    out_of_section: Optional[float] = None
+    calculated_sections: frozenset = frozenset()
+    calculated_out_of_section: bool = False
+
+
+class SectionAction(enum.Enum):
+    OPEN = "open"
+    CLOSE = "close"
+    CLOSE_ALL = "close_all"
+
+
+class WorkloadAction(enum.Enum):
+    """Actions a rank can request from the launcher (reference ``data.py:236``)."""
+
+    Continue = "continue"
+    ExcludeThisNode = "exclude_this_node"
+    ShutdownWorkload = "shutdown_workload"
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadControlRequest:
+    action: WorkloadAction
+    sender: RankInfo
+    reason: str = ""
+
+
+# -- rank ↔ monitor messages ----------------------------------------------
+
+
+@dataclasses.dataclass
+class InitMsg:
+    rank_info: RankInfo
+    # client pushes any previously persisted state (calculated timeouts)
+    client_state: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class InitReplyMsg:
+    config: Any  # effective FaultToleranceConfig
+    hb_timeouts: HeartbeatTimeouts
+    section_timeouts: SectionTimeouts
+
+
+@dataclasses.dataclass
+class HeartbeatMsg:
+    rank: int
+    timestamp: float = dataclasses.field(default_factory=time.monotonic)
+    state: Optional[dict] = None  # optional piggy-backed client state
+
+
+@dataclasses.dataclass
+class SectionMsg:
+    rank: int
+    action: SectionAction
+    name: Optional[str] = None
+    timestamp: float = dataclasses.field(default_factory=time.monotonic)
+
+
+@dataclasses.dataclass
+class UpdateTimeoutsMsg:
+    hb_timeouts: Optional[HeartbeatTimeouts] = None
+    section_timeouts: Optional[SectionTimeouts] = None
+
+
+@dataclasses.dataclass
+class OkMsg:
+    payload: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class ErrorMsg:
+    error: str

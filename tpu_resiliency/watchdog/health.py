@@ -1,0 +1,148 @@
+"""Pluggable host/device health checks run by the rank monitor.
+
+Analogue of the reference's ``shared_utils/health_check.py`` (``GPUHealthCheck:148``,
+``NicHealthCheck:306``). On TPU there is no NVML; the equivalents are:
+
+- :class:`DeviceLivenessCheck` — submits tiny device work under a watchdog thread
+  (must run in a process that owns the TPU; workers use it inside restart health
+  checks, see ``inprocess/health_check``),
+- :class:`SysfsCounterCheck` — watches a sysfs error-counter delta, the generalization
+  of the reference's IB ``link_downed`` monitoring (``health_check.py:527-559``); the
+  path template is injectable so tests fake the counter exactly as the reference does
+  (``health_check.py:325``),
+- :class:`CallbackHealthCheck` — wraps any ``() -> bool``.
+
+All checks expose sync ``__call__() -> bool`` and can be polled periodically by the
+monitor with an ``on_failure`` callback (reference ``async_check`` loop,
+``health_check.py:148-303``).
+"""
+
+from __future__ import annotations
+
+import abc
+import glob
+import threading
+import time
+from typing import Callable, Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class HealthCheck(abc.ABC):
+    @abc.abstractmethod
+    def __call__(self) -> bool:
+        """True = healthy."""
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class CallbackHealthCheck(HealthCheck):
+    def __init__(self, fn: Callable[[], bool], name: str = "callback"):
+        self._fn = fn
+        self._name = name
+
+    def __call__(self) -> bool:
+        try:
+            return bool(self._fn())
+        except Exception:
+            log.exception("health check %s raised", self._name)
+            return False
+
+    def describe(self) -> str:
+        return self._name
+
+
+class DeviceLivenessCheck(HealthCheck):
+    """Tiny compiled add + block_until_ready under a timeout thread
+    (the reference ``CudaHealthCheck`` double-sync analogue,
+    ``inprocess/health_check.py:70-110``)."""
+
+    def __init__(self, timeout: float = 60.0):
+        self.timeout = timeout
+
+    def __call__(self) -> bool:
+        from tpu_resiliency.platform.device import device_liveness_probe
+
+        return device_liveness_probe(timeout=self.timeout)
+
+
+class SysfsCounterCheck(HealthCheck):
+    """Healthy while monitored counters do not increase between polls.
+
+    ``path_glob``: glob of counter files (each containing one integer). The first poll
+    snapshots baselines; any later increase marks unhealthy (sticky until ``reset``).
+    """
+
+    def __init__(self, path_glob: str):
+        self.path_glob = path_glob
+        self._baseline: Optional[dict[str, int]] = None
+        self._tripped = False
+
+    def _read(self) -> dict[str, int]:
+        values = {}
+        for path in sorted(glob.glob(self.path_glob)):
+            try:
+                with open(path) as f:
+                    values[path] = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                continue
+        return values
+
+    def reset(self) -> None:
+        self._baseline = None
+        self._tripped = False
+
+    def __call__(self) -> bool:
+        current = self._read()
+        if self._baseline is None:
+            self._baseline = current
+            return True
+        for path, value in current.items():
+            if value > self._baseline.get(path, value):
+                log.error("sysfs counter increased: %s %d -> %d",
+                          path, self._baseline.get(path, 0), value)
+                self._tripped = True
+        self._baseline.update(current)
+        return not self._tripped
+
+
+class PeriodicHealthMonitor:
+    """Polls a set of checks on an interval in a daemon thread; fires ``on_failure``
+    once per failed check (reference async_check loop)."""
+
+    def __init__(
+        self,
+        checks: list[HealthCheck],
+        interval: float,
+        on_failure: Callable[[HealthCheck], None],
+    ):
+        self.checks = list(checks)
+        self.interval = interval
+        self.on_failure = on_failure
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._failed: set[int] = set()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, name="health-monitor", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            for i, check in enumerate(self.checks):
+                if i in self._failed:
+                    continue
+                if not check():
+                    self._failed.add(i)
+                    try:
+                        self.on_failure(check)
+                    except Exception:
+                        log.exception("health on_failure callback failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)

@@ -1,0 +1,189 @@
+"""In-rank client API for workload monitoring.
+
+Analogue of the reference's ``RankMonitorClient`` (``fault_tolerance/rank_monitor_client.py``):
+``init_workload_monitoring`` connects to the per-rank monitor socket and receives the
+effective config (``:281-321``); ``send_heartbeat`` (``:333``) and
+``start_section``/``end_section``/``end_all_sections`` (``:339-367``) are the per-step
+signals; ``calculate_and_set_*_timeouts`` auto-calibrate from observed behavior
+(``:144-219``); ``state_dict``/``load_state_dict`` persist calculated timeouts across
+restarts (``:369-423``); ``send_workload_control_request`` messages the launcher
+(``:425``).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from tpu_resiliency.exceptions import FaultToleranceError
+from tpu_resiliency.platform import ipc
+from tpu_resiliency.utils.logging import RankLoggerAdapter, get_logger
+from tpu_resiliency.watchdog.data import (
+    ErrorMsg,
+    HeartbeatMsg,
+    HeartbeatTimeouts,
+    InitMsg,
+    InitReplyMsg,
+    OkMsg,
+    RankInfo,
+    SectionAction,
+    SectionMsg,
+    SectionTimeouts,
+    UpdateTimeoutsMsg,
+    WorkloadAction,
+    WorkloadControlRequest,
+)
+from tpu_resiliency.watchdog.timeouts import TimeoutsCalc
+
+log = get_logger(__name__)
+
+
+class RankMonitorClient:
+    def __init__(self):
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+        self.rank_info: Optional[RankInfo] = None
+        self.cfg = None
+        self.hb_timeouts: Optional[HeartbeatTimeouts] = None
+        self.section_timeouts: Optional[SectionTimeouts] = None
+        self.timeouts_calc: Optional[TimeoutsCalc] = None
+        self._loaded_state: Optional[dict] = None
+        self.log = RankLoggerAdapter(log, role="client")
+
+    @property
+    def is_initialized(self) -> bool:
+        return self._sock is not None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def init_workload_monitoring(
+        self,
+        socket_path: Optional[str] = None,
+        rank_info: Optional[RankInfo] = None,
+    ) -> None:
+        if self.is_initialized:
+            raise FaultToleranceError("workload monitoring already initialized")
+        socket_path = socket_path or os.environ.get(ipc.MONITOR_SOCKET_ENV)
+        if not socket_path:
+            raise FaultToleranceError(
+                f"no monitor socket: pass socket_path or set ${ipc.MONITOR_SOCKET_ENV}"
+            )
+        if rank_info is None:
+            rank_info = RankInfo.of_current_process(
+                global_rank=int(os.environ.get("RANK", "0")),
+                local_rank=int(os.environ.get("LOCAL_RANK", "0")),
+            )
+        self.rank_info = rank_info
+        self.log.rank = rank_info.global_rank
+        self._sock = ipc.connect(socket_path)
+        reply = self._request(InitMsg(rank_info=rank_info, client_state=self._loaded_state))
+        if not isinstance(reply, InitReplyMsg):
+            raise FaultToleranceError(f"bad init reply: {reply!r}")
+        self.cfg = reply.config
+        self.hb_timeouts = reply.hb_timeouts
+        self.section_timeouts = reply.section_timeouts
+        self.timeouts_calc = TimeoutsCalc(safety_factor=self.cfg.safety_factor)
+        self.timeouts_calc.reset()
+        self.log.info(f"workload monitoring initialized via {socket_path}")
+
+    def shutdown_workload_monitoring(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def _request(self, msg):
+        with self._lock:
+            if self._sock is None:
+                raise FaultToleranceError("monitor client is not initialized")
+            ipc.write_object(self._sock, msg)
+            reply = ipc.read_object(self._sock)
+        if isinstance(reply, ErrorMsg):
+            raise FaultToleranceError(f"monitor error: {reply.error}")
+        return reply
+
+    # -- per-step signals --------------------------------------------------
+
+    def send_heartbeat(self) -> None:
+        self._request(HeartbeatMsg(rank=self.rank_info.global_rank))
+        self.timeouts_calc.update_on_heartbeat()
+
+    def start_section(self, name: str) -> None:
+        self._request(
+            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.OPEN, name=name)
+        )
+        self.timeouts_calc.update_on_section_open(name)
+
+    def end_section(self, name: str) -> None:
+        self._request(
+            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.CLOSE, name=name)
+        )
+        self.timeouts_calc.update_on_section_close(name)
+
+    def end_all_sections(self) -> None:
+        self._request(
+            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.CLOSE_ALL)
+        )
+        for name in list(self.timeouts_calc.section_open_since):
+            self.timeouts_calc.update_on_section_close(name)
+
+    # -- timeout calibration ----------------------------------------------
+
+    def calculate_and_set_hb_timeouts(
+        self, store=None, rank: int = 0, world_size: int = 1
+    ) -> HeartbeatTimeouts:
+        """safety_factor × max observed gaps (cross-rank MAX via store when given),
+        EMA-merged with previous calculated values, pushed to the monitor."""
+        self.timeouts_calc.synchronize_all(store, rank, world_size)
+        new = self.timeouts_calc.get_hb_timeouts(previous=self.hb_timeouts)
+        self.hb_timeouts = new
+        self._request(UpdateTimeoutsMsg(hb_timeouts=new))
+        return new
+
+    def calculate_and_set_section_timeouts(
+        self, store=None, rank: int = 0, world_size: int = 1
+    ) -> SectionTimeouts:
+        self.timeouts_calc.synchronize_all(store, rank, world_size)
+        new = self.timeouts_calc.get_section_timeouts(previous=self.section_timeouts)
+        self.section_timeouts = new
+        self._request(UpdateTimeoutsMsg(section_timeouts=new))
+        return new
+
+    # -- persistence -------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "hb_timeouts": self.hb_timeouts,
+            "section_timeouts": self.section_timeouts,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Apply persisted calculated timeouts; if already connected, push them to the
+        monitor immediately, otherwise they ride the next InitMsg."""
+        self._loaded_state = state
+        if self.is_initialized:
+            hb = state.get("hb_timeouts")
+            st = state.get("section_timeouts")
+            if hb is not None:
+                self.hb_timeouts = hb
+            if st is not None:
+                self.section_timeouts = st
+            self._request(UpdateTimeoutsMsg(hb_timeouts=hb, section_timeouts=st))
+
+    # -- launcher control --------------------------------------------------
+
+    def send_workload_control_request(
+        self, action: WorkloadAction, reason: str = ""
+    ) -> None:
+        """Fire a control request at the launcher's IPC socket
+        (reference ``rank_monitor_client.py:425``)."""
+        path = os.environ.get(ipc.LAUNCHER_SOCKET_ENV)
+        if not path:
+            raise FaultToleranceError(f"${ipc.LAUNCHER_SOCKET_ENV} is not set")
+        ipc.send_to(
+            path, WorkloadControlRequest(action=action, sender=self.rank_info, reason=reason)
+        )

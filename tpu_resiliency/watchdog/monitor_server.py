@@ -1,0 +1,283 @@
+"""Per-rank monitor process: Unix-socket server + periodic timeout/health checks.
+
+Analogue of the reference's ``RankMonitorServer`` (``fault_tolerance/rank_monitor_server.py``):
+one asyncio process per rank, forked by the launcher (``:488-512``); handles
+Init/Heartbeat/Section/UpdateTimeouts messages (``:307-340``); a periodic task checks
+heartbeat timeout (``_is_hb_timeout_elapsed:349``), section / out-of-section timeouts
+(``:369``) and optional health checks (``:411-414``); on violation it sends SIGCONT +
+the configured termination signal to the rank PID (``_shutdown_rank:176``) so the
+launcher's worker poll sees the death and triggers an in-job restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import multiprocessing as mp
+import os
+import signal
+import time
+from typing import Optional
+
+from tpu_resiliency.platform import framing
+from tpu_resiliency.utils.logging import RankLoggerAdapter, get_logger
+from tpu_resiliency.watchdog.config import FaultToleranceConfig
+from tpu_resiliency.watchdog.data import (
+    ErrorMsg,
+    HeartbeatMsg,
+    HeartbeatTimeouts,
+    InitMsg,
+    InitReplyMsg,
+    OkMsg,
+    RankInfo,
+    SectionAction,
+    SectionMsg,
+    SectionTimeouts,
+    UpdateTimeoutsMsg,
+)
+from tpu_resiliency.watchdog.health import HealthCheck, PeriodicHealthMonitor
+from tpu_resiliency.watchdog.state_machine import RestarterStateMachine, RestarterState
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class _RankSession:
+    info: RankInfo
+    connected_at: float
+    last_hb: Optional[float] = None
+    open_sections: dict = dataclasses.field(default_factory=dict)  # name -> open ts
+    last_section_activity: Optional[float] = None
+    terminated: bool = False
+
+
+class RankMonitorServer:
+    def __init__(
+        self,
+        cfg: FaultToleranceConfig,
+        socket_path: str,
+        health_checks: Optional[list[HealthCheck]] = None,
+    ):
+        self.cfg = cfg
+        self.socket_path = socket_path
+        self.session: Optional[_RankSession] = None
+        self.hb_timeouts = HeartbeatTimeouts(
+            initial=cfg.initial_rank_heartbeat_timeout,
+            subsequent=cfg.rank_heartbeat_timeout,
+            calculated=False,
+        )
+        self.section_timeouts = SectionTimeouts(
+            section=dict(cfg.rank_section_timeouts),
+            out_of_section=cfg.rank_out_of_section_timeout,
+        )
+        self.health_checks = health_checks or []
+        self._health_monitor: Optional[PeriodicHealthMonitor] = None
+        self._health_failure: Optional[str] = None
+        self.restarter = RestarterStateMachine("InJob", strict=False)
+        self.log = RankLoggerAdapter(log, role="monitor")
+        self._stop_event: Optional[asyncio.Event] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def serve(self) -> None:
+        self._stop_event = asyncio.Event()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        os.makedirs(os.path.dirname(self.socket_path) or ".", exist_ok=True)
+        server = await asyncio.start_unix_server(self._handle_conn, path=self.socket_path)
+        self.restarter.initialize()
+        if self.health_checks and self.cfg.enable_health_checks:
+            self._health_monitor = PeriodicHealthMonitor(
+                self.health_checks,
+                self.cfg.health_check_interval,
+                self._on_health_failure,
+            )
+            self._health_monitor.start()
+        checker = asyncio.create_task(self._periodic_check())
+        try:
+            async with server:
+                await self._stop_event.wait()
+        finally:
+            checker.cancel()
+            if self._health_monitor:
+                self._health_monitor.stop()
+            if os.path.exists(self.socket_path):
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
+
+    def run(self) -> None:
+        asyncio.run(self.serve())
+
+    @classmethod
+    def run_in_subprocess(
+        cls,
+        cfg: FaultToleranceConfig,
+        socket_path: str,
+        health_checks: Optional[list[HealthCheck]] = None,
+        start_method: str = "fork",
+    ) -> mp.Process:
+        """Fork a monitor process (reference ``rank_monitor_server.py:488-512``).
+
+        Waits until the server socket exists so the worker can connect immediately.
+        """
+        ctx = mp.get_context(start_method)
+        proc = ctx.Process(
+            target=_monitor_main, args=(cfg, socket_path, health_checks), daemon=True
+        )
+        proc.start()
+        deadline = time.monotonic() + 30.0
+        while not os.path.exists(socket_path):
+            if time.monotonic() > deadline or not proc.is_alive():
+                raise RuntimeError(f"rank monitor failed to start on {socket_path}")
+            time.sleep(0.01)
+        return proc
+
+    # -- connection handling ----------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                try:
+                    msg = await framing.read_obj_stream(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                reply = self._dispatch(msg)
+                await framing.write_obj_stream(writer, reply)
+        finally:
+            if self.session is not None:
+                self.log.info(
+                    f"rank {self.session.info.global_rank} disconnected from monitor"
+                )
+            writer.close()
+
+    def _dispatch(self, msg):
+        try:
+            if isinstance(msg, InitMsg):
+                return self._on_init(msg)
+            if isinstance(msg, HeartbeatMsg):
+                return self._on_heartbeat(msg)
+            if isinstance(msg, SectionMsg):
+                return self._on_section(msg)
+            if isinstance(msg, UpdateTimeoutsMsg):
+                return self._on_update_timeouts(msg)
+            return ErrorMsg(f"unknown message {type(msg).__name__}")
+        except Exception as e:
+            self.log.exception("monitor dispatch failed")
+            return ErrorMsg(repr(e))
+
+    def _on_init(self, msg: InitMsg):
+        self.session = _RankSession(info=msg.rank_info, connected_at=time.monotonic())
+        if msg.client_state:
+            hb = msg.client_state.get("hb_timeouts")
+            if hb is not None:
+                self.hb_timeouts = hb
+            st = msg.client_state.get("section_timeouts")
+            if st is not None:
+                self.section_timeouts = st
+        self.log.rank = msg.rank_info.global_rank
+        self.log.info(f"monitoring rank {msg.rank_info.global_rank} pid {msg.rank_info.pid}")
+        return InitReplyMsg(
+            config=self.cfg,
+            hb_timeouts=self.hb_timeouts,
+            section_timeouts=self.section_timeouts,
+        )
+
+    def _on_heartbeat(self, msg: HeartbeatMsg):
+        if self.session is None:
+            return ErrorMsg("heartbeat before init")
+        self.session.last_hb = time.monotonic()
+        return OkMsg()
+
+    def _on_section(self, msg: SectionMsg):
+        if self.session is None:
+            return ErrorMsg("section message before init")
+        now = time.monotonic()
+        s = self.session
+        if msg.action is SectionAction.OPEN:
+            if msg.name in s.open_sections:
+                return ErrorMsg(f"section {msg.name!r} already open")
+            s.open_sections[msg.name] = now
+        elif msg.action is SectionAction.CLOSE:
+            if msg.name not in s.open_sections:
+                return ErrorMsg(f"section {msg.name!r} not open")
+            del s.open_sections[msg.name]
+        elif msg.action is SectionAction.CLOSE_ALL:
+            s.open_sections.clear()
+        s.last_section_activity = now
+        return OkMsg()
+
+    def _on_update_timeouts(self, msg: UpdateTimeoutsMsg):
+        if msg.hb_timeouts is not None:
+            self.hb_timeouts = msg.hb_timeouts
+        if msg.section_timeouts is not None:
+            self.section_timeouts = msg.section_timeouts
+        self.log.info(
+            f"timeouts updated: hb={self.hb_timeouts} sections={self.section_timeouts}"
+        )
+        return OkMsg()
+
+    # -- periodic checks ---------------------------------------------------
+
+    def _hb_timeout_elapsed(self, now: float) -> Optional[str]:
+        s = self.session
+        if s.last_hb is None:
+            t = self.hb_timeouts.initial
+            if t is not None and now - s.connected_at > t:
+                return f"no initial heartbeat within {t:.1f}s"
+        else:
+            t = self.hb_timeouts.subsequent
+            if t is not None and now - s.last_hb > t:
+                return f"heartbeat gap exceeded {t:.1f}s"
+        return None
+
+    def _section_timeout_elapsed(self, now: float) -> Optional[str]:
+        s = self.session
+        for name, opened in s.open_sections.items():
+            t = self.section_timeouts.section.get(name)
+            if t is not None and now - opened > t:
+                return f"section {name!r} open for more than {t:.1f}s"
+        t = self.section_timeouts.out_of_section
+        if t is not None and not s.open_sections and s.last_section_activity is not None:
+            if now - s.last_section_activity > t:
+                return f"out-of-section for more than {t:.1f}s"
+        return None
+
+    async def _periodic_check(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.workload_check_interval)
+            if self.session is None or self.session.terminated:
+                continue
+            now = time.monotonic()
+            reason = self._hb_timeout_elapsed(now) or self._section_timeout_elapsed(now)
+            if reason is None and self._health_failure is not None:
+                reason = f"health check failed: {self._health_failure}"
+            if reason is not None:
+                self._terminate_rank(reason)
+
+    def _on_health_failure(self, check: HealthCheck) -> None:
+        self._health_failure = check.describe()
+
+    def _terminate_rank(self, reason: str) -> None:
+        s = self.session
+        s.terminated = True
+        self.restarter.handling_start(f"reason={reason!r}")
+        self.log.error(f"terminating rank {s.info.global_rank} (pid {s.info.pid}): {reason}")
+        self.restarter.handling_processing()
+        try:
+            os.kill(s.info.pid, signal.SIGCONT)  # wake a stopped process first
+            os.kill(s.info.pid, self.cfg.rank_termination_signal)
+        except ProcessLookupError:
+            self.log.info("rank process already gone")
+        self.restarter.handling_completed()
+
+    def request_stop(self) -> None:
+        if self._stop_event is not None:
+            self._stop_event.set()
+
+
+def _monitor_main(cfg, socket_path, health_checks) -> None:
+    # A forked monitor must never touch the parent's TPU runtime.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    RankMonitorServer(cfg, socket_path, health_checks).run()
