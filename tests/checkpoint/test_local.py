@@ -1,0 +1,288 @@
+"""Multi-rank local checkpointing: comm, replication cliques, manager coverage.
+
+Simulated multi-rank pattern per SURVEY §4: N "ranks" as threads, each with its own
+store client + peer exchange against one KVServer — the JAX-host analogue of the
+reference's Gloo-on-CPU multi-process fixtures.
+"""
+
+import concurrent.futures as cf
+import pickle
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
+from tpu_resiliency.checkpoint.local_manager import CkptID, LocalCheckpointManager
+from tpu_resiliency.checkpoint.replication import (
+    CliqueReplicationStrategy,
+    ExchangePlan,
+    parse_group_sequence,
+)
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.platform.store import CoordStore
+
+
+def run_ranks(world, fn, timeout=60.0):
+    """Run fn(rank) on `world` threads; raise the first failure."""
+    with cf.ThreadPoolExecutor(max_workers=world) as pool:
+        futures = [pool.submit(fn, r) for r in range(world)]
+        return [f.result(timeout=timeout) for f in futures]
+
+
+@pytest.fixture
+def make_store(kv_server):
+    stores = []
+
+    def factory():
+        s = CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+        stores.append(s)
+        return s
+
+    yield factory
+    for s in stores:
+        s.close()
+
+
+class TestParseGroupSequence:
+    def test_adjacent(self):
+        assert parse_group_sequence(1, 2, 4) == [[0, 1], [2, 3]]
+
+    def test_jump_spans_hosts(self):
+        # jump=2 (ranks per host), factor=2, world=8: mirrors on different hosts.
+        assert parse_group_sequence(2, 2, 8) == [[0, 2], [1, 3], [4, 6], [5, 7]]
+
+    def test_factor_one_identity(self):
+        assert parse_group_sequence(1, 1, 3) == [[0], [1], [2]]
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            parse_group_sequence(2, 2, 6)
+
+
+class TestExchangePlan:
+    def test_balanced_holder_choice(self):
+        # Ranks 0,1 lost their shards; both 2 and 3 hold both shards.
+        plan = ExchangePlan.build(
+            wanted={0: 0, 1: 1}, holders={2: {0, 1}, 3: {0, 1}}
+        )
+        senders = sorted(src for src in plan.sends)
+        assert senders == [2, 3]  # load-balanced, not both from rank 2
+
+    def test_no_holder_raises(self):
+        with pytest.raises(CheckpointError):
+            ExchangePlan.build(wanted={0: 0}, holders={1: {5}})
+
+
+class TestStoreComm:
+    def test_all_gather_ordered(self, make_store):
+        world = 4
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            return comm.all_gather(rank * 10)
+
+        for result in run_ranks(world, body):
+            assert result == [0, 10, 20, 30]
+
+    def test_broadcast(self, make_store):
+        world = 3
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            return comm.broadcast({"cfg": 1} if rank == 1 else None, src=1)
+
+        assert run_ranks(world, body) == [{"cfg": 1}] * world
+
+    def test_all_reduce_and(self, make_store):
+        world = 3
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            return comm.all_reduce_and(rank != 1)
+
+        assert run_ranks(world, body) == [False] * world
+
+    def test_rounds_do_not_collide(self, make_store):
+        world = 2
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            out = [comm.all_gather(f"{rank}-{i}") for i in range(3)]
+            return out
+
+        for result in run_ranks(world, body):
+            assert result == [["0-0", "1-0"], ["0-1", "1-1"], ["0-2", "1-2"]]
+
+
+class TestPeerExchange:
+    def test_send_recv(self, make_store):
+        world = 2
+
+        def body(rank):
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                ex.send(1 - rank, "t", f"hello-{rank}".encode())
+                return ex.recv(1 - rank, "t").decode()
+            finally:
+                ex.close()
+
+        assert run_ranks(world, body) == ["hello-1", "hello-0"]
+
+    def test_tag_isolation(self, make_store):
+        world = 2
+
+        def body(rank):
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                if rank == 0:
+                    ex.send(1, "b", b"B")
+                    ex.send(1, "a", b"A")
+                    return None
+                return (ex.recv(0, "a"), ex.recv(0, "b"))
+            finally:
+                ex.close()
+
+        assert run_ranks(world, body)[1] == (b"A", b"B")
+
+
+class TestCliqueReplication:
+    def test_replicate_within_clique(self, make_store):
+        world = 4
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                held = strat.replicate(f"shard-{rank}".encode())
+                return {owner: blob.decode() for owner, blob in held.items()}
+            finally:
+                ex.close()
+
+        results = run_ranks(world, body)
+        assert results[0] == {0: "shard-0", 1: "shard-1"}
+        assert results[3] == {2: "shard-2", 3: "shard-3"}
+
+
+def _tree(rank):
+    return {"w": np.full((4,), float(rank), dtype=np.float32), "step": rank}
+
+
+class TestLocalCheckpointManager:
+    def test_single_rank_roundtrip(self, tmp_path):
+        mgr = LocalCheckpointManager(str(tmp_path), rank=0)
+        sd = PyTreeStateDict(_tree(0))
+        mgr.save(10, sd, is_async=True)
+        mgr.maybe_finalize(blocking=True)
+        assert mgr.find_latest() == 10
+        hollow, tensors, meta = mgr.load(10)
+        assert meta["iteration"] == 10
+        restored = PyTreeStateDict.__new__(PyTreeStateDict)
+        restored._tree, restored._hollow, restored._tensors = hollow, True, None
+        restored._shardings = None
+        restored.insert_tensors(tensors)
+        np.testing.assert_array_equal(np.asarray(restored.tree["w"]), np.zeros(4))
+        mgr.close()
+
+    def test_prunes_old_iterations(self, tmp_path):
+        mgr = LocalCheckpointManager(str(tmp_path), rank=0)
+        mgr.save(1, PyTreeStateDict(_tree(0)), is_async=False)
+        mgr.save(2, PyTreeStateDict(_tree(0)), is_async=False)
+        assert {i.iteration for i in mgr.local_ids()} == {2}
+        mgr.close()
+
+    def test_dirty_files_cleaned_on_init(self, tmp_path):
+        mgr = LocalCheckpointManager(str(tmp_path), rank=0)
+        mgr.save(1, PyTreeStateDict(_tree(0)), is_async=False)
+        dirty = mgr._path(CkptID(9, 0)) + ckpt_format.DIRTY_SUFFIX
+        with open(dirty, "wb") as f:
+            f.write(b"junk")
+        mgr.close()
+        mgr2 = LocalCheckpointManager(str(tmp_path), rank=0)
+        import os
+
+        assert not os.path.exists(dirty)
+        assert mgr2.find_latest() == 1
+        mgr2.close()
+
+    def test_distributed_save_load_with_replication(self, tmp_path, make_store):
+        world = 4
+
+        def body(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    str(tmp_path), rank=rank, comm=comm, replication=strat
+                )
+                mgr.save(5, PyTreeStateDict(_tree(rank)), is_async=True)
+                mgr.maybe_finalize(blocking=True)
+                latest = mgr.find_latest()
+                hollow, tensors, meta = mgr.load(latest)
+                mgr.close()
+                return latest, float(tensors[0][0])
+            finally:
+                ex.close()
+
+        results = run_ranks(world, body, timeout=120.0)
+        assert all(latest == 5 for latest, _ in results)
+        assert [v for _, v in results] == [0.0, 1.0, 2.0, 3.0]
+
+    def test_lost_rank_recovers_from_mirror(self, tmp_path, make_store):
+        """Rank 1's storage is wiped after save; load must route from its clique peer."""
+        world = 2
+
+        def save_phase(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    str(tmp_path), rank=rank, comm=comm, replication=strat
+                )
+                mgr.save(3, PyTreeStateDict(_tree(rank)), is_async=False)
+                mgr.close()
+            finally:
+                ex.close()
+
+        run_ranks(world, save_phase)
+
+        # Simulate rank 1 landing on a fresh host: wipe its directory.
+        import shutil, os
+
+        shutil.rmtree(os.path.join(str(tmp_path), "s0", "r1"))
+
+        def load_phase(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    str(tmp_path), rank=rank, comm=comm, replication=strat
+                )
+                latest = mgr.find_latest()
+                hollow, tensors, meta = mgr.load(latest)
+                mgr.close()
+                return latest, float(tensors[0][0])
+            finally:
+                ex.close()
+
+        results = run_ranks(world, load_phase, timeout=120.0)
+        assert results == [(3, 0.0), (3, 1.0)]

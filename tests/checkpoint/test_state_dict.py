@@ -1,0 +1,224 @@
+"""Tensor-aware state dict, container format, and whole-tree async checkpointer.
+
+Models the reference's checkpointing unit tests (``tests/checkpointing/unit/``): tmp-dir
+round-trips, async save + finalize, structure checks — no hardware assumptions.
+"""
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer
+from tpu_resiliency.checkpoint.async_core import (
+    AsyncCallsQueue,
+    AsyncRequest,
+    ThreadAsyncCaller,
+)
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict, TensorPlaceholder
+from tpu_resiliency.exceptions import CheckpointError
+
+
+def make_tree():
+    return {
+        "params": {"w": jnp.arange(12, dtype=jnp.float32).reshape(3, 4), "b": jnp.ones(4)},
+        "step": 7,
+        "opt": [jnp.zeros((2, 2)), {"m": jnp.full((3,), 2.5)}],
+        "name": "flagship",
+    }
+
+
+class TestPyTreeStateDict:
+    def test_pop_insert_roundtrip(self):
+        tree = make_tree()
+        sd = PyTreeStateDict(tree)
+        tensors = sd.pop_tensors()
+        assert sd.is_hollow
+        assert len(tensors) == 4
+        # Hollow skeleton is picklable and contains placeholders.
+        blob = pickle.dumps(sd.hollow_tree)
+        hollow = pickle.loads(blob)
+        leaves = jax.tree_util.tree_leaves(
+            hollow, is_leaf=lambda x: isinstance(x, TensorPlaceholder)
+        )
+        assert sum(isinstance(leaf, TensorPlaceholder) for leaf in leaves) == 4
+        sd.insert_tensors(tensors)
+        assert not sd.is_hollow
+        restored = sd.tree
+        np.testing.assert_array_equal(restored["params"]["w"], tree["params"]["w"])
+        assert restored["step"] == 7 and restored["name"] == "flagship"
+
+    def test_host_copy_and_device_restore(self):
+        sd = PyTreeStateDict(make_tree())
+        sd.pop_tensors()
+        sd.copy_tensors_to_host()
+        assert all(isinstance(t, np.ndarray) for t in sd.tensors())
+        sd.restore_tensor_device()
+        assert all(isinstance(t, jax.Array) for t in sd.tensors())
+        sd.insert_tensors(sd.tensors())
+        np.testing.assert_array_equal(
+            np.asarray(sd.tree["params"]["b"]), np.ones(4, dtype=np.float32)
+        )
+
+    def test_double_pop_raises(self):
+        sd = PyTreeStateDict(make_tree())
+        sd.pop_tensors()
+        with pytest.raises(CheckpointError):
+            sd.pop_tensors()
+
+    def test_insert_wrong_count(self):
+        sd = PyTreeStateDict(make_tree())
+        sd.pop_tensors()
+        with pytest.raises(CheckpointError):
+            sd.insert_tensors([np.zeros(1)])
+
+    def test_non_array_leaves_preserved(self):
+        sd = PyTreeStateDict({"a": 1, "b": "x", "c": None})
+        assert sd.pop_tensors() == []
+        sd.insert_tensors([])
+        assert sd.tree == {"a": 1, "b": "x", "c": None}
+
+
+class TestContainerFormat:
+    def test_roundtrip(self, tmp_path):
+        path = str(tmp_path / "c.ckpt")
+        arrays = [np.arange(6, dtype=np.float64).reshape(2, 3), np.ones(3, np.int32)]
+        ckpt_format.write_payload(path, b"hollow", arrays, meta={"it": 3})
+        hollow, tensors, meta = ckpt_format.read_payload(path)
+        assert hollow == b"hollow" and meta == {"it": 3}
+        np.testing.assert_array_equal(tensors[0], arrays[0])
+        np.testing.assert_array_equal(tensors[1], arrays[1])
+        assert not os.path.exists(path + ckpt_format.DIRTY_SUFFIX)
+
+    def test_bytes_roundtrip(self):
+        arrays = [np.random.default_rng(0).standard_normal((4, 4)).astype(np.float32)]
+        blob = ckpt_format.serialize_to_bytes(b"h", arrays, meta={"k": 1})
+        hollow, tensors, meta = ckpt_format.deserialize_from_bytes(blob)
+        assert hollow == b"h" and meta == {"k": 1}
+        np.testing.assert_array_equal(tensors[0], arrays[0])
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "bad.ckpt")
+        with open(path, "wb") as f:
+            f.write(b"NOTMAGIC" + b"\0" * 32)
+        with pytest.raises(CheckpointError):
+            ckpt_format.read_payload(path)
+
+    def test_bfloat16_roundtrip(self, tmp_path):
+        path = str(tmp_path / "bf16.ckpt")
+        arr = jnp.astype(jnp.arange(8), jnp.bfloat16)
+        ckpt_format.write_payload(path, b"", [np.asarray(arr)])
+        _, tensors, _ = ckpt_format.read_payload(path)
+        np.testing.assert_array_equal(
+            np.asarray(tensors[0], np.float32), np.arange(8, dtype=np.float32)
+        )
+
+
+class TestAsyncCore:
+    def test_thread_caller_runs(self, tmp_path):
+        marker = tmp_path / "done"
+        caller = ThreadAsyncCaller()
+        caller.schedule(AsyncRequest(async_fn=lambda: marker.write_text("ok")))
+        assert caller.wait(10.0)
+        caller.raise_if_failed()
+        assert marker.read_text() == "ok"
+
+    def test_thread_caller_error_surfaces(self):
+        caller = ThreadAsyncCaller()
+
+        def boom():
+            raise RuntimeError("disk full")
+
+        caller.schedule(AsyncRequest(async_fn=boom))
+        caller.wait(10.0)
+        with pytest.raises(CheckpointError, match="disk full"):
+            caller.raise_if_failed()
+
+    def test_queue_fifo_finalize(self):
+        order = []
+        q = AsyncCallsQueue(caller="thread")
+        for i in range(3):
+            q.schedule_async_request(
+                AsyncRequest(
+                    async_fn=lambda: None,
+                    finalize_fns=(lambda i=i: order.append(i),),
+                )
+            )
+            q.maybe_finalize_async_calls(blocking=True)
+        assert order == [0, 1, 2]
+        assert q.num_unfinalized_calls == 0
+        q.close()
+
+    def test_failed_save_never_finalizes(self):
+        """Regression: a failed save must be dequeued when its error is raised —
+        a later poll must not run its finalize_fns as if it succeeded."""
+        q = AsyncCallsQueue(caller="thread")
+        finalized = []
+
+        def boom():
+            raise RuntimeError("disk full")
+
+        q.schedule_async_request(
+            AsyncRequest(async_fn=boom, finalize_fns=(lambda: finalized.append(1),))
+        )
+        with pytest.raises(CheckpointError):
+            q.maybe_finalize_async_calls(blocking=True)
+        assert q.maybe_finalize_async_calls(blocking=True) == []
+        assert finalized == [] and q.num_unfinalized_calls == 0
+        q.close()
+
+    def test_preload_runs_synchronously(self):
+        events = []
+        q = AsyncCallsQueue(caller="thread")
+        q.schedule_async_request(
+            AsyncRequest(
+                async_fn=lambda: events.append("async"),
+                preload_fn=lambda: events.append("preload"),
+            )
+        )
+        assert events[0] == "preload"
+        q.finalize_all()
+        q.close()
+
+
+class TestAsyncCheckpointer:
+    def test_async_save_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "model.ckpt")
+        tree = make_tree()
+        ckpt = AsyncCheckpointer()
+        ckpt.async_save(tree, path, meta={"iteration": 11})
+        ckpt.finalize_all()
+        loaded, meta = AsyncCheckpointer.load(path)
+        assert meta["iteration"] == 11
+        np.testing.assert_array_equal(
+            np.asarray(loaded["params"]["w"]), np.asarray(tree["params"]["w"])
+        )
+        assert loaded["step"] == 7
+        assert isinstance(loaded["params"]["w"], jax.Array)
+        ckpt.close()
+
+    def test_changed_scalar_leaves_are_persisted(self, tmp_path):
+        """Same treedef, different non-array leaf values: both must round-trip
+        (regression: a structure-keyed hollow cache wrote stale step counters)."""
+        ckpt = AsyncCheckpointer()
+        tree = make_tree()
+        ckpt.async_save(tree, str(tmp_path / "a.ckpt"))
+        ckpt.finalize_all()
+        tree2 = dict(tree, step=9999)
+        ckpt.async_save(tree2, str(tmp_path / "b.ckpt"))
+        ckpt.finalize_all()
+        assert AsyncCheckpointer.load(str(tmp_path / "a.ckpt"))[0]["step"] == 7
+        assert AsyncCheckpointer.load(str(tmp_path / "b.ckpt"))[0]["step"] == 9999
+        ckpt.close()
+
+    def test_per_rank_paths(self, tmp_path):
+        ckpt = AsyncCheckpointer()
+        ckpt.save({"x": jnp.ones(2)}, str(tmp_path / "s.ckpt"), rank=3)
+        assert os.path.exists(tmp_path / "s.r3.ckpt")
+        tree, _ = AsyncCheckpointer.load(str(tmp_path / "s.ckpt"), rank=3)
+        np.testing.assert_array_equal(np.asarray(tree["x"]), np.ones(2, np.float32))
+        ckpt.close()

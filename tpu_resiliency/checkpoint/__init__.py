@@ -1,1 +1,51 @@
+"""Checkpointing: async saves, local (node-storage) checkpoints, clique replication.
 
+TPU-native re-design of the reference's ``checkpointing/`` package (SURVEY §2.6):
+
+- :mod:`~tpu_resiliency.checkpoint.state_dict` — pytree hollow/payload split
+  (``TensorAwareStateDict`` contract).
+- :mod:`~tpu_resiliency.checkpoint.format` — atomic single-file container.
+- :mod:`~tpu_resiliency.checkpoint.async_core` — ``AsyncRequest`` / callers /
+  ``AsyncCallsQueue`` with distributed finalization.
+- :mod:`~tpu_resiliency.checkpoint.async_ckpt` — whole-pytree async checkpointer.
+- :mod:`~tpu_resiliency.checkpoint.comm` — store-backed object collectives + p2p
+  bulk links.
+- :mod:`~tpu_resiliency.checkpoint.replication` — clique replication + exchange plans.
+- :mod:`~tpu_resiliency.checkpoint.local_manager` — per-rank local checkpoint manager
+  with coverage-based ``find_latest``.
+"""
+
+from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer
+from tpu_resiliency.checkpoint.async_core import (
+    AsyncCallsQueue,
+    AsyncRequest,
+    ForkAsyncCaller,
+    ProcessAsyncCaller,
+    ThreadAsyncCaller,
+)
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
+from tpu_resiliency.checkpoint.local_manager import CkptID, LocalCheckpointManager
+from tpu_resiliency.checkpoint.replication import (
+    CliqueReplicationStrategy,
+    ExchangePlan,
+    parse_group_sequence,
+)
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict, TensorPlaceholder
+
+__all__ = [
+    "AsyncCheckpointer",
+    "AsyncCallsQueue",
+    "AsyncRequest",
+    "ThreadAsyncCaller",
+    "ProcessAsyncCaller",
+    "ForkAsyncCaller",
+    "StoreComm",
+    "PeerExchange",
+    "CkptID",
+    "LocalCheckpointManager",
+    "CliqueReplicationStrategy",
+    "ExchangePlan",
+    "parse_group_sequence",
+    "PyTreeStateDict",
+    "TensorPlaceholder",
+]

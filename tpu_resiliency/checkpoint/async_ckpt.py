@@ -1,0 +1,112 @@
+"""Whole-pytree async checkpointing to durable storage.
+
+The reference wraps ``torch.save`` in pinned-memory preload + an ``AsyncRequest``
+(``checkpointing/async_ckpt/torch_ckpt.py:31-76``) and splits torch-DCP's save into a
+foreground plan/metadata phase and a background write phase with plan caching
+(``state_dict_saver.py:53-231``). The TPU-native equivalent below:
+
+- Foreground (fast): split the pytree (``PyTreeStateDict``), one batched D2H.
+- Background: stream the container file (``checkpoint/format.py``) to the target dir.
+- The reference's ``CheckpointMetadataCache`` exists to skip *collectives* (plan +
+  metadata exchange). This design has no per-save collectives to skip — the hollow
+  skeleton is pickled fresh each save (it is KBs and may contain changing non-array
+  leaves like step counters, so caching it would write stale values).
+
+Sharded arrays: each rank saves its own addressable shards; ``rank`` lands in the
+filename, and load reassembles per-rank files. (Full global-array gather/scatter is the
+job of orbax-style global checkpointing; local resiliency needs the per-rank form.)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def _write_container(path: str, hollow_bytes: bytes, tensors, meta: dict) -> None:
+    ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+
+
+class AsyncCheckpointer:
+    """Asynchronous whole-tree save/load with structure caching.
+
+    ``async_save`` returns immediately after D2H; call ``maybe_finalize()`` from the
+    train loop (the reference's ``maybe_finalize_async_calls``, ``core.py:541``) or
+    ``finalize_all()`` before exit.
+    """
+
+    def __init__(self, caller: str = "thread", sync_fn=None):
+        self.queue = AsyncCallsQueue(caller=caller, sync_fn=sync_fn)
+
+    @staticmethod
+    def _hollow_bytes(sd: PyTreeStateDict) -> bytes:
+        # Always pickled fresh: the skeleton carries non-array leaves (step counters,
+        # schedules) whose values change between saves with an identical treedef.
+        return pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def async_save(
+        self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None
+    ) -> AsyncRequest:
+        sd = PyTreeStateDict(tree)
+        sd.pop_tensors()
+        sd.copy_tensors_to_host()
+        hollow_bytes = self._hollow_bytes(sd)
+        target = self._rank_path(path, rank)
+        req = AsyncRequest(
+            async_fn=_write_container,
+            async_fn_args=(target, hollow_bytes, sd.tensors(), meta or {}),
+        )
+        self.queue.schedule_async_request(req)
+        return req
+
+    def save(self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None) -> None:
+        sd = PyTreeStateDict(tree)
+        sd.pop_tensors()
+        sd.copy_tensors_to_host()
+        _write_container(
+            self._rank_path(path, rank),
+            pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL),
+            sd.tensors(),
+            meta or {},
+        )
+
+    @staticmethod
+    def _rank_path(path: str, rank: Optional[int]) -> str:
+        if rank is None:
+            return path
+        base, ext = os.path.splitext(path)
+        return f"{base}.r{rank}{ext}"
+
+    @staticmethod
+    def load(path: str, rank: Optional[int] = None, shardings=None, device=None) -> tuple[Any, dict]:
+        """Returns (tree, meta); arrays placed per ``shardings``/``device`` if given."""
+        target = AsyncCheckpointer._rank_path(path, rank)
+        if not os.path.exists(target):
+            raise CheckpointError(f"no checkpoint at {target}")
+        hollow_b, tensors, meta = ckpt_format.read_payload(target)
+        sd = PyTreeStateDict.__new__(PyTreeStateDict)
+        sd._tree = pickle.loads(hollow_b)
+        sd._hollow = True
+        sd._tensors = list(tensors)
+        sd._shardings = None
+        sd.restore_tensor_device(shardings=shardings, device=device)
+        sd.insert_tensors(sd._tensors)
+        return sd.tree, meta
+
+    def maybe_finalize(self, blocking: bool = False) -> list[int]:
+        return self.queue.maybe_finalize_async_calls(blocking=blocking)
+
+    def finalize_all(self) -> list[int]:
+        return self.queue.finalize_all()
+
+    def close(self) -> None:
+        self.queue.close()

@@ -1,0 +1,214 @@
+"""Host-side group communication for checkpoint coordination and replication.
+
+The reference rides ``torch.distributed`` for three distinct things the checkpoint layer
+needs (SURVEY §2.1/§2.6): small-object collectives (``all_gather_object`` for ckpt-ID
+coverage, 1-int all-reduce for async-done agreement), process-group barriers, and
+point-to-point tensor sends for shard retrieval (``group_utils.py:394-465``). On TPU the
+accelerator interconnect is reserved for the training program; checkpoint coordination is
+**host-side control plane**, so both live here, over TCP:
+
+- :class:`StoreComm` — object collectives + barriers on the coordination KV store
+  (``platform/store.py``). Fine for metadata (IDs, plans, flags): bytes to KBs.
+- :class:`PeerExchange` — direct rank↔rank TCP links for tensor payloads (checkpoint
+  shards are MBs–GBs and must not transit the KV server). Each rank listens on an
+  ephemeral port published in the store under ``p2p/{rank}``; frames carry raw array
+  bytes via the checkpoint container encoding (``checkpoint/format.py``).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Optional
+
+from tpu_resiliency.exceptions import CheckpointError, StoreTimeoutError
+from tpu_resiliency.platform import framing
+from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+# Checkpoint shards can be large; allow 16 GB frames on p2p links.
+P2P_MAX_FRAME = 16 * 1024**3
+
+
+class StoreComm:
+    """Object collectives over the coordination store, scoped to a rank group.
+
+    Every member must call each collective the same number of times in the same order
+    (the usual collective contract). Data keys are namespaced by a per-tag round
+    counter and deleted by the leader once every member has read them; barriers use
+    **fixed** names per tag — the server's generation-counted reentrant barriers exist
+    precisely so a steady-state poll loop doesn't mint unbounded server state.
+    """
+
+    def __init__(
+        self,
+        store: StoreView,
+        rank: int,
+        ranks: list[int],
+        timeout: float = 300.0,
+    ):
+        if rank not in ranks:
+            raise ValueError(f"rank {rank} not in group {ranks}")
+        self.store = store.scoped(f"comm/{'-'.join(map(str, sorted(ranks)))}")
+        self.rank = rank
+        self.ranks = sorted(ranks)
+        self.timeout = timeout
+        self._rounds: dict[str, int] = {}
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.rank == self.ranks[0]
+
+    def _round(self, tag: str) -> int:
+        r = self._rounds.get(tag, 0)
+        self._rounds[tag] = r + 1
+        return r
+
+    def barrier(self, tag: str = "barrier", timeout: Optional[float] = None) -> None:
+        self.store.barrier_join(tag, self.rank, self.world_size, timeout or self.timeout)
+
+    def all_gather(self, obj: Any, tag: str = "ag", timeout: Optional[float] = None) -> list:
+        """Returns ``[obj_from_rank]`` ordered by group rank index."""
+        t = timeout or self.timeout
+        r = self._round(tag)
+        base = f"{tag}/{r}"
+        self.store.set(f"{base}/{self.rank}", obj)
+        self.store.barrier_join(f"{tag}/b0", self.rank, self.world_size, t)
+        out = [self.store.get(f"{base}/{peer}", timeout=t) for peer in self.ranks]
+        # Exit barrier so the leader only deletes after everyone has read.
+        self.store.barrier_join(f"{tag}/b1", self.rank, self.world_size, t)
+        if self.is_leader:
+            for peer in self.ranks:
+                self.store.delete(f"{base}/{peer}")
+        return out
+
+    def broadcast(self, obj: Any, src: int, tag: str = "bc", timeout: Optional[float] = None) -> Any:
+        t = timeout or self.timeout
+        r = self._round(tag)
+        base = f"{tag}/{r}"
+        if self.rank == src:
+            self.store.set(f"{base}/v", obj)
+        value = self.store.get(f"{base}/v", timeout=t)
+        self.store.barrier_join(f"{tag}/b", self.rank, self.world_size, t)
+        if self.is_leader:
+            self.store.delete(f"{base}/v")
+        return value
+
+    def all_reduce_and(self, value: bool, tag: str = "and") -> bool:
+        """The reference's 1-int "is everyone done" agreement (``core.py:152-164``)."""
+        return all(self.all_gather(bool(value), tag=tag))
+
+    def all_reduce_max(self, value, tag: str = "max"):
+        return max(self.all_gather(value, tag=tag))
+
+    def make_sync_fn(self):
+        """Adapter for :class:`AsyncCallsQueue`'s ``sync_fn``."""
+
+        def sync_fn(local_done: bool) -> bool:
+            return self.all_reduce_and(local_done, tag="ckpt-done")
+
+        return sync_fn
+
+
+class PeerExchange:
+    """Rank↔rank bulk transfer channel for checkpoint shards.
+
+    ``start()`` binds an ephemeral listener and publishes its address in the store;
+    ``send(dst, tag, blob)`` pushes raw bytes to a peer; ``recv(src, tag)`` blocks for a
+    matching frame. Message matching is (src, tag) so concurrent replication rounds with
+    distinct tags don't cross. Analogue of the reference's isend/irecv shard routing
+    (``checkpointing/local/replication/group_utils.py:394-465``).
+    """
+
+    def __init__(self, store: StoreView, rank: int, timeout: float = 300.0):
+        self.store = store.scoped("p2p")
+        self.rank = rank
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._inbox: dict[tuple[int, str], list[bytes]] = {}
+        self._cond = threading.Condition()
+        self._shutdown = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._addr_cache: dict[int, tuple[str, int]] = {}
+
+    def start(self, host: str = "127.0.0.1", advertise_host: Optional[str] = None) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, 0))
+        self._sock.listen(128)
+        port = self._sock.getsockname()[1]
+        self.store.set(f"addr/{self.rank}", (advertise_host or host, port))
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"p2p-accept-{self.rank}", daemon=True
+        )
+        self._accept_thread.start()
+
+    def close(self) -> None:
+        self._shutdown.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._cond:
+            self._cond.notify_all()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._recv_conn, args=(conn,), daemon=True, name="p2p-recv"
+            ).start()
+
+    def _recv_conn(self, conn: socket.socket) -> None:
+        try:
+            msg = framing.recv_obj(conn, max_frame=P2P_MAX_FRAME)
+            src, tag, blob = msg["src"], msg["tag"], msg["blob"]
+            with self._cond:
+                self._inbox.setdefault((src, tag), []).append(blob)
+                self._cond.notify_all()
+        except (ConnectionError, EOFError, OSError, KeyError, TypeError, ValueError):
+            log.warning("p2p: dropped malformed incoming frame", exc_info=True)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _peer_addr(self, peer: int) -> tuple[str, int]:
+        if peer not in self._addr_cache:
+            try:
+                self._addr_cache[peer] = tuple(
+                    self.store.get(f"addr/{peer}", timeout=self.timeout)
+                )
+            except StoreTimeoutError as e:
+                raise CheckpointError(f"p2p: no address published for rank {peer}") from e
+        return self._addr_cache[peer]
+
+    def send(self, dst: int, tag: str, blob: bytes) -> None:
+        host, port = self._peer_addr(dst)
+        with socket.create_connection((host, port), timeout=self.timeout) as conn:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+
+    def recv(self, src: int, tag: str, timeout: Optional[float] = None) -> bytes:
+        import time as _time
+
+        deadline = _time.monotonic() + (timeout or self.timeout)
+        key = (src, tag)
+        with self._cond:
+            while not self._inbox.get(key):
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise CheckpointError(f"p2p: timed out waiting for {tag!r} from rank {src}")
+                self._cond.wait(timeout=min(remaining, 1.0))
+            return self._inbox[key].pop(0)

@@ -1,0 +1,260 @@
+"""Local (node-storage) checkpoint manager with replication and coverage tracking.
+
+Re-design of the reference's local checkpointing
+(``checkpointing/local/ckpt_managers/base_manager.py:35-318`` and
+``local_manager.py:38-178``): each rank persists its shard to node-local storage (NVMe /
+ramdisk) every few minutes; cliques mirror shards across hosts; after a restart —
+possibly with ranks moved between hosts — ``find_latest`` agrees on the newest iteration
+whose shards **cover every rank**, and ``load`` routes missing shards from their mirrors.
+
+Checkpoint identity is ``CkptID = (iteration, owner_rank, session)``
+(``base_manager.py:86-101``). Files are ``iter_{it:07d}_{owner}_local.ckpt`` under
+``root/s{session}/r{rank}/`` — the directory names the *holder*, the filename the
+*owner*, so a rank's dir holds its own shard plus its clique mirrors. Writes are
+``.dirty``-then-rename atomic (``local_manager.py:110-131``); saves run through
+:class:`~tpu_resiliency.checkpoint.async_core.AsyncCallsQueue` with a finalize step
+that re-checks cross-rank coverage and prunes superseded iterations
+(``base_manager.py:277-304``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
+from tpu_resiliency.checkpoint.comm import StoreComm
+from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.logging import get_logger
+
+import pickle
+
+log = get_logger(__name__)
+
+_FILE_RE = re.compile(r"^iter_(\d{7})_(\d+)_local\.ckpt$")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class CkptID:
+    iteration: int
+    owner: int
+    session: int = 0
+
+    def filename(self) -> str:
+        return f"iter_{self.iteration:07d}_{self.owner}_local.ckpt"
+
+
+def _write_blobs(paths_and_blobs: list[tuple[str, bytes]]) -> None:
+    """Async-part worker: write each blob atomically (module-level: picklable)."""
+    for path, blob in paths_and_blobs:
+        tmp = path + ckpt_format.DIRTY_SUFFIX
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+
+class LocalCheckpointManager:
+    """Per-rank local checkpoint manager.
+
+    Single-rank operation: pass ``comm=None`` (no coverage agreement, no replication).
+    Distributed: pass a :class:`StoreComm` over all ranks, and optionally a
+    :class:`CliqueReplicationStrategy` built on the same store.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        rank: int = 0,
+        session: int = 0,
+        comm: Optional[StoreComm] = None,
+        replication: Optional[CliqueReplicationStrategy] = None,
+        caller: str = "thread",
+    ):
+        self.root = root
+        self.rank = rank
+        self.session = session
+        self.comm = comm
+        self.replication = replication
+        self.queue = AsyncCallsQueue(
+            caller=caller, sync_fn=comm.make_sync_fn() if comm is not None else None
+        )
+        self._dir = os.path.join(root, f"s{session}", f"r{rank}")
+        os.makedirs(self._dir, exist_ok=True)
+        self._cleanup_dirty()
+
+    # -- local inventory ---------------------------------------------------
+
+    def _cleanup_dirty(self) -> None:
+        for name in os.listdir(self._dir):
+            if name.endswith(ckpt_format.DIRTY_SUFFIX):
+                try:
+                    os.unlink(os.path.join(self._dir, name))
+                except OSError:
+                    pass
+
+    def local_ids(self) -> set[CkptID]:
+        """Checkpoint IDs held in this rank's directory (own shard + mirrors)."""
+        out = set()
+        for name in os.listdir(self._dir):
+            m = _FILE_RE.match(name)
+            if m:
+                out.add(CkptID(int(m.group(1)), int(m.group(2)), self.session))
+        return out
+
+    def _path(self, ckpt_id: CkptID) -> str:
+        return os.path.join(self._dir, ckpt_id.filename())
+
+    # -- save --------------------------------------------------------------
+
+    def save(
+        self,
+        iteration: int,
+        state_dict: PyTreeStateDict,
+        is_async: bool = True,
+        meta: Optional[dict] = None,
+    ) -> Optional[AsyncRequest]:
+        """Replicate + persist this rank's shard for ``iteration``.
+
+        Synchronous on the caller: pop tensors → one batched D2H → clique exchange
+        (host TCP). Asynchronous: file writes. Finalization (all ranks): coverage
+        verification + pruning of older iterations (``base_manager.py:236-318``).
+        """
+        if not state_dict.is_hollow:
+            state_dict.pop_tensors()
+        state_dict.copy_tensors_to_host()
+        hollow_bytes = pickle.dumps(
+            state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
+        )
+        blob = ckpt_format.serialize_to_bytes(
+            hollow_bytes, state_dict.tensors(), meta={"iteration": iteration, **(meta or {})}
+        )
+        held = (
+            self.replication.replicate(blob)
+            if self.replication is not None and self.replication.enabled
+            else {self.rank: blob}
+        )
+        writes = [
+            (self._path(CkptID(iteration, owner, self.session)), b)
+            for owner, b in held.items()
+        ]
+        req = AsyncRequest(
+            async_fn=_write_blobs,
+            async_fn_args=(writes,),
+            finalize_fns=(lambda: self._finalize_save(iteration),),
+        )
+        if is_async:
+            self.queue.schedule_async_request(req)
+            return req
+        req.execute_sync()
+        return None
+
+    def _finalize_save(self, iteration: int) -> None:
+        """Verify coverage of ``iteration`` across ranks, then prune older iterations."""
+        covered = self._covered_iterations()
+        if iteration not in covered:
+            raise CheckpointError(
+                f"checkpoint iteration {iteration} incomplete after save "
+                f"(covered: {sorted(covered)[-3:]})"
+            )
+        # Keep only the newest fully-covered iteration (the reference's retention
+        # policy: local ckpts are a recovery buffer, not an archive).
+        for ckpt_id in self.local_ids():
+            if ckpt_id.iteration < iteration:
+                try:
+                    os.unlink(self._path(ckpt_id))
+                except OSError:
+                    pass
+
+    # -- coverage / find_latest -------------------------------------------
+
+    def _covered_iterations(self) -> set[int]:
+        """Iterations for which the union of all ranks' holdings covers every rank."""
+        if self.comm is None:
+            return {i.iteration for i in self.local_ids() if i.owner == self.rank}
+        gathered = self.comm.all_gather(
+            sorted((i.iteration, i.owner) for i in self.local_ids()), tag="coverage"
+        )
+        by_iter: dict[int, set[int]] = {}
+        for holdings in gathered:
+            for it, owner in holdings:
+                by_iter.setdefault(it, set()).add(owner)
+        world = set(self.comm.ranks)  # the group's actual rank ids, not range(world)
+        return {it for it, owners in by_iter.items() if world <= owners}
+
+    def find_latest(self) -> int:
+        """Newest iteration fully covered by the group's holdings, or -1.
+
+        Mirrors reference ``base_manager.py:156-203`` (all-gather available IDs, pick
+        the max iteration every rank can be served for).
+        """
+        covered = self._covered_iterations()
+        return max(covered) if covered else -1
+
+    # -- load --------------------------------------------------------------
+
+    def load(self, iteration: Optional[int] = None) -> tuple[Any, list, dict]:
+        """Load this rank's shard for ``iteration`` (default: ``find_latest()``).
+
+        Returns ``(hollow_tree, host_tensors, meta)`` — caller re-inserts and restores
+        device placement (shardings belong to the *new* mesh after a restart). Routes
+        through clique retrieval when the shard isn't held locally
+        (``base_manager.py:205-234``).
+        """
+        if iteration is None:
+            iteration = self.find_latest()
+        if iteration < 0:
+            raise CheckpointError("no fully-covered local checkpoint found")
+        my_id = CkptID(iteration, self.rank, self.session)
+        path = self._path(my_id)
+        if os.path.exists(path):
+            blob = None
+            if self.comm is not None and self.replication is not None:
+                # Participate in the collective retrieve even when locally satisfied.
+                self.replication.retrieve(
+                    None, self._held_owners(iteration), lambda o: self._read_blob(iteration, o)
+                )
+            hollow_b, tensors, meta = ckpt_format.read_payload(path)
+        else:
+            if self.replication is None:
+                raise CheckpointError(
+                    f"rank {self.rank} holds no shard for iteration {iteration} "
+                    f"and replication is disabled"
+                )
+            blob = self.replication.retrieve(
+                self.rank, self._held_owners(iteration), lambda o: self._read_blob(iteration, o)
+            )
+            if blob is None:
+                raise CheckpointError(
+                    f"retrieval produced no shard for rank {self.rank} @ iter {iteration}"
+                )
+            hollow_b, tensors, meta = ckpt_format.deserialize_from_bytes(blob)
+        return pickle.loads(hollow_b), tensors, meta
+
+    def _held_owners(self, iteration: int) -> set[int]:
+        return {i.owner for i in self.local_ids() if i.iteration == iteration}
+
+    def _read_blob(self, iteration: int, owner: int) -> bytes:
+        with open(self._path(CkptID(iteration, owner, self.session)), "rb") as f:
+            return f.read()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def maybe_finalize(self, blocking: bool = False) -> list[int]:
+        return self.queue.maybe_finalize_async_calls(blocking=blocking)
+
+    def close(self) -> None:
+        self.queue.close()
+
+    def wipe(self) -> None:
+        """Remove this rank's local checkpoint directory (tests / teardown)."""
+        shutil.rmtree(self._dir, ignore_errors=True)
+        os.makedirs(self._dir, exist_ok=True)

@@ -1,7 +1,9 @@
-"""Shared wire framing: 4-byte big-endian length prefix + pickle payload.
+"""Shared wire framing: 8-byte big-endian length prefix + pickle payload.
 
-Single implementation used by both the TCP coordination store (``platform/store.py``)
-and the local UDS IPC (``platform/ipc.py``) so the wire protocol evolves in one place.
+Single implementation used by the TCP coordination store (``platform/store.py``), the
+local UDS IPC (``platform/ipc.py``), and the checkpoint peer-exchange links
+(``checkpoint/comm.py``) so the wire protocol evolves in one place. The length prefix
+is 64-bit because peer-exchange frames carry whole checkpoint shards (multi-GB).
 """
 
 from __future__ import annotations
@@ -12,7 +14,7 @@ import socket
 import struct
 from typing import Any
 
-LEN = struct.Struct("!I")
+LEN = struct.Struct("!Q")
 DEFAULT_MAX_FRAME = 64 * 1024 * 1024
 
 

@@ -123,6 +123,13 @@ class RankMonitorServer:
         Waits until the server socket exists so the worker can connect immediately.
         """
         ctx = mp.get_context(start_method)
+        # A stale socket file from a SIGKILLed predecessor would satisfy the readiness
+        # poll below before the child has actually bound its listener.
+        if os.path.exists(socket_path):
+            try:
+                os.unlink(socket_path)
+            except OSError:
+                pass
         proc = ctx.Process(
             target=_monitor_main, args=(cfg, socket_path, health_checks), daemon=True
         )
@@ -247,14 +254,21 @@ class RankMonitorServer:
     async def _periodic_check(self) -> None:
         while True:
             await asyncio.sleep(self.cfg.workload_check_interval)
-            if self.session is None or self.session.terminated:
-                continue
-            now = time.monotonic()
-            reason = self._hb_timeout_elapsed(now) or self._section_timeout_elapsed(now)
-            if reason is None and self._health_failure is not None:
-                reason = f"health check failed: {self._health_failure}"
-            if reason is not None:
-                self._terminate_rank(reason)
+            try:
+                if self.session is None or self.session.terminated:
+                    continue
+                now = time.monotonic()
+                reason = self._hb_timeout_elapsed(now) or self._section_timeout_elapsed(now)
+                if reason is None and self._health_failure is not None:
+                    reason = f"health check failed: {self._health_failure}"
+                if reason is not None:
+                    self._terminate_rank(reason)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The checker must survive anything (e.g. os.kill PermissionError on a
+                # reused PID) — a dead checker silently disables hang detection.
+                self.log.exception("periodic check iteration failed; continuing")
 
     def _on_health_failure(self, check: HealthCheck) -> None:
         self._health_failure = check.describe()

@@ -32,6 +32,10 @@ class TimeoutsCalc:
     section_open_since: dict[str, float] = dataclasses.field(default_factory=dict)
     out_of_section_max: float = 0.0
     last_section_close: Optional[float] = None
+    # Local collective-round counter: every rank calls synchronize_all the same number
+    # of times, so a local counter keys the round namespace without a store read (a
+    # store-read epoch races: a fast rank can re-enter before rank 0 bumps it).
+    sync_epoch: int = 0
 
     def _now(self) -> float:
         return time.monotonic()
@@ -86,15 +90,18 @@ class TimeoutsCalc:
         (reference ``timeouts_calc.py:74-91``)."""
         if world_size <= 1 or store is None:
             return
-        epoch = store.add(f"{key}/epoch", 0)  # read without bumping
-        ns = f"{key}/{epoch}"
+        ns = f"{key}/{self.sync_epoch}"
+        self.sync_epoch += 1
         store.set(f"{ns}/rank/{rank}", self._stats())
-        store.barrier(f"{ns}/sync", rank, world_size, 300.0)
+        # Fixed barrier names: the server's generation-counted reentrant barriers make
+        # them reusable across epochs without leaking per-epoch barrier state.
+        store.barrier(f"{key}/sync", rank, world_size, 300.0)
         merged = [store.get(f"{ns}/rank/{r}", timeout=60.0) for r in range(world_size)]
         self._merge_max(merged)
-        store.barrier(f"{ns}/done", rank, world_size, 300.0)
+        store.barrier(f"{key}/done", rank, world_size, 300.0)
         if rank == 0:
-            store.add(f"{key}/epoch", 1)
+            for r in range(world_size):
+                store.delete(f"{ns}/rank/{r}")
 
     def _stats(self) -> dict:
         return {
