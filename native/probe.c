@@ -1,0 +1,88 @@
+/* Native pending-call progress probe.
+ *
+ * The progress watchdog proves the main thread's eval loop is alive by scheduling a
+ * callback onto it with Py_AddPendingCall (the CPython liveness trick of the
+ * reference's inprocess/progress_watchdog.py:47-195). A ctypes-wrapped Python
+ * trampoline has a flaw: it executes Python bytecode on the main thread, so a
+ * PyThreadState_SetAsyncExc-injected restart exception can be delivered *inside the
+ * trampoline frame*, where ctypes swallows it ("Exception ignored on calling ctypes
+ * callback") and the restart signal is lost or misattributed as a SystemError.
+ *
+ * This callback is pure C: it runs on the main thread with the GIL held but never
+ * enters the bytecode eval loop, so pending async exceptions cannot fire inside it.
+ * It records a monotonic timestamp + counter read by the watchdog thread.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdatomic.h>
+#include <stdint.h>
+#include <time.h>
+
+static _Atomic int64_t g_probe_count = 0;
+static _Atomic int64_t g_probe_last_ns = 0;
+
+static int64_t monotonic_ns(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return (int64_t)ts.tv_sec * 1000000000LL + (int64_t)ts.tv_nsec;
+}
+
+/* Runs on the main thread inside the interpreter's pending-call drain. */
+static int probe_callback(void *arg) {
+    (void)arg;
+    atomic_store(&g_probe_last_ns, monotonic_ns());
+    atomic_fetch_add(&g_probe_count, 1);
+    return 0;
+}
+
+/* Schedule one probe; returns False if the interpreter's pending-call queue is
+ * full (caller retries next tick). Safe to call from any thread. */
+static PyObject *probe_schedule(PyObject *self, PyObject *noargs) {
+    (void)self;
+    (void)noargs;
+    int rc = Py_AddPendingCall(probe_callback, NULL);
+    if (rc != 0) {
+        Py_RETURN_FALSE;
+    }
+    Py_RETURN_TRUE;
+}
+
+static PyObject *probe_count(PyObject *self, PyObject *noargs) {
+    (void)self;
+    (void)noargs;
+    return PyLong_FromLongLong(atomic_load(&g_probe_count));
+}
+
+static PyObject *probe_last_ns(PyObject *self, PyObject *noargs) {
+    (void)self;
+    (void)noargs;
+    return PyLong_FromLongLong(atomic_load(&g_probe_last_ns));
+}
+
+static PyMethodDef ProbeMethods[] = {
+    {"schedule", probe_schedule, METH_NOARGS,
+     "Queue a pure-C pending call onto the main thread; True if queued."},
+    {"count", probe_count, METH_NOARGS,
+     "Number of probe callbacks the main thread has executed."},
+    {"last_ns", probe_last_ns, METH_NOARGS,
+     "CLOCK_MONOTONIC ns of the most recent executed probe (0 if none)."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef probemodule = {
+    PyModuleDef_HEAD_INIT,
+    "_probe_native",
+    "Pure-C main-thread liveness probe for the progress watchdog.",
+    -1,
+    ProbeMethods,
+    NULL,
+    NULL,
+    NULL,
+    NULL,
+};
+
+PyMODINIT_FUNC PyInit__probe_native(void) {
+    return PyModule_Create(&probemodule);
+}

@@ -1,0 +1,20 @@
+"""Native extensions build. Pure-Python metadata lives in pyproject.toml.
+
+Extensions are optional at runtime: every consumer falls back to a pure-Python path
+when the compiled module is absent (e.g. `tpu_resiliency/inprocess/progress_watchdog.py`
+falls back to a ctypes trampoline). Build in-place with:
+
+    python setup.py build_ext --inplace
+"""
+
+from setuptools import Extension, setup
+
+setup(
+    ext_modules=[
+        Extension(
+            "tpu_resiliency._probe_native",
+            sources=["native/probe.c"],
+            extra_compile_args=["-O2", "-std=c11"],
+        ),
+    ]
+)

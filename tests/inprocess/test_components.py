@@ -1,0 +1,417 @@
+"""Unit tests for the in-process restart building blocks (no multi-process)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import (
+    HealthCheckError,
+    InternalError,
+    RestartAbort,
+)
+from tpu_resiliency.inprocess.attribution import Interruption
+from tpu_resiliency.inprocess.compose import Compose, isinstance_or_composed
+from tpu_resiliency.inprocess.coordination import RestartCoordinator
+from tpu_resiliency.inprocess.finalize import Finalize, ThreadedFinalize
+from tpu_resiliency.inprocess.health_check import FaultCounter, JaxHealthCheck
+from tpu_resiliency.inprocess.initialize import RetryController
+from tpu_resiliency.inprocess.monitor_thread import MonitorThread, RankShouldRestart
+from tpu_resiliency.inprocess.progress_watchdog import ProgressWatchdog
+from tpu_resiliency.inprocess.rank_assignment import (
+    ActivateAllRanks,
+    ActiveWorldSizeDivisibleBy,
+    FillGaps,
+    FilterCountGroupedByKey,
+    Layer,
+    LayerFlag,
+    MaxActiveWorldSize,
+    RankAssignmentCtx,
+    ShiftRanks,
+    Tree,
+)
+from tpu_resiliency.inprocess.state import Mode, State
+from tpu_resiliency.inprocess.tools.inject_fault import Fault, InjectedFault, inject_fault
+from tpu_resiliency.platform.store import CoordStore
+
+
+def ctx_for(rank, world, terminated=()):
+    return RankAssignmentCtx(
+        State(rank=rank, world_size=world), frozenset(terminated)
+    )
+
+
+class TestStateAndFilters:
+    def test_state_defaults(self):
+        s = State(rank=3, world_size=8)
+        assert s.initial_rank == 3 and s.active_rank == 3 and s.mode == Mode.INITIALIZED
+
+    def test_activate_all(self):
+        c = ActivateAllRanks()(ctx_for(4, 6, terminated={1, 2}))
+        assert c.state.mode == Mode.ACTIVE
+        assert c.state.active_rank == 2  # survivors [0,3,4,5] → index 2
+        assert c.state.active_world_size == 4
+
+    def test_shift_ranks_terminated_rank(self):
+        c = ShiftRanks()(ctx_for(1, 4, terminated={1}))
+        assert c.state.mode == Mode.TERMINATED and c.state.active_rank is None
+
+    def test_fill_gaps_keeps_stable_slots(self):
+        # world 6, terminate {1, 3} → survivors [0,2,4,5], n=4.
+        # keep: 0,2 at own slots; movers 4,5 fill gaps [1,3].
+        for rank, expect in [(0, 0), (2, 2), (4, 1), (5, 3)]:
+            c = FillGaps()(ctx_for(rank, 6, terminated={1, 3}))
+            assert (c.state.active_rank, c.state.mode) == (expect, Mode.ACTIVE)
+
+    def test_max_active_world_size(self):
+        c = MaxActiveWorldSize(2)(ctx_for(3, 4))
+        assert c.state.mode == Mode.INACTIVE and c.state.active_world_size == 2
+
+    def test_divisible_by(self):
+        c = ActiveWorldSizeDivisibleBy(4)(ctx_for(5, 7, terminated={0}))
+        # 6 survivors → active world 4; survivor idx of 5 is 4 → INACTIVE
+        assert c.state.active_world_size == 4
+        assert c.state.mode == Mode.INACTIVE
+
+    def test_divisible_by_abort(self):
+        with pytest.raises(RestartAbort):
+            ActiveWorldSizeDivisibleBy(8)(ctx_for(0, 4, terminated={1}))
+
+    def test_filter_count_grouped_by_key(self):
+        # hosts of 2; host with a dead member is dropped entirely.
+        a = FilterCountGroupedByKey(lambda r: r // 2, lambda n: n == 2)
+        c = a(ctx_for(0, 6, terminated={1}))
+        assert c.state.mode == Mode.INACTIVE  # host 0 lost rank 1 → rank 0 demoted
+        c = a(ctx_for(2, 6, terminated={1}))
+        assert c.state.mode == Mode.ACTIVE and c.state.active_rank == 0
+
+
+class TestTree:
+    def test_dissolve_under_min(self):
+        # hosts of 2, min 2: losing one rank dissolves the host; RESERVE keeps the
+        # survivor as a spare.
+        tree = Tree(
+            layers=[
+                Layer(
+                    min_ranks=2,
+                    max_ranks=2,
+                    key_or_fn=lambda r: r // 2,
+                    flag=LayerFlag.RESERVE,
+                )
+            ]
+        )
+        c = tree(ctx_for(2, 8, terminated={3}))
+        assert c.state.mode == Mode.INACTIVE  # rank 2's host dissolved
+        c = tree(ctx_for(0, 8, terminated={3}))
+        assert c.state.mode == Mode.ACTIVE and c.state.active_world_size == 6
+
+    def test_backfill_across_hosts_within_slice(self):
+        # Outer layer: slices of 4 (BACKFILL). Inner: hosts of 2 (min 2, RESERVE).
+        # Terminating rank 3 dissolves host 1; its survivor (rank 2) backfills
+        # slice 0 back toward capacity.
+        tree = Tree(
+            layers=[
+                Layer(
+                    min_ranks=2,
+                    max_ranks=4,
+                    key_or_fn=lambda r: r // 4,
+                    flag=LayerFlag.BACKFILL,
+                ),
+                Layer(
+                    min_ranks=2,
+                    max_ranks=2,
+                    key_or_fn=lambda r: r // 2,
+                    flag=LayerFlag.RESERVE,
+                ),
+            ]
+        )
+        c = tree(ctx_for(2, 8, terminated={3}))
+        assert c.state.mode == Mode.ACTIVE
+        assert c.state.active_world_size == 7  # everyone alive stays active
+        actives = set()
+        for r in range(8):
+            if r == 3:
+                continue
+            cc = tree(ctx_for(r, 8, terminated={3}))
+            assert cc.state.mode == Mode.ACTIVE
+            actives.add(cc.state.active_rank)
+        assert actives == set(range(7))  # dense renumbering
+
+    def test_world_size_filter(self):
+        tree = Tree(
+            layers=[Layer(min_ranks=1, key_or_fn=None)],
+            world_size_filter=lambda n: (n // 4) * 4,
+        )
+        c = tree(ctx_for(0, 10, terminated={9}))
+        assert c.state.active_world_size == 8
+
+
+class TestPlugins:
+    def test_retry_controller(self):
+        s = State(rank=0, world_size=4)
+        RetryController(max_iterations=3)(s.freeze())
+        s.iteration = 3
+        with pytest.raises(RestartAbort):
+            RetryController(max_iterations=3)(s.freeze())
+
+    def test_retry_controller_min_world(self):
+        s = State(rank=0, world_size=2)
+        with pytest.raises(RestartAbort):
+            RetryController(min_world_size=4)(s.freeze())
+
+    def test_fault_counter(self):
+        st = State(rank=0, world_size=1)
+        st.fn_exception = RuntimeError("local fault")
+        faulted = st.freeze()
+        fc = FaultCounter(max_rank_faults=2)
+        fc(faulted)
+        fc(faulted)
+        with pytest.raises(HealthCheckError):
+            fc(faulted)
+
+    def test_fault_counter_ignores_peer_rounds(self):
+        st = State(rank=0, world_size=2)
+        clean = st.freeze()  # restart caused by a peer: fn_exception is None
+        fc = FaultCounter(max_rank_faults=1)
+        for _ in range(5):
+            fc(clean)  # never raises: this rank did not fault
+
+    def test_jax_health_check_passes(self):
+        s = State(rank=0, world_size=1).freeze()
+        assert JaxHealthCheck(timeout=60.0)(s) is s
+
+    def test_threaded_finalize_runs(self):
+        hits = []
+        s = State(rank=0, world_size=1).freeze()
+        ThreadedFinalize(timeout=5.0, fn=lambda: hits.append(1))(s)
+        assert hits == [1]
+
+    def test_threaded_finalize_timeout(self):
+        s = State(rank=0, world_size=1).freeze()
+        with pytest.raises(InternalError):
+            ThreadedFinalize(timeout=0.2, fn=lambda: time.sleep(5))(s)
+
+    def test_compose(self):
+        f = Compose(lambda x: x + 1, lambda x: x * 2)
+        assert f(3) == 8
+        assert isinstance_or_composed(
+            Compose(ThreadedFinalize(1.0, lambda: None)), Finalize
+        )
+        assert not isinstance_or_composed(Compose(lambda x: x), Finalize)
+
+    def test_inject_fault_exc(self):
+        with pytest.raises(InjectedFault):
+            inject_fault(Fault.EXC)
+
+
+class TestMonitorThread:
+    def test_injects_until_acknowledged(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=2)
+        aborted = []
+        lock = threading.RLock()
+        mt = MonitorThread(
+            coord,
+            iteration=0,
+            main_thread_id=threading.main_thread().ident,
+            atomic_lock=lock,
+            abort_fn=lambda: aborted.append(1),
+            interval=0.05,
+            last_call_wait=0.0,
+        )
+        mt.start()
+        mt.arm()
+        coord.record_interruption(0, 1, Interruption.EXCEPTION, "peer failed")
+        caught = False
+        deadline = time.monotonic() + 10.0
+        try:
+            while time.monotonic() < deadline:
+                time.sleep(0.01)
+        except RankShouldRestart:
+            caught = True
+        finally:
+            mt.acknowledge()
+            mt.shutdown()
+        assert caught and aborted == [1] and mt.fired
+        store.close()
+
+    def test_atomic_section_defers_injection(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=2)
+        lock = threading.RLock()
+        mt = MonitorThread(
+            coord,
+            iteration=0,
+            main_thread_id=threading.main_thread().ident,
+            atomic_lock=lock,
+            interval=0.05,
+            last_call_wait=0.0,
+        )
+        mt.start()
+        mt.arm()
+        interrupted_inside = False
+        try:
+            with lock:  # critical section: injection must not land here
+                coord.record_interruption(0, 1, Interruption.EXCEPTION, "x")
+                time.sleep(0.5)
+                critical_done = True
+        except RankShouldRestart:
+            interrupted_inside = True
+            critical_done = False
+        # Outside the lock the injection is free to land.
+        caught_outside = False
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                time.sleep(0.01)
+        except RankShouldRestart:
+            caught_outside = True
+        finally:
+            mt.acknowledge()
+            mt.shutdown()
+        assert not interrupted_inside and critical_done and caught_outside
+        store.close()
+
+    def test_clean_shutdown_without_interruption(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=1)
+        mt = MonitorThread(
+            coord,
+            iteration=0,
+            main_thread_id=threading.main_thread().ident,
+            atomic_lock=threading.RLock(),
+            interval=0.05,
+        )
+        mt.start()
+        mt.shutdown()
+        assert not mt.fired
+        store.close()
+
+
+def _native_probe_built() -> bool:
+    try:
+        from tpu_resiliency import _probe_native  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class TestProgressWatchdog:
+    @pytest.mark.parametrize(
+        "use_native",
+        [
+            False,
+            pytest.param(
+                True,
+                marks=pytest.mark.skipif(
+                    not _native_probe_built(), reason="_probe_native not built"
+                ),
+            ),
+        ],
+    )
+    def test_auto_and_manual_timestamps(self, use_native):
+        reports = []
+        wd = ProgressWatchdog(
+            interval=0.05, report=lambda k, t: reports.append(k), use_native=use_native
+        )
+        wd.start()
+        time.sleep(0.5)  # main thread sleeping still executes pending calls
+        wd.ping()
+        wd.shutdown()
+        kinds = set(reports)
+        assert "auto" in kinds and "manual" in kinds
+
+    def test_pause_stops_auto(self):
+        reports = []
+        wd = ProgressWatchdog(interval=0.05, report=lambda k, t: reports.append(k))
+        wd.pause()
+        wd.start()
+        time.sleep(0.3)
+        wd.shutdown()
+        assert "auto" not in set(reports)
+
+
+class TestCoordinator:
+    def test_interruption_roundtrip(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=4)
+        assert not coord.is_interrupted(0)
+        coord.record_interruption(0, 2, Interruption.SOFT_TIMEOUT, "slow")
+        assert coord.is_interrupted(0)
+        assert coord.wait_interrupted(0, timeout=1.0)
+        recs = coord.get_interruptions(0)
+        assert len(recs) == 1 and recs[0].rank == 2
+        assert not coord.is_interrupted(1)  # per-iteration scoping
+        store.close()
+
+    def test_on_behalf_barrier_idempotent(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=2)
+        # Two watchers complete for the same dead rank; then the survivor joins.
+        coord.complete_barriers_for(0, 1)
+        coord.complete_barriers_for(0, 1)  # idempotent — no overflow
+        coord.join_iteration_barrier(0, 0, timeout=5.0)
+        coord.join_completion_barrier(0, 0, timeout=5.0)
+        store.close()
+
+    def test_terminated_accumulates(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        coord = RestartCoordinator(store, world_size=4)
+        coord.record_terminated([1])
+        coord.record_terminated([3])
+        assert coord.terminated_ranks() == frozenset({1, 3})
+        store.close()
+
+
+class TestCompletionAndGC:
+    def test_completion_barrier_yields_to_interruption(self, kv_server):
+        """A completer must abandon the completion wait as soon as a peer's fault is
+        on record — not after the full barrier timeout (that stall would outlast the
+        faulted rank's resync window and eject a healthy rank)."""
+        from tpu_resiliency.inprocess.coordination import CompletionInterrupted
+
+        store = CoordStore("127.0.0.1", kv_server.port)
+        coord = RestartCoordinator(store, world_size=2)
+        t0 = time.monotonic()
+
+        def fault_soon():
+            time.sleep(0.3)
+            coord.record_interruption(0, 1, Interruption.EXCEPTION, "peer boom")
+
+        threading.Thread(target=fault_soon, daemon=True).start()
+        with pytest.raises(CompletionInterrupted):
+            coord.join_completion_barrier(0, rank=0, timeout=60.0, poll_interval=0.05)
+        assert time.monotonic() - t0 < 5.0
+        store.close()
+
+    def test_completion_barrier_releases(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port)
+        coord = RestartCoordinator(store, world_size=2)
+        done = []
+
+        def other():
+            c2 = RestartCoordinator(CoordStore("127.0.0.1", kv_server.port), 2)
+            c2.join_completion_barrier(0, rank=1, timeout=10.0, poll_interval=0.05)
+            done.append(1)
+
+        t = threading.Thread(target=other, daemon=True)
+        t.start()
+        coord.join_completion_barrier(0, rank=0, timeout=10.0, poll_interval=0.05)
+        t.join(10.0)
+        assert done == [1]
+        store.close()
+
+    def test_cleanup_iteration_reclaims_round_state(self, kv_server):
+        store = CoordStore("127.0.0.1", kv_server.port)
+        coord = RestartCoordinator(store, world_size=2)
+        coord.record_interruption(3, 0, Interruption.SOFT_TIMEOUT, "slow")
+        coord.complete_barriers_for(3, 0)
+        coord.record_terminated([1])
+        coord.cleanup_iteration(3)
+        assert coord.get_interruptions(3) == []
+        assert not coord.is_interrupted(3)
+        assert store.barrier_status("barrier/iteration/3") is None
+        # Cross-iteration state survives GC.
+        assert coord.terminated_ranks() == frozenset({1})
+        store.close()

@@ -55,22 +55,16 @@ def test_add_and_cas(coord_store):
 
 
 def test_lists_and_sets(coord_store):
-    coord_store.record_interrupted({"rank": 3, "why": "exc"})
-    coord_store.record_interrupted({"rank": 5, "why": "timeout"})
-    recs = coord_store.get_interruption_records()
+    coord_store.list_append("records", {"rank": 3, "why": "exc"})
+    coord_store.list_append("records", {"rank": 5, "why": "timeout"})
+    recs = coord_store.list_get("records")
     assert [r["rank"] for r in recs] == [3, 5]
-    coord_store.clear_interruption_records()
-    assert coord_store.get_interruption_records() == []
+    coord_store.list_clear("records")
+    assert coord_store.list_get("records") == []
 
-    coord_store.record_terminated_ranks([1, 2])
-    coord_store.record_terminated_ranks([2, 7])
-    assert coord_store.get_terminated_ranks() == {1, 2, 7}
-
-
-def test_heartbeats(coord_store):
-    coord_store.send_heartbeat(0, 123.0)
-    coord_store.send_heartbeat(3, 456.0)
-    assert coord_store.get_heartbeats() == {0: 123.0, 3: 456.0}
+    coord_store.set_add("terminated", [1, 2])
+    coord_store.set_add("terminated", [2, 7])
+    assert coord_store.set_get("terminated") == {1, 2, 7}
 
 
 def _run_barrier(port, name, rank, world, timeout=10.0):
@@ -123,11 +117,51 @@ def test_barrier_timeout(coord_store):
         coord_store.barrier("lonely", 0, 2, timeout=0.2)
 
 
-def test_barrier_double_join_overflow(kv_server):
+def test_barrier_double_join_semantics(kv_server):
+    """Duplicate non-blocking registrations and duplicate proxy joins are idempotent;
+    a duplicate *waiting* join and a dead-marked rank arriving itself are errors."""
     c = CoordStore("127.0.0.1", kv_server.port)
     c.barrier_join("dj", rank=0, world_size=3, timeout=0.0, wait=False)
-    with pytest.raises(BarrierOverflow):
-        c.barrier_join("dj", rank=0, world_size=3, timeout=0.0, wait=False)
+    c.barrier_join("dj", rank=0, world_size=3, timeout=0.0, wait=False)  # no overflow
+    with pytest.raises(BarrierOverflow):  # rank 0 already registered this round
+        c.barrier_join("dj", rank=0, world_size=3, timeout=0.5, wait=True)
+    c.complete_barrier_for("dj", rank=1, world_size=3)
+    c.complete_barrier_for("dj", rank=1, world_size=3)  # duplicate proxy: no-op
+    with pytest.raises(BarrierOverflow):  # proxied-dead rank arriving itself
+        c.barrier_join("dj", rank=1, world_size=3, timeout=0.5, wait=True)
+    c.close()
+
+
+def test_barrier_no_phantom_rerelease(kv_server):
+    """A round covered entirely by proxies releases exactly once: late duplicate
+    proxies must not bump the generation again (completers poll `generation >
+    start_gen`, so a phantom release would fake a successful round)."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+    for r in (0, 1):
+        c.barrier_join("pr", rank=r, world_size=2, timeout=0.0, wait=False)
+    assert c.barrier_status("pr")["generation"] == 1
+    for _ in range(3):
+        c.complete_barrier_for("pr", rank=1, world_size=2)
+        c.barrier_join("pr", rank=1, world_size=2, timeout=0.0, wait=False, on_behalf=True)
+    assert c.barrier_status("pr")["generation"] == 1
+    c.close()
+
+
+def test_barrier_elastic_world_resets_absences(kv_server):
+    """Sticky absences die with the world size: after an elastic shrink the old
+    rank numbering is meaningless, so a round at the new size must require every
+    live rank — not release early on a stale absence."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.complete_barrier_for("ew", rank=2, world_size=3)
+    for r in (0, 1):
+        c.barrier_join("ew", rank=r, world_size=3, timeout=5.0, wait=False)
+    assert c.barrier_status("ew")["generation"] == 1
+    # New round at world 2: rank 2's stale absence must not count.
+    c.barrier_join("ew", rank=0, world_size=2, timeout=0.0, wait=False)
+    st = c.barrier_status("ew")
+    assert st["generation"] == 1 and st["absent"] == set()
+    c.barrier_join("ew", rank=1, world_size=2, timeout=0.0, wait=False)
+    assert c.barrier_status("ew")["generation"] == 2
     c.close()
 
 
@@ -164,8 +198,8 @@ def test_scoped_views_isolate(coord_store):
     s1.set("k", "b")
     assert s0.get("k") == "a"
     assert s1.get("k") == "b"
-    s0.record_terminated_ranks([1])
-    assert s1.get_terminated_ranks() == set()
+    s0.set_add("terminated", [1])
+    assert s1.set_get("terminated") == set()
     # every key-based op must stay inside the view's namespace
     assert s0.check(["k"]) and s1.check(["k"])
     assert s0.prefix_get() == {"k": "a"}
@@ -173,8 +207,8 @@ def test_scoped_views_isolate(coord_store):
     assert s1.get("k") == "b"  # sibling namespace untouched
     s0.list_append("l", 1)
     assert s0.list_get("l") == [1] and s1.list_get("l") == []
-    s0.send_heartbeat(4, 9.0)
-    assert s0.get_heartbeats() == {4: 9.0} and s1.get_heartbeats() == {}
+    s0.set("hb/4", 9.0)
+    assert s0.prefix_get("hb/") == {"hb/4": 9.0} and s1.prefix_get("hb/") == {}
 
 
 def test_auth_handshake():
@@ -217,7 +251,7 @@ def test_blocking_op_does_not_starve_fast_ops(kv_server):
     t.start()
     time.sleep(0.3)
     start = time.monotonic()
-    c.send_heartbeat(0)
+    c.set("hb/0", time.time())
     elapsed = time.monotonic() - start
     assert elapsed < 2.0, f"heartbeat starved behind blocking barrier: {elapsed:.1f}s"
     # release the barrier so the thread exits quickly
@@ -256,4 +290,64 @@ def test_concurrent_clients_hammer(kv_server):
         t.join(30.0)
     c = CoordStore("127.0.0.1", kv_server.port)
     assert c.get("hammer") == N * per
+    c.close()
+
+
+def test_sticky_absent_across_generations(kv_server):
+    """A proxied-dead rank stays covered in every later round of the same barrier
+    name, and a duplicate proxy racing a release can't plant a phantom arrival."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+    world = 3
+    c.complete_barrier_for("st", rank=2, world_size=world)
+
+    def join(rank, out):
+        cc = CoordStore("127.0.0.1", kv_server.port)
+        try:
+            cc.barrier_join("st", rank, world, timeout=10.0)
+            out.append(rank)
+        finally:
+            cc.close()
+
+    for gen in range(2):  # round 2 works WITHOUT re-proxying rank 2
+        out = []
+        threads = [threading.Thread(target=join, args=(r, out)) for r in (0, 1)]
+        for t in threads:
+            t.start()
+        # duplicate proxy joins mid-round and post-release: must all be no-ops
+        c.complete_barrier_for("st", rank=2, world_size=world)
+        for t in threads:
+            t.join(15.0)
+        assert sorted(out) == [0, 1], f"round {gen}"
+        c.complete_barrier_for("st", rank=2, world_size=world)
+
+    with pytest.raises(BarrierOverflow):  # dead-marked rank rejoining is the signal
+        c.barrier_join("st", rank=2, world_size=world, timeout=0.5)
+    c.close()
+
+
+def test_touch_and_stale_keys(kv_server):
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.touch("hb/0")
+    c.set("hb/notnum", "x")  # non-numeric values are never reported stale
+    assert c.stale_keys("hb/", 30.0) == {}
+    time.sleep(0.05)
+    stale = c.stale_keys("hb/", 0.01)
+    assert set(stale) == {"hb/0"} and stale["hb/0"] > 0.0
+    c.close()
+
+
+def test_prefix_clear_all_tables(kv_server):
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.set("iter/0/flag", True)
+    c.list_append("iter/0/recs", 1)
+    c.set_add("iter/0/dead", [4])
+    c.complete_barrier_for("iter/0/bar", rank=0, world_size=2)
+    c.set("iter/1/flag", True)
+    removed = c.prefix_clear("iter/0/")
+    assert removed == 4
+    assert c.prefix_get("iter/0/") == {}
+    assert c.list_get("iter/0/recs") == []
+    assert c.set_get("iter/0/dead") == set()
+    assert c.barrier_status("iter/0/bar") is None
+    assert c.prefix_get("iter/1/") == {"iter/1/flag": True}
     c.close()

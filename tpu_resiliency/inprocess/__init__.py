@@ -1,1 +1,82 @@
+"""In-process restart: recover from faults without killing the training process.
 
+TPU-native re-design of the reference's ``inprocess/`` package (SURVEY §2.4): wrap the
+training function with :class:`Wrapper`; on any fault the engine aborts, finalizes,
+health-checks, reassigns ranks, and re-enters — skipping scheduler launch, container
+start, interpreter init, and device-runtime creation on the recovery path.
+"""
+
+from tpu_resiliency.inprocess.abort import Abort, AbortCompilationCache, AbortJaxDistributed
+from tpu_resiliency.inprocess.attribution import Interruption, InterruptionRecord
+from tpu_resiliency.inprocess.completion import (
+    Completion,
+    LogCompletion,
+    LogTerminate,
+    Terminate,
+)
+from tpu_resiliency.inprocess.compose import Compose, isinstance_or_composed
+from tpu_resiliency.inprocess.coordination import RestartCoordinator
+from tpu_resiliency.inprocess.finalize import Finalize, ThreadedFinalize
+from tpu_resiliency.inprocess.health_check import FaultCounter, HealthCheck, JaxHealthCheck
+from tpu_resiliency.inprocess.initialize import Initialize, RetryController
+from tpu_resiliency.inprocess.monitor_thread import MonitorThread, RankShouldRestart
+from tpu_resiliency.inprocess.monitor_process import MonitorConfig, MonitorProcess
+from tpu_resiliency.inprocess.nested_restarter import NestedRestarter
+from tpu_resiliency.inprocess.progress_watchdog import ProgressWatchdog
+from tpu_resiliency.inprocess.rank_assignment import (
+    ActivateAllRanks,
+    ActiveWorldSizeDivisibleBy,
+    FillGaps,
+    FilterCountGroupedByKey,
+    Layer,
+    LayerFlag,
+    MaxActiveWorldSize,
+    RankAssignmentCtx,
+    ShiftRanks,
+    Tree,
+)
+from tpu_resiliency.inprocess.state import FrozenState, Mode, State
+from tpu_resiliency.inprocess.wrap import CallWrapper, Wrapper
+
+__all__ = [
+    "Abort",
+    "AbortCompilationCache",
+    "AbortJaxDistributed",
+    "ActivateAllRanks",
+    "ActiveWorldSizeDivisibleBy",
+    "CallWrapper",
+    "Completion",
+    "Compose",
+    "FaultCounter",
+    "FillGaps",
+    "FilterCountGroupedByKey",
+    "Finalize",
+    "FrozenState",
+    "HealthCheck",
+    "Initialize",
+    "Interruption",
+    "InterruptionRecord",
+    "JaxHealthCheck",
+    "Layer",
+    "LayerFlag",
+    "LogCompletion",
+    "LogTerminate",
+    "MaxActiveWorldSize",
+    "Mode",
+    "MonitorConfig",
+    "MonitorProcess",
+    "MonitorThread",
+    "NestedRestarter",
+    "ProgressWatchdog",
+    "RankAssignmentCtx",
+    "RankShouldRestart",
+    "RestartCoordinator",
+    "RetryController",
+    "ShiftRanks",
+    "State",
+    "Terminate",
+    "ThreadedFinalize",
+    "Tree",
+    "Wrapper",
+    "isinstance_or_composed",
+]

@@ -1,0 +1,67 @@
+"""Abort: tear down distributed/device state so a faulted iteration can't wedge us.
+
+Analogue of reference ``inprocess/abort.py``: ``AbortTorchDistributed`` aborts NCCL
+communicators in parallel threads then destroys the process group (``abort.py:58-105``).
+
+There is no NCCL-communicator-abort equivalent for an in-flight XLA computation
+(SURVEY §7 "hard parts"): a hung collective blocks ``block_until_ready`` until the
+runtime notices peer loss. What *can* and must be torn down host-side:
+
+- the JAX distributed client (coordination-service connection) — so the restarted
+  iteration can re-`initialize` with the new world;
+- compiled-computation caches pinned to the old mesh/world shape;
+- our own coordination-store connections scoped to the aborted iteration.
+
+The escalation ladder for truly stuck device programs is the same as the reference's:
+soft (this abort) → hard (monitor process signals the OS process; the in-job launcher
+restarts it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from tpu_resiliency.inprocess.state import FrozenState
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Abort:
+    def __call__(self, state: FrozenState) -> FrozenState:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class AbortJaxDistributed(Abort):
+    """Shut down the JAX distributed client (multi-host coordination connection)."""
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        import jax
+
+        try:
+            if jax._src.distributed.global_state.client is not None:  # noqa: SLF001
+                jax.distributed.shutdown()
+                log.info("abort: jax.distributed shut down")
+        except Exception as e:  # abort must never fail the restart loop
+            log.warning(f"abort: jax.distributed.shutdown failed: {e!r}")
+        return state
+
+
+@dataclasses.dataclass
+class AbortCompilationCache(Abort):
+    """Drop compiled programs pinned to the previous world's mesh shapes.
+
+    After rank reassignment the mesh changes; executables compiled for the old device
+    assignment must not be reused (and on CPU/TPU they pin device buffers).
+    """
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        import jax
+
+        try:
+            jax.clear_caches()
+            log.info("abort: cleared jit/pjit compilation caches")
+        except Exception as e:
+            log.warning(f"abort: clear_caches failed: {e!r}")
+        return state

@@ -1,0 +1,93 @@
+"""Post-fault rank health checks.
+
+Analogue of the reference's ``inprocess/health_check.py``: ``CudaHealthCheck`` proves
+the GPU still answers by running two ``torch.cuda.synchronize`` calls under a timeout
+thread (``:70-110``); ``FaultCounter`` caps faults per rank (``:122-146``).
+
+The TPU analogue of "does the device still answer": compile-and-run a tiny addition and
+``block_until_ready`` it, twice, each under a watchdog timeout — the first run flushes
+any poisoned program state; the second proves steady-state liveness. A hung XLA
+computation blocks ``block_until_ready`` forever, which is exactly what the timeout
+thread detects (there is no CUDA-context-style query to poll on TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+from tpu_resiliency.exceptions import HealthCheckError
+from tpu_resiliency.inprocess.state import FrozenState
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class HealthCheck:
+    """Interface: called with the frozen state after finalize; raise to exclude rank."""
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        raise NotImplementedError
+
+
+def _run_with_timeout(fn, timeout: float, what: str) -> None:
+    err: list[BaseException] = []
+    done = threading.Event()
+
+    def body() -> None:
+        try:
+            fn()
+        except BaseException as e:
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=body, name=f"health-{what}", daemon=True)
+    t.start()
+    if not done.wait(timeout):
+        raise HealthCheckError(f"{what} did not complete within {timeout}s")
+    if err:
+        raise HealthCheckError(f"{what} failed: {err[0]!r}") from err[0]
+
+
+@dataclasses.dataclass
+class JaxHealthCheck(HealthCheck):
+    """Device liveness probe: two tiny compiled adds under a timeout (the direct
+    analogue of ``CudaHealthCheck``'s double ``synchronize``)."""
+
+    timeout: float = 30.0
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        import jax
+        import jax.numpy as jnp
+
+        def probe() -> None:
+            x = jnp.asarray([1.0, 2.0])
+            jax.block_until_ready(x + x)
+
+        _run_with_timeout(probe, self.timeout, "device probe (1/2)")
+        _run_with_timeout(probe, self.timeout, "device probe (2/2)")
+        return state
+
+
+@dataclasses.dataclass
+class FaultCounter(HealthCheck):
+    """Exclude a rank after too many faults (reference ``health_check.py:122-146``)."""
+
+    max_rank_faults: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        self._count = 0
+
+    def __call__(self, state: FrozenState) -> FrozenState:
+        # The health chain runs on EVERY survivor each restart round; only rounds
+        # where THIS rank's fn raised count as this rank's faults.
+        if state.fn_exception is None:
+            return state
+        self._count += 1
+        if self.max_rank_faults is not None and self._count > self.max_rank_faults:
+            raise HealthCheckError(
+                f"rank {state.rank} exceeded {self.max_rank_faults} faults"
+            )
+        return state

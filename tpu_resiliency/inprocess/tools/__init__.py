@@ -1,1 +1,3 @@
+from tpu_resiliency.inprocess.tools.inject_fault import Fault, InjectedFault, inject_fault
 
+__all__ = ["Fault", "InjectedFault", "inject_fault"]

@@ -1,0 +1,106 @@
+"""Fault injection for resiliency testing.
+
+Analogue of reference ``inprocess/tools/inject_fault.py:34-92``: a registry of fault
+kinds that tests and examples trigger deterministically (by iteration/step) or after a
+delay, exercising every detector: exceptions (monitor-thread path), async exceptions,
+SIGKILL / segfault (sibling + monitor-process death paths), GIL lockup (progress
+watchdog hard-timeout path), and sleeps (soft-timeout path).
+
+Faults are destructive by design; they are for tests of THIS framework only.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Fault(enum.Enum):
+    EXC = enum.auto()  # raise in the calling thread
+    ASYNC_EXC = enum.auto()  # async-raise into the main thread from a helper thread
+    SIGKILL = enum.auto()  # kill the process
+    SIGTERM = enum.auto()
+    SIGSTOP = enum.auto()  # stop (simulates a wedged-but-alive process)
+    SEGFAULT = enum.auto()  # native crash
+    LOCK_GIL = enum.auto()  # hold the GIL forever in a helper thread
+    SLEEP = enum.auto()  # block the calling thread (soft timeout)
+    EXIT = enum.auto()  # os._exit without cleanup
+
+
+class InjectedFault(Exception):
+    pass
+
+
+def _segfault() -> None:
+    ctypes.memmove(1, 2, 3)  # write to an unmapped address
+
+
+def _lock_gil() -> None:
+    # PyEval-level spin with the GIL held: pure-Python hot loop in a thread that
+    # never yields via C calls barely exists in CPython; use ctypes to call a
+    # blocking C function while holding the GIL instead.
+    libc = ctypes.CDLL(None, use_errno=True)
+    pythonapi = ctypes.pythonapi
+    pythonapi.PyGILState_Ensure.restype = ctypes.c_void_p
+    pythonapi.PyGILState_Ensure()
+    libc.sleep(3600)  # blocks holding the GIL: no other thread can run Python
+
+
+def inject_fault(
+    fault: Fault,
+    delay: float = 0.0,
+    duration: float = 30.0,
+    in_thread: bool = False,
+) -> Optional[threading.Thread]:
+    """Trigger ``fault`` after ``delay`` seconds (in a helper thread if requested or
+    inherently asynchronous)."""
+
+    def fire() -> None:
+        if delay > 0:
+            time.sleep(delay)
+        log.warning(f"injecting fault {fault.name} (pid {os.getpid()})")
+        if fault == Fault.EXC:
+            raise InjectedFault(f"injected {fault.name}")
+        if fault == Fault.ASYNC_EXC:
+            main_id = threading.main_thread().ident
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(main_id), ctypes.py_object(InjectedFault)
+            )
+            return
+        if fault in (Fault.SIGKILL, Fault.SIGTERM, Fault.SIGSTOP):
+            sig = {
+                Fault.SIGKILL: signal.SIGKILL,
+                Fault.SIGTERM: signal.SIGTERM,
+                Fault.SIGSTOP: signal.SIGSTOP,
+            }[fault]
+            os.kill(os.getpid(), sig)
+            return
+        if fault == Fault.SEGFAULT:
+            _segfault()
+            return
+        if fault == Fault.LOCK_GIL:
+            _lock_gil()
+            return
+        if fault == Fault.SLEEP:
+            time.sleep(duration)
+            return
+        if fault == Fault.EXIT:
+            os._exit(3)
+        raise ValueError(f"unknown fault {fault}")
+
+    needs_thread = in_thread or fault in (Fault.ASYNC_EXC, Fault.LOCK_GIL)
+    if delay > 0 or needs_thread:
+        t = threading.Thread(target=fire, name=f"fault-{fault.name}", daemon=True)
+        t.start()
+        return t
+    fire()
+    return None

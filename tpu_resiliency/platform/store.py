@@ -73,6 +73,13 @@ class _Barrier:
     generation: int = 0
     arrived: set = dataclasses.field(default_factory=set)
     world_size: int = 0
+    #: ranks marked absent by on-behalf (proxy) joins — sticky across generations:
+    #: a dead rank stays dead for every subsequent round of this barrier name, so
+    #: watchers need not — but may, idempotently — re-proxy each round. Reset when a
+    #: round opens with a different world size (elastic membership change).
+    absent: set = dataclasses.field(default_factory=set)
+    #: world size of the last round that opened, for detecting elastic changes
+    last_world: int = 0
 
 
 class KVServer:
@@ -96,6 +103,7 @@ class KVServer:
         self._lists: dict[str, list] = {}
         self._sets: dict[str, set] = {}
         self._barriers: dict[str, _Barrier] = {}
+        self._stale_cache: dict[tuple[str, float], tuple[float, dict]] = {}
         self._cond = threading.Condition()
         self._shutdown = threading.Event()
 
@@ -278,13 +286,37 @@ class KVServer:
         with self._cond:
             return self._ok(set(self._sets.get(req["key"], set())))
 
-    def _op_barrier(self, req: dict) -> dict:
-        """Join barrier `name` as `rank`; release when `world_size` distinct ranks joined.
+    @staticmethod
+    def _barrier_maybe_release(b: _Barrier) -> bool:
+        covered = len(b.arrived | b.absent)
+        if b.world_size and covered >= b.world_size:
+            b.generation += 1
+            b.arrived = set()  # absent stays: dead ranks stay dead for future rounds
+            b.world_size = 0
+            return True
+        return False
 
-        Reentrant: each completed round bumps the generation, so the same name can be
-        used every iteration (reference ``reentrant_barrier``, ``store.py:244``). With
-        ``wait=False`` the caller joins without blocking — used by monitors to complete
-        barriers on behalf of dead ranks (reference ``monitor_process.py:260-282``).
+    def _op_barrier(self, req: dict) -> dict:
+        """Join barrier `name` as `rank`; release when `world_size` ranks are covered.
+
+        Three join modes:
+
+        - ``wait=True`` — arrive and block until the round releases (the normal join).
+        - ``wait=False`` — *register* arrival and return immediately; the caller polls
+          ``barrier_status`` for the release (how completers overlap the barrier wait
+          with interruption watching). Duplicate registrations are no-ops.
+        - ``on_behalf=True`` — proxy join *for a dead rank*: the rank is marked absent
+          stickily, counting toward this and every future round of the name until the
+          world size changes (reference ``monitor_process.py:260-282``). Repeats are
+          no-ops; release fires only on a coverage *transition*, so a late duplicate
+          proxy can neither plant a phantom arrival nor re-release a finished round.
+
+        A dead-marked rank arriving itself gets :class:`BarrierOverflow` — the
+        falsely-declared-dead signal the restart loop converts into exclusion.
+        Reentrant: each completed round bumps the generation (reference
+        ``reentrant_barrier``, ``store.py:244``); a round opening with a different
+        world size (elastic shrink/grow) resets the absent set, since rank identities
+        were remapped by reassignment.
         """
         name, rank = req["name"], req["rank"]
         world_size = int(req["world_size"])
@@ -292,26 +324,41 @@ class KVServer:
         with self._cond:
             b = self._barriers.setdefault(name, _Barrier())
             if b.world_size and b.world_size != world_size:
-                # A new round may legitimately shrink/grow the world (elastic restart);
-                # only flag mismatch within an in-progress round.
+                # Mismatch within an in-progress round is a protocol error.
                 if b.arrived:
                     raise BarrierOverflow(
                         f"barrier {name!r}: world_size {world_size} != in-progress "
                         f"round's {b.world_size}"
                     )
+            if b.world_size == 0:  # first join of a round
+                if b.last_world and b.last_world != world_size:
+                    # Elastic membership change: stale absences refer to the old
+                    # rank numbering and must not count toward the new round.
+                    b.absent = set()
+                b.last_world = world_size
             b.world_size = world_size
             gen = b.generation
+            if req.get("on_behalf", False):
+                if rank not in b.absent:
+                    b.absent.add(rank)
+                    if self._barrier_maybe_release(b):
+                        self._cond.notify_all()
+                return self._ok(None)
+            if rank in b.absent:
+                raise BarrierOverflow(
+                    f"barrier {name!r}: rank {rank} was proxied as dead"
+                )
             if rank in b.arrived:
+                if not req.get("wait", True):
+                    return self._ok(None)  # idempotent re-registration
                 raise BarrierOverflow(f"barrier {name!r}: rank {rank} joined twice")
             b.arrived.add(rank)
-            if len(b.arrived) > world_size:
+            if len(b.arrived | b.absent) > world_size:
                 raise BarrierOverflow(
-                    f"barrier {name!r}: {len(b.arrived)} arrivals > world {world_size}"
+                    f"barrier {name!r}: {len(b.arrived | b.absent)} arrivals > "
+                    f"world {world_size}"
                 )
-            if len(b.arrived) == world_size:
-                b.generation += 1
-                b.arrived = set()
-                b.world_size = 0
+            if self._barrier_maybe_release(b):
                 self._cond.notify_all()
                 return self._ok(b.generation)
             if not req.get("wait", True):
@@ -333,8 +380,64 @@ class KVServer:
             if b is None:
                 return self._ok(None)
             return self._ok(
-                {"generation": b.generation, "arrived": set(b.arrived), "world_size": b.world_size}
+                {
+                    "generation": b.generation,
+                    "arrived": set(b.arrived),
+                    "absent": set(b.absent),
+                    "world_size": b.world_size,
+                }
             )
+
+    def _op_touch(self, req: dict) -> dict:
+        """Store the *server's* wall time under `key`. Heartbeat freshness must be
+        judged by one clock — comparing a peer host's ``time.time()`` against the local
+        one turns NTP offset into false UNRESPONSIVE verdicts."""
+        with self._cond:
+            self._data[req["key"]] = time.time()
+            self._cond.notify_all()
+        return self._ok()
+
+    def _op_stale(self, req: dict) -> dict:
+        """Return ``{key: age}`` for keys under `prefix` whose touch-stamp is older
+        than `max_age` seconds by the server clock.
+
+        This is the watchers' liveness query: the response carries only the *stale*
+        entries, so N watchers polling every second costs O(stale) wire traffic, not
+        O(N²) full-table transfers. Scans are coalesced through a short-lived cache —
+        liveness tolerates a second of slack, the single server lock does not tolerate
+        N full scans per second.
+        """
+        prefix, max_age = req["prefix"], float(req["max_age"])
+        with self._cond:
+            cached = self._stale_cache.get((prefix, max_age))
+            now = time.time()
+            if cached is not None and now - cached[0] < 1.0:
+                return self._ok(dict(cached[1]))
+            out = {}
+            for k, v in self._data.items():
+                # bool is an int subclass: a True/False flag under the prefix must
+                # not be read as a ~epoch-0 timestamp and reported forever-stale.
+                if k.startswith(prefix) and isinstance(v, (int, float)) and not isinstance(v, bool):
+                    age = now - v
+                    if age > max_age:
+                        out[k] = age
+            self._stale_cache[(prefix, max_age)] = (now, out)
+            return self._ok(dict(out))
+
+    def _op_prefix_clear(self, req: dict) -> dict:
+        """Delete every datum, list, set, and barrier whose key starts with `prefix` —
+        the GC hook that keeps per-iteration restart state (interruption records,
+        completion flags, old barriers) from accumulating for the job's lifetime."""
+        prefix = req["prefix"]
+        removed = 0
+        with self._cond:
+            for table in (self._data, self._lists, self._sets, self._barriers):
+                dead = [k for k in table if k.startswith(prefix)]
+                for k in dead:
+                    del table[k]
+                removed += len(dead)
+            self._stale_cache.clear()
+        return self._ok(removed)
 
 
 class KVClient:
@@ -487,6 +590,15 @@ class KVClient:
     def prefix_get(self, prefix: str) -> dict[str, Any]:
         return self._call({"op": "prefix_get", "prefix": prefix})
 
+    def prefix_clear(self, prefix: str) -> int:
+        return self._call({"op": "prefix_clear", "prefix": prefix})
+
+    def touch(self, key: str) -> None:
+        self._call({"op": "touch", "key": key})
+
+    def stale_keys(self, prefix: str, max_age: float) -> dict[str, float]:
+        return self._call({"op": "stale", "prefix": prefix, "max_age": max_age})
+
     def num_keys(self) -> int:
         return self._call({"op": "num_keys"})
 
@@ -512,6 +624,7 @@ class KVClient:
         world_size: int,
         timeout: float,
         wait: bool = True,
+        on_behalf: bool = False,
     ) -> Optional[int]:
         try:
             return self._call(
@@ -522,6 +635,7 @@ class KVClient:
                     "world_size": world_size,
                     "timeout": timeout,
                     "wait": wait,
+                    "on_behalf": on_behalf,
                 },
                 op_timeout=timeout if wait else 0.0,
             )
@@ -535,16 +649,14 @@ class KVClient:
 class StoreView:
     """A prefix-scoped coordination API over a :class:`KVClient`.
 
-    Implements the reference ``StoreMixin`` surface (``inprocess/store.py:48-311``):
-    named reentrant barriers, interruption records, terminated-rank sets, per-rank
-    heartbeats — every key-based operation consistently namespaced under ``prefix``.
-    ``scoped()`` derives a deeper view, the per-restart-iteration namespace pattern
-    (reference ``store.py:360 PrefixStore``, ``wrap.py:417``).
+    Provides the primitive surface of the reference's ``StoreMixin``
+    (``inprocess/store.py:48-311``): namespaced KV ops, named reentrant barriers, and
+    on-behalf barrier completion. The restart-protocol schema on top (interruption
+    records, terminated sets, heartbeats) lives in
+    ``inprocess/coordination.py:RestartCoordinator``. ``scoped()`` derives a deeper
+    view, the per-restart-iteration namespace pattern (reference ``store.py:360
+    PrefixStore``, ``wrap.py:417``).
     """
-
-    INTERRUPTION_RECORDS = "interruption_records"
-    TERMINATED_RANKS = "terminated_ranks"
-    HEARTBEAT_PREFIX = "heartbeat/"
 
     def __init__(self, client: KVClient, prefix: str = ""):
         self.client = client
@@ -589,6 +701,17 @@ class StoreView:
         start = len(self.prefix)
         return {k[start:]: v for k, v in raw.items()}
 
+    def prefix_clear(self, prefix: str) -> int:
+        return self.client.prefix_clear(self._k(prefix))
+
+    def touch(self, key: str) -> None:
+        self.client.touch(self._k(key))
+
+    def stale_keys(self, prefix: str, max_age: float) -> dict[str, float]:
+        raw = self.client.stale_keys(self._k(prefix), max_age)
+        start = len(self.prefix)
+        return {k[start:]: v for k, v in raw.items()}
+
     def list_append(self, key: str, value: Any) -> None:
         self.client.list_append(self._k(key), value)
 
@@ -604,8 +727,10 @@ class StoreView:
     def set_get(self, key: str) -> set:
         return self.client.set_get(self._k(key))
 
-    def barrier_join(self, name, rank, world_size, timeout, wait=True):
-        return self.client.barrier_join(self._k(name), rank, world_size, timeout, wait)
+    def barrier_join(self, name, rank, world_size, timeout, wait=True, on_behalf=False):
+        return self.client.barrier_join(
+            self._k(name), rank, world_size, timeout, wait, on_behalf
+        )
 
     def barrier_status(self, name: str) -> Optional[dict]:
         return self.client.barrier_status(self._k(name))
@@ -617,38 +742,7 @@ class StoreView:
 
     def complete_barrier_for(self, name: str, rank: int, world_size: int) -> None:
         """Join `name` on behalf of (possibly dead) `rank` without blocking."""
-        self.barrier_join(name, rank, world_size, timeout=0.0, wait=False)
-
-    def record_interrupted(self, record) -> None:
-        self.list_append(self.INTERRUPTION_RECORDS, record)
-
-    def get_interruption_records(self) -> list:
-        return self.list_get(self.INTERRUPTION_RECORDS)
-
-    def clear_interruption_records(self) -> None:
-        self.list_clear(self.INTERRUPTION_RECORDS)
-
-    def record_terminated_ranks(self, ranks: Iterable[int]) -> int:
-        return self.set_add(self.TERMINATED_RANKS, ranks)
-
-    def get_terminated_ranks(self) -> set[int]:
-        return self.set_get(self.TERMINATED_RANKS)
-
-    def send_heartbeat(self, rank: int, timestamp: float | None = None) -> None:
-        self.set(
-            f"{self.HEARTBEAT_PREFIX}{rank}",
-            time.time() if timestamp is None else timestamp,
-        )
-
-    def get_heartbeats(self) -> dict[int, float]:
-        raw = self.prefix_get(self.HEARTBEAT_PREFIX)
-        out = {}
-        for k, v in raw.items():
-            try:
-                out[int(k.rsplit("/", 1)[-1])] = v
-            except ValueError:
-                continue
-        return out
+        self.barrier_join(name, rank, world_size, timeout=0.0, wait=False, on_behalf=True)
 
 
 class CoordStore(StoreView):
