@@ -1,0 +1,46 @@
+"""CLI plumbing: ft-param extraction, script-arg boundaries, endpoint locality."""
+
+from tpu_resiliency.launcher.launch import (
+    endpoint_is_local,
+    extract_ft_params,
+    parse_nnodes,
+    split_at_script,
+)
+
+
+def test_parse_nnodes():
+    assert parse_nnodes("3") == (3, 3)
+    assert parse_nnodes("2:5") == (2, 5)
+
+
+def test_split_at_script():
+    head, tail = split_at_script(
+        ["--nproc-per-node", "2", "--no-ft-monitors", "train.py", "--lr", "3e-4"]
+    )
+    assert head == ["--nproc-per-node", "2", "--no-ft-monitors"]
+    assert tail == ["train.py", "--lr", "3e-4"]
+
+
+def test_ft_params_extracted_only_before_script():
+    argv = [
+        "--nproc-per-node", "1",
+        "--ft-param-safety_factor", "2.5",
+        "--ft-param-log_level=DEBUG",
+        "train.py",
+        "--ft-param-foo", "belongs-to-script",
+    ]
+    rest, ns = extract_ft_params(argv)
+    assert rest == ["--nproc-per-node", "1", "train.py", "--ft-param-foo", "belongs-to-script"]
+    assert ns.ft_param_safety_factor == "2.5"
+    assert ns.ft_param_log_level == "DEBUG"
+    assert not hasattr(ns, "ft_param_foo")
+
+
+def test_endpoint_is_local():
+    assert endpoint_is_local("127.0.0.1")
+    assert endpoint_is_local("localhost")
+    assert endpoint_is_local("")
+    import socket
+
+    assert endpoint_is_local(socket.gethostname())
+    assert not endpoint_is_local("some-other-host.invalid")

@@ -1,0 +1,126 @@
+"""WorkerGroup subprocess management: env injection, polling, error files, stop."""
+
+import os
+import signal
+import textwrap
+import time
+
+from tpu_resiliency.launcher.errors import WorkerError, write_error_file
+from tpu_resiliency.launcher.proc import GroupState, WorkerGroup
+
+
+def wait_state(group, want, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        state = group.poll()
+        if state is want:
+            return state
+        time.sleep(0.05)
+    return group.poll()
+
+
+def test_success_and_env(tmp_path):
+    out = tmp_path / "env_{rank}.txt"
+    script = tmp_path / "w.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import os
+            path = {str(out)!r}.format(rank=os.environ["RANK"])
+            with open(path, "w") as f:
+                f.write(",".join(os.environ[k] for k in
+                    ("RANK", "LOCAL_RANK", "WORLD_SIZE", "LOCAL_WORLD_SIZE",
+                     "NODE_RANK", "TPU_FT_RESTART_COUNT")))
+            """
+        )
+    )
+    group = WorkerGroup(
+        argv=[str(script)],
+        nproc=2,
+        base_env={"NODE_RANK": "3"},
+        run_dir=str(tmp_path / "run"),
+    )
+    group.start(round_no=7, first_global_rank=6, world_size=8)
+    assert wait_state(group, GroupState.SUCCEEDED) is GroupState.SUCCEEDED
+    group.reap()
+    assert group.exitcodes() == {6: 0, 7: 0}
+    assert (tmp_path / "env_6.txt").read_text() == "6,0,8,2,3,7"
+    assert (tmp_path / "env_7.txt").read_text() == "7,1,8,2,3,7"
+
+
+def test_failure_collects_error_file(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text(
+        textwrap.dedent(
+            """
+            import os
+            from tpu_resiliency.launcher.errors import record
+
+            @record
+            def main():
+                if os.environ["RANK"] == "1":
+                    raise ValueError("rank one always dies")
+
+            main()
+            """
+        )
+    )
+    group = WorkerGroup(
+        argv=[str(script)], nproc=2, base_env={}, run_dir=str(tmp_path / "run")
+    )
+    group.start(round_no=0, first_global_rank=0, world_size=2)
+    assert wait_state(group, GroupState.FAILED) is GroupState.FAILED
+    group.stop()
+    failures = group.failures()
+    assert [f.global_rank for f in failures] == [1]
+    f = failures[0]
+    assert f.exitcode == 1
+    assert f.error is not None
+    assert f.error.exception_type == "ValueError"
+    assert "rank one always dies" in f.error.message
+    assert "ValueError" in f.error.traceback
+    assert "rank 1" in f.describe() and "ValueError" in f.describe()
+
+
+def test_stop_terminates_sleepers(tmp_path):
+    script = tmp_path / "sleep.py"
+    script.write_text("import time; time.sleep(600)")
+    group = WorkerGroup(
+        argv=[str(script)], nproc=2, base_env={}, run_dir=str(tmp_path / "run")
+    )
+    group.start(round_no=0, first_global_rank=0, world_size=2)
+    assert group.poll() is GroupState.RUNNING
+    t0 = time.monotonic()
+    group.stop(grace=5.0)
+    assert time.monotonic() - t0 < 10.0
+    codes = group.exitcodes()
+    assert all(c is not None and c != 0 for c in codes.values())
+
+
+def test_log_capture(tmp_path):
+    script = tmp_path / "talk.py"
+    script.write_text("import os, sys; print('out', os.environ['RANK']); print('err', file=sys.stderr)")
+    group = WorkerGroup(
+        argv=[str(script)],
+        nproc=1,
+        base_env={},
+        run_dir=str(tmp_path / "run"),
+        log_dir=str(tmp_path / "logs"),
+    )
+    group.start(round_no=2, first_global_rank=5, world_size=6)
+    wait_state(group, GroupState.SUCCEEDED)
+    group.reap()
+    d = tmp_path / "logs" / "round_2" / "rank_5"
+    assert (d / "stdout.log").read_text() == "out 5\n"
+    assert (d / "stderr.log").read_text() == "err\n"
+
+
+def test_error_file_roundtrip(tmp_path):
+    path = str(tmp_path / "err.json")
+    try:
+        raise RuntimeError("direct write")
+    except RuntimeError as e:
+        write_error_file(e, path)
+    err = WorkerError.from_file(path)
+    assert err.message == "direct write" and err.exception_type == "RuntimeError"
+    assert err.pid == os.getpid() and err.timestamp > 0

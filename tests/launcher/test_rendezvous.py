@@ -1,0 +1,169 @@
+"""Rendezvous state-machine tests: joins, spares, restart epochs, dead-node pruning."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import FaultToleranceError
+from tpu_resiliency.launcher.rendezvous import RendezvousSettings, StoreRendezvous
+from tpu_resiliency.platform.store import CoordStore
+
+
+def make_rdzv(port, node_id, **kw):
+    defaults = dict(
+        min_nodes=1,
+        max_nodes=1,
+        join_timeout=20.0,
+        last_call_timeout=0.3,
+        keep_alive_interval=0.1,
+        keep_alive_timeout=1.0,
+        poll_interval=0.05,
+    )
+    defaults.update(kw)
+    store = CoordStore("127.0.0.1", port, prefix="rdzv/")
+    return StoreRendezvous(store, node_id, RendezvousSettings(**defaults)), store
+
+
+def test_single_node(kv_server):
+    rdzv, store = make_rdzv(kv_server.port, "n0")
+    out = rdzv.next_round()
+    assert out.round == 0 and out.node_rank == 0 and out.active == ["n0"]
+    rdzv.stop_keepalive()
+    store.close()
+
+
+def test_multi_node_with_spare(kv_server):
+    """3 joiners, max 2: first two by join order become active, third is a spare."""
+    outs = {}
+
+    def join(nid):
+        rdzv, store = make_rdzv(kv_server.port, nid, min_nodes=2, max_nodes=2)
+        outs[nid] = rdzv.next_round()
+        rdzv.stop_keepalive()
+        store.close()
+
+    threads = [threading.Thread(target=join, args=(f"n{i}",)) for i in range(3)]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)  # deterministic join order
+    for t in threads:
+        t.join(20.0)
+    assert len(outs) == 3
+    rounds = {o.round for o in outs.values()}
+    assert rounds == {0}
+    actives = [nid for nid, o in outs.items() if not o.is_spare]
+    spares = [nid for nid, o in outs.items() if o.is_spare]
+    assert len(actives) == 2 and len(spares) == 1
+    ranks = sorted(outs[nid].node_rank for nid in actives)
+    assert ranks == [0, 1]
+
+
+def test_restart_round_includes_former_spare(kv_server):
+    """After a restart request, the next round re-ranks everyone — a former spare
+    can be promoted when a former active departs."""
+    r0, s0 = make_rdzv(kv_server.port, "a", min_nodes=2, max_nodes=2)
+    r1, s1 = make_rdzv(kv_server.port, "b", min_nodes=2, max_nodes=2)
+    r2, s2 = make_rdzv(kv_server.port, "c", min_nodes=2, max_nodes=2)
+    outs = {}
+    ts = []
+    for nid, r in (("a", r0), ("b", r1), ("c", r2)):
+        t = threading.Thread(target=lambda nid=nid, r=r: outs.update({nid: r.next_round()}))
+        t.start()
+        ts.append(t)
+        time.sleep(0.05)
+    for t in ts:
+        t.join(20.0)
+    assert outs["c"].is_spare
+    round0 = outs["c"].round
+    # Node "a" leaves for good; "b" requests a restart (as an agent would on a
+    # worker failure); b and c re-rendezvous.
+    r0.leave()
+    s0.close()
+    r1.request_restart("test")
+    outs2 = {}
+    ts = [
+        threading.Thread(target=lambda nid=nid, r=r: outs2.update({nid: r.next_round(round0)}))
+        for nid, r in (("b", r1), ("c", r2))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20.0)
+    assert not outs2["b"].is_spare and not outs2["c"].is_spare
+    assert outs2["b"].round > round0
+    assert sorted([outs2["b"].node_rank, outs2["c"].node_rank]) == [0, 1]
+    for r in (r1, r2):
+        r.stop_keepalive()
+    for s in (s1, s2):
+        s.close()
+
+
+def test_dead_node_pruned_from_open_round(kv_server):
+    """A joiner that dies before the round closes must not block it forever: the
+    leader prunes keep-alive-stale participants."""
+    # Dead node joins the open round but never keeps alive again.
+    r_dead, s_dead = make_rdzv(kv_server.port, "dead", min_nodes=2, max_nodes=3)
+    s_dead_view = s_dead  # join state manually: register participant + one ka touch
+    st = s_dead_view.try_get("state")
+    assert st is None
+    s_dead_view.set(
+        "state",
+        {
+            "round": 0,
+            "status": "open",
+            "seq": 1,
+            "participants": {"dead": 0},
+            "waiting": {},
+            "active": [],
+            "spares": [],
+        },
+    )
+    s_dead_view.touch("ka/dead")
+    time.sleep(1.2)  # let the dead node's keep-alive go stale
+    outs = {}
+
+    def join(nid):
+        rdzv, store = make_rdzv(kv_server.port, nid, min_nodes=2, max_nodes=3)
+        outs[nid] = rdzv.next_round()
+        rdzv.stop_keepalive()
+        store.close()
+
+    ts = [threading.Thread(target=join, args=(f"n{i}",)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20.0)
+    assert len(outs) == 2
+    for o in outs.values():
+        assert not o.is_spare
+        assert set(o.active) == {"n0", "n1"}  # the dead joiner was pruned
+    s_dead.close()
+
+
+def test_join_timeout(kv_server):
+    rdzv, store = make_rdzv(kv_server.port, "lonely", min_nodes=2, max_nodes=2, join_timeout=1.0)
+    with pytest.raises(FaultToleranceError):
+        rdzv.next_round()
+    rdzv.stop_keepalive()
+    store.close()
+
+
+def test_signals_roundtrip(kv_server):
+    rdzv, store = make_rdzv(kv_server.port, "n0")
+    assert rdzv.restart_epoch() == 0
+    rdzv.request_restart("why not")
+    assert rdzv.restart_epoch() == 1
+    assert rdzv.shutdown_reason() is None
+    rdzv.request_shutdown("done testing")
+    assert "done testing" in rdzv.shutdown_reason()
+    rdzv.mark_done(4)
+    assert rdzv.done_nodes(4) == {"n0"}
+    rdzv.set_health(True)
+    time.sleep(0.15)
+    rdzv.store.touch("ka/n0")
+    assert "n0" in rdzv.healthy_live_nodes()
+    rdzv.set_health(False, "broke")
+    assert "n0" not in rdzv.healthy_live_nodes()
+    rdzv.stop_keepalive()
+    store.close()

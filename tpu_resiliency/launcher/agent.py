@@ -1,0 +1,386 @@
+"""Per-host elastic agent: rendezvous → spawn monitors + workers → supervise → restart.
+
+Re-design of the reference's launcher/agent stack (``fault_tolerance/launcher.py``
+``LocalElasticAgent:126`` / ``_invoke_run_with_*_policy:281,350`` +
+``_torch_elastic_compat/agent/server/api.py`` ``SimpleElasticAgent``) on TPU-native
+substrate: membership and restart signalling ride the coordination KV store
+(``rendezvous.py``) instead of a c10d TCPStore fork; per-rank hang detection is the
+``watchdog`` monitor process (UDS), reference ``launcher.py:454 setup_rank_monitors``;
+rank control requests (exclude-node / shutdown, reference
+``_handle_control_requests_from_rank``, ``_ft_rendezvous.py:785-804``) arrive on the
+launcher's UDS socket.
+
+Restart policies (reference ``launcher.py:270-449``):
+
+- ``any-failed``: any worker failure anywhere triggers a full restart round.
+- ``min-healthy``: a failed node reports unhealthy and the job restarts only once at
+  least ``min_nodes`` healthy nodes are available — no thrash while hosts churn.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket as socketmod
+import time
+import uuid
+from typing import Optional
+
+from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.launcher.proc import GroupState, WorkerGroup
+from tpu_resiliency.launcher.rendezvous import (
+    RendezvousOutcome,
+    RendezvousSettings,
+    StoreRendezvous,
+)
+from tpu_resiliency.platform import ipc
+from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.watchdog.config import FaultToleranceConfig
+from tpu_resiliency.watchdog.data import WorkloadAction, WorkloadControlRequest
+from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
+from tpu_resiliency.watchdog.state_machine import RestarterStateMachine
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    argv: list[str]
+    nproc_per_node: int = 1
+    min_nodes: int = 1
+    max_nodes: int = 1
+    node_id: str = ""
+    max_restarts: int = 3
+    restart_policy: str = "any-failed"  # or "min-healthy"
+    monitor_interval: float = 0.5
+    last_call_timeout: float = 1.0
+    keep_alive_interval: float = 2.0
+    keep_alive_timeout: float = 20.0
+    upscaling_enabled: bool = False
+    term_grace: float = 15.0
+    run_dir: str = ""
+    log_dir: Optional[str] = None
+    use_python: bool = True
+    enable_ft_monitors: bool = True
+    store_host: str = "127.0.0.1"
+    store_port: int = 0
+
+    def __post_init__(self):
+        if not self.node_id:
+            self.node_id = f"{socketmod.gethostname()}-{uuid.uuid4().hex[:8]}"
+        if not self.run_dir:
+            self.run_dir = os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), f"tpu_ft_{os.getpid()}"
+            )
+        if self.restart_policy not in ("any-failed", "min-healthy"):
+            raise ValueError(f"unknown restart policy {self.restart_policy!r}")
+
+
+class WorkersFailed(RuntimeError):
+    def __init__(self, message: str, exitcodes: dict):
+        super().__init__(message)
+        self.exitcodes = exitcodes
+
+
+class ElasticAgent:
+    def __init__(self, cfg: AgentConfig, ft_cfg: FaultToleranceConfig, store: StoreView):
+        self.cfg = cfg
+        self.ft = ft_cfg
+        self.store = store
+        self.rdzv = StoreRendezvous(
+            store.scoped("rdzv"),
+            cfg.node_id,
+            RendezvousSettings(
+                min_nodes=cfg.min_nodes,
+                max_nodes=cfg.max_nodes,
+                last_call_timeout=cfg.last_call_timeout,
+                keep_alive_interval=cfg.keep_alive_interval,
+                keep_alive_timeout=cfg.keep_alive_timeout,
+                upscaling_enabled=cfg.upscaling_enabled,
+            ),
+        )
+        self.restarter = RestarterStateMachine("InJob", strict=False)
+        self._monitors: list = []
+        self._monitor_sockets: list[str] = []
+        self._ipc: Optional[ipc.IpcReceiver] = None
+        self._launcher_socket = os.path.join(self.cfg.run_dir, "launcher.sock")
+        self._restarts_used = 0
+        self._last_exitcodes: dict[int, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def run(self) -> dict[int, int]:
+        """Supervise until success, shutdown, exclusion, or restart budget exhausted.
+        Returns {global_rank: exitcode} of this node's last round on success."""
+        os.makedirs(self.cfg.run_dir, exist_ok=True)
+        self._ipc = ipc.IpcReceiver(self._launcher_socket)
+        self._ipc.start()
+        self.restarter.initialize()
+        prev_round = -1
+        try:
+            while True:
+                outcome = self.rdzv.next_round(prev_round)
+                prev_round = outcome.round
+                reason = self.rdzv.shutdown_reason()
+                if reason is not None:
+                    raise WorkersFailed(f"workload shut down: {reason}", {})
+                if outcome.is_spare:
+                    action = self._wait_as_spare(outcome)
+                else:
+                    action = self._run_round(outcome)
+                if action == "done":
+                    return self._last_exitcodes
+                if action == "excluded":
+                    log.info(f"[{self.cfg.node_id}] leaving the job (excluded)")
+                    self.rdzv.leave()
+                    return {}
+                # action == "restart": loop into the next rendezvous round
+        finally:
+            try:
+                self.rdzv.mark_exited()
+            except Exception:
+                pass
+            self.rdzv.stop_keepalive()
+            if self._ipc is not None:
+                self._ipc.stop()
+
+    # -- spare path --------------------------------------------------------
+
+    def _wait_as_spare(self, outcome: RendezvousOutcome) -> str:
+        """Idle in reserve: poll for a restart round (our chance to be promoted),
+        shutdown, or job completion (reference redundancy ranks,
+        ``_ft_rendezvous.py:302-338``)."""
+        log.info(f"[{self.cfg.node_id}] spare for round {outcome.round}; standing by")
+        epoch0 = outcome.epoch
+        while True:
+            time.sleep(self.cfg.monitor_interval)
+            try:
+                if self.rdzv.shutdown_reason() is not None:
+                    self._last_exitcodes = {}
+                    return "done"
+                if self.rdzv.restart_epoch() != epoch0:
+                    return "restart"
+                done = self.rdzv.done_nodes(outcome.round)
+                if done and set(outcome.active) <= done:
+                    self._last_exitcodes = {}
+                    return "done"
+                # A spare must also watch active liveness: if every active died at
+                # once (host loss), no survivor is left to request the restart that
+                # would promote us.
+                dead = self.rdzv.dead_nodes() & set(outcome.active)
+                if dead - done:
+                    self.rdzv.request_restart(
+                        f"spare {self.cfg.node_id} saw dead actives: {sorted(dead - done)}"
+                    )
+                    return "restart"
+            except StoreError:
+                # The store host left — the job is over; spares have nothing to do.
+                self._last_exitcodes = {}
+                return "done"
+            req = self._poll_control()
+            if req == "excluded":
+                return "excluded"
+
+    # -- active path -------------------------------------------------------
+
+    def _run_round(self, outcome: RendezvousOutcome) -> str:
+        cfg = self.cfg
+        node_rank = outcome.node_rank
+        world_size = outcome.num_nodes * cfg.nproc_per_node
+        first_rank = node_rank * cfg.nproc_per_node
+        log.info(
+            f"[{cfg.node_id}] round {outcome.round}: node_rank={node_rank} "
+            f"world={world_size} nodes={outcome.active} spares={outcome.spares}"
+        )
+        base_env = {
+            "NODE_RANK": str(node_rank),
+            "GROUP_RANK": str(node_rank),
+            "TPU_RESILIENCY_STORE_HOST": cfg.store_host,
+            "TPU_RESILIENCY_STORE_PORT": str(cfg.store_port),
+            ipc.LAUNCHER_SOCKET_ENV: self._launcher_socket,
+        }
+        group = WorkerGroup(
+            argv=cfg.argv,
+            nproc=cfg.nproc_per_node,
+            base_env=base_env,
+            run_dir=cfg.run_dir,
+            log_dir=cfg.log_dir,
+            use_python=cfg.use_python,
+        )
+        self._start_monitors(outcome.round)
+        if self._monitor_sockets:
+            sockets = list(self._monitor_sockets)
+            group.per_rank_env = lambda local: {ipc.MONITOR_SOCKET_ENV: sockets[local]}
+        try:
+            group.start(outcome.round, first_rank, world_size)
+            self.restarter.handling_start(f"round={outcome.round}")
+            self.restarter.handling_processing()
+            result = self._supervise(group, outcome)
+            self.restarter.handling_completed()
+            return result
+        finally:
+            if group.workers and group.poll() is GroupState.RUNNING:
+                # Unwinding on an exception (e.g. store loss) must not orphan the
+                # round's workers — they'd keep holding the TPU devices.
+                group.stop(cfg.term_grace)
+            self._stop_monitors()
+
+    def _supervise(self, group: WorkerGroup, outcome: RendezvousOutcome) -> str:
+        cfg = self.cfg
+        epoch0 = outcome.epoch
+        i_am_leader = outcome.node_rank == 0
+        self.rdzv.set_health(True)
+        while True:
+            time.sleep(cfg.monitor_interval)
+            state = group.poll()
+            if state is GroupState.SUCCEEDED:
+                group.reap()
+                self._last_exitcodes = {k: v for k, v in group.exitcodes().items()}
+                self.rdzv.mark_done(outcome.round)
+                return self._await_group_completion(outcome, epoch0)
+            if state is GroupState.FAILED:
+                return self._handle_failure(group, outcome)
+            # -- running: watch the control plane --------------------------
+            if self.rdzv.shutdown_reason() is not None:
+                group.stop(cfg.term_grace)
+                raise WorkersFailed(
+                    f"workload shut down: {self.rdzv.shutdown_reason()}", group.exitcodes()
+                )
+            if self.rdzv.restart_epoch() != epoch0:
+                log.info(f"[{cfg.node_id}] restart requested elsewhere; stopping workers")
+                group.stop(cfg.term_grace)
+                return "restart"
+            req = self._poll_control()
+            if req == "excluded":
+                group.stop(cfg.term_grace)
+                self.rdzv.request_restart(f"node {cfg.node_id} excluded by rank request")
+                return "excluded"
+            if req == "shutdown":
+                group.stop(cfg.term_grace)
+                raise WorkersFailed("workload shut down by rank request", group.exitcodes())
+            if i_am_leader:
+                self._leader_duties(outcome)
+
+    def _await_group_completion(self, outcome: RendezvousOutcome, epoch0: int) -> str:
+        """Local workers succeeded; hold until every active node reports done (or a
+        failure elsewhere pulls us into another round — any-failed semantics)."""
+        while True:
+            try:
+                done = self.rdzv.done_nodes(outcome.round)
+                if set(outcome.active) <= done:
+                    return "done"
+                if self.rdzv.shutdown_reason() is not None:
+                    return "done"
+                if self.rdzv.restart_epoch() != epoch0:
+                    return "restart"
+                dead = self.rdzv.dead_nodes() & set(outcome.active)
+                if dead - done:
+                    self.rdzv.request_restart(f"nodes died after our completion: {dead - done}")
+                    return "restart"
+            except StoreError:
+                # Store host gone after our own success ⇒ treat the round as done.
+                return "done"
+            time.sleep(self.cfg.monitor_interval)
+
+    def _handle_failure(self, group: WorkerGroup, outcome: RendezvousOutcome) -> str:
+        cfg = self.cfg
+        failures = group.failures()
+        for f in failures:
+            log.error(f"[{cfg.node_id}] worker failed: {f.describe()}")
+        group.stop(cfg.term_grace)
+        self._restarts_used += 1
+        if self._restarts_used > cfg.max_restarts:
+            self.rdzv.request_shutdown(
+                f"restart budget exhausted ({cfg.max_restarts}) after: "
+                f"{failures[0].describe() if failures else 'unknown'}"
+            )
+            self.restarter.aborted()
+            raise WorkersFailed(
+                f"workers failed and restart budget ({cfg.max_restarts}) exhausted: "
+                + "; ".join(f.describe() for f in failures),
+                group.exitcodes(),
+            )
+        if cfg.restart_policy == "min-healthy":
+            self.rdzv.set_health(False, failures[0].describe() if failures else "")
+            self._wait_min_healthy()
+        self.rdzv.request_restart(
+            f"node {cfg.node_id}: " + "; ".join(f.describe() for f in failures)
+        )
+        return "restart"
+
+    def _wait_min_healthy(self) -> None:
+        """min-healthy policy: hold the restart until at least ``min_nodes`` *live*
+        agents exist (reference ``_invoke_run_with_min_healthy_policy``,
+        ``launcher.py:350``). Liveness — a fresh keep-alive — is the criterion, not
+        last round's health flags: after a correlated failure every node flags
+        unhealthy, yet all of them are alive and ready for the next round; counting
+        flags would deadlock the whole fleet."""
+        cfg = self.cfg
+        epoch0 = self.rdzv.restart_epoch()
+        while True:
+            live = self.rdzv.live_nodes()
+            if len(live) >= cfg.min_nodes:
+                return
+            if self.rdzv.shutdown_reason() is not None:
+                return
+            if self.rdzv.restart_epoch() != epoch0:
+                return  # someone else already judged the fleet ready
+            log.info(
+                f"[{cfg.node_id}] min-healthy hold: {len(live)}/{cfg.min_nodes} live agents"
+            )
+            time.sleep(max(cfg.monitor_interval, 1.0))
+
+    def _leader_duties(self, outcome: RendezvousOutcome) -> None:
+        """Node-rank-0 extras each tick: evict dead nodes, trigger upscale rounds."""
+        dead = self.rdzv.dead_nodes() & set(outcome.active)
+        if dead:
+            self.rdzv.request_restart(f"dead nodes: {sorted(dead)}")
+            return
+        if self.cfg.upscaling_enabled and len(outcome.active) < self.cfg.max_nodes:
+            if self.rdzv.waiting_count() > 0:
+                self.rdzv.request_restart("upscale: new nodes waiting")
+
+    # -- control requests --------------------------------------------------
+
+    def _poll_control(self) -> Optional[str]:
+        """Drain rank → launcher control messages (reference
+        ``_handle_control_requests_from_rank``, ``_ft_rendezvous.py:785-804``)."""
+        if self._ipc is None:
+            return None
+        for msg in self._ipc.fetch():
+            if not isinstance(msg, WorkloadControlRequest):
+                log.warning(f"ignoring unknown control message {type(msg).__name__}")
+                continue
+            log.info(
+                f"[{self.cfg.node_id}] control request {msg.action.name} "
+                f"from rank {msg.sender.global_rank if msg.sender else '?'}: {msg.reason}"
+            )
+            if msg.action is WorkloadAction.ExcludeThisNode:
+                return "excluded"
+            if msg.action is WorkloadAction.ShutdownWorkload:
+                self.rdzv.request_shutdown(f"rank requested shutdown: {msg.reason}")
+                return "shutdown"
+        return None
+
+    # -- per-rank FT monitors ----------------------------------------------
+
+    def _start_monitors(self, round_no: int) -> None:
+        if not self.cfg.enable_ft_monitors:
+            return
+        self._monitor_sockets = []
+        for local in range(self.cfg.nproc_per_node):
+            path = os.path.join(self.cfg.run_dir, f"monitor_{local}.sock")
+            proc = RankMonitorServer.run_in_subprocess(self.ft, path)
+            self._monitors.append(proc)
+            self._monitor_sockets.append(path)
+
+    def _stop_monitors(self) -> None:
+        for proc in self._monitors:
+            proc.terminate()
+        for proc in self._monitors:
+            proc.join(timeout=10.0)
+            if proc.is_alive():
+                proc.kill()
+        self._monitors = []
+        self._monitor_sockets = []

@@ -1,0 +1,258 @@
+"""``tpu-ft-launcher`` CLI: the fault-tolerant elastic launcher.
+
+Analogue of the reference's ``ft_launcher`` console script
+(``fault_tolerance/launcher.py:2065 main``, CLI surface ``:739 LaunchConfig``): spawns
+``--nproc-per-node`` workers per host under a per-host elastic agent with per-rank
+hang monitors, restarts on failure up to ``--max-restarts``, supports elastic
+``--nnodes MIN:MAX`` with spares and optional upscaling, ``--restart-policy
+{any-failed,min-healthy}``, YAML fault-tolerance config with ``--ft-param-*``
+overrides (``config.py:144``), and per-round/per-rank log capture.
+
+Store hosting: the agent whose ``--rdzv-endpoint`` port is free on the local machine
+binds the coordination KVServer itself (rank-0-hosts pattern); everyone else connects
+as a client. A multi-host job therefore needs no separate store daemon — start the
+first agent on the endpoint host.
+
+Example::
+
+    tpu-ft-launcher --nproc-per-node 4 --nnodes 2:3 \\
+        --rdzv-endpoint host0:29511 --max-restarts 5 train.py --lr 3e-4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+from tpu_resiliency.launcher.agent import AgentConfig, ElasticAgent, WorkersFailed
+from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore, KVServer
+from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.watchdog.config import FaultToleranceConfig
+
+log = get_logger(__name__)
+
+STORE_PREFIX = "launcher/"
+
+
+def parse_nnodes(spec: str) -> tuple[int, int]:
+    if ":" in spec:
+        lo, hi = spec.split(":", 1)
+        return int(lo), int(hi)
+    n = int(spec)
+    return n, n
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-ft-launcher",
+        description="Fault-tolerant elastic launcher for TPU training workloads.",
+        allow_abbrev=False,
+    )
+    p.add_argument("--nproc-per-node", type=int, default=1)
+    p.add_argument(
+        "--nnodes",
+        default="1",
+        help="node count, fixed ('2') or elastic range ('MIN:MAX'); surplus joiners "
+        "become spares (the reference's redundancy list)",
+    )
+    p.add_argument("--rdzv-endpoint", default="127.0.0.1:29511", help="host:port of the store")
+    p.add_argument("--node-id", default="", help="stable node identity (default: generated)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument(
+        "--restart-policy", choices=("any-failed", "min-healthy"), default="any-failed"
+    )
+    p.add_argument("--monitor-interval", type=float, default=0.5)
+    p.add_argument(
+        "--rdzv-last-call",
+        type=float,
+        default=1.0,
+        help="seconds the leader holds a rendezvous round open after min nodes arrive",
+    )
+    p.add_argument(
+        "--rdzv-keep-alive-interval", type=float, default=2.0,
+        help="agent keep-alive stamp period",
+    )
+    p.add_argument(
+        "--rdzv-keep-alive-timeout", type=float, default=20.0,
+        help="agents with keep-alives staler than this are treated as dead",
+    )
+    p.add_argument("--upscaling-enabled", action="store_true")
+    p.add_argument("--term-grace", type=float, default=15.0)
+    p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
+    p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
+    p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
+    p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
+    p.add_argument(
+        "--no-python",
+        action="store_true",
+        help="run the script as a raw executable instead of through the interpreter",
+    )
+    p.add_argument("script", help="training script (plus its args)")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+#: launcher flags that take no value — keep in sync with build_parser(); needed to
+#: find where the user's script starts without invoking argparse
+_STORE_TRUE_FLAGS = {
+    "--upscaling-enabled",
+    "--no-ft-monitors",
+    "--no-python",
+    "-h",
+    "--help",
+}
+
+
+def split_at_script(argv: list[str]) -> tuple[list[str], list[str]]:
+    """Split argv into (launcher args, script + script args): the script is the
+    first token that is neither an option nor an option's value."""
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a.startswith("-"):
+            i += 1 if (a in _STORE_TRUE_FLAGS or "=" in a) else 2
+        else:
+            return argv[:i], argv[i:]
+    return argv, []
+
+
+def extract_ft_params(argv: list[str]) -> tuple[list[str], argparse.Namespace]:
+    """Pull dynamic ``--ft-param-<field>[=| ]<value>`` options out of the *launcher's*
+    portion of argv (reference's ``--ft-param-*`` namespace, ``config.py:144``).
+    Tokens at or after the script name are left untouched — a ``--ft-param-*`` flag
+    there belongs to the user's script, not to us."""
+    head, tail = split_at_script(argv)
+    rest: list[str] = []
+    ns = argparse.Namespace()
+    i = 0
+    while i < len(head):
+        arg = head[i]
+        if arg.startswith("--ft-param-"):
+            body = arg[len("--ft-param-") :]
+            if "=" in body:
+                name, value = body.split("=", 1)
+            else:
+                name = body
+                i += 1
+                if i >= len(head):
+                    raise SystemExit(f"--ft-param-{name} requires a value")
+                value = head[i]
+            setattr(ns, f"ft_param_{name.replace('-', '_')}", value)
+        else:
+            rest.append(arg)
+        i += 1
+    return rest + tail, ns
+
+
+def endpoint_is_local(host: str) -> bool:
+    """Is the rendezvous endpoint this machine? Only then may we host the store —
+    a free port elsewhere must NOT seed a second, split-brain store."""
+    import socket as socketmod
+
+    if host in ("", "localhost", "127.0.0.1", "0.0.0.0", "::1"):
+        return True
+    hostname = socketmod.gethostname()
+    if host in (hostname, socketmod.getfqdn()):
+        return True
+    try:
+        ep_ips = {ai[4][0] for ai in socketmod.getaddrinfo(host, None)}
+    except OSError:
+        return False
+    local_ips = {"127.0.0.1", "::1"}
+    try:
+        local_ips |= {ai[4][0] for ai in socketmod.getaddrinfo(hostname, None)}
+    except OSError:
+        pass
+    return bool(ep_ips & local_ips)
+
+
+def host_or_connect_store(endpoint: str) -> tuple[CoordStore, Optional[KVServer], str, int]:
+    """Bind the KVServer on the endpoint port when the endpoint IS this machine and
+    the port is free; otherwise connect as a client.
+
+    First-local-agent-hosts: deterministic on one machine; in a multi-host job only
+    agents on the endpoint host ever try to bind, so remote agents cannot form an
+    isolated second store."""
+    host, _, port_s = endpoint.partition(":")
+    port = int(port_s or "29511")
+    auth_key = os.environ.get(AUTH_KEY_ENV) or None
+    server: Optional[KVServer] = None
+    client_host = host or "127.0.0.1"
+    if endpoint_is_local(host):
+        try:
+            bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
+            server = KVServer(host=bind_host, port=port, auth_key=auth_key)
+            port = server.port  # resolves port 0 → the ephemeral port actually bound
+            log.info(f"hosting coordination store on :{port}")
+            client_host = "127.0.0.1"
+        except OSError:
+            client_host = "127.0.0.1"
+    store = CoordStore(client_host, port, prefix=STORE_PREFIX, auth_key=auth_key)
+    return store, server, client_host, port
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    argv, ft_ns = extract_ft_params(argv)
+    args = build_parser().parse_args(argv)
+
+    base_ft = (
+        FaultToleranceConfig.from_yaml_file(args.ft_cfg_path)
+        if args.ft_cfg_path
+        else FaultToleranceConfig()
+    )
+    ft_cfg = FaultToleranceConfig.from_args(ft_ns, base=base_ft)
+
+    min_nodes, max_nodes = parse_nnodes(args.nnodes)
+    store, server, store_host, store_port = host_or_connect_store(args.rdzv_endpoint)
+    # Workers reach the store through the agent-visible address: if we host it,
+    # that's this machine; remote workers of other agents use their agent's view.
+    endpoint_host = args.rdzv_endpoint.partition(":")[0] or "127.0.0.1"
+    worker_store_host = "127.0.0.1" if server is not None else endpoint_host
+
+    cfg = AgentConfig(
+        argv=[args.script] + list(args.script_args),
+        nproc_per_node=args.nproc_per_node,
+        min_nodes=min_nodes,
+        max_nodes=max_nodes,
+        node_id=args.node_id,
+        max_restarts=args.max_restarts,
+        restart_policy=args.restart_policy,
+        monitor_interval=args.monitor_interval,
+        last_call_timeout=args.rdzv_last_call,
+        keep_alive_interval=args.rdzv_keep_alive_interval,
+        keep_alive_timeout=args.rdzv_keep_alive_timeout,
+        upscaling_enabled=args.upscaling_enabled,
+        term_grace=args.term_grace,
+        run_dir=args.run_dir,
+        log_dir=args.log_dir,
+        use_python=not args.no_python,
+        enable_ft_monitors=not args.no_ft_monitors,
+        store_host=worker_store_host,
+        store_port=store_port,
+    )
+    agent = ElasticAgent(cfg, ft_cfg, store)
+    try:
+        exitcodes = agent.run()
+        log.info(f"workload finished: exit codes {exitcodes}")
+        return 0
+    except WorkersFailed as e:
+        log.error(f"workload failed: {e}")
+        return 1
+    finally:
+        if server is not None:
+            # We host the control plane: closing it while peers still coordinate
+            # would rip the store out from under them — wait for their exit marks.
+            try:
+                agent.rdzv.await_peers_exit()
+            except Exception:
+                pass
+        store.close()
+        if server is not None:
+            server.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""Worker process group: spawn, poll, redirect, stop.
+
+The lean re-design of the reference's vendored torchelastic multiprocessing layer
+(``_torch_elastic_compat/multiprocessing/api.py`` ``start_processes``/``PContext``,
+std redirection/tee, ~2000 LoC): one ``subprocess.Popen`` per rank with per-rank
+log files and error files, a non-blocking group poll, and graceful→forceful stop.
+No fork-server indirection — TPU workers are always fresh interpreters (a forked JAX
+runtime is unusable anyway), so plain exec is both simpler and correct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import IO, Optional
+
+from tpu_resiliency.launcher.errors import ERROR_FILE_ENV, WorkerError
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class GroupState(enum.Enum):
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class Worker:
+    local_rank: int
+    global_rank: int
+    proc: subprocess.Popen
+    error_file: str
+    log_dir: Optional[str] = None
+    _stdout: Optional[IO] = None
+    _stderr: Optional[IO] = None
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def exitcode(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def error(self) -> Optional[WorkerError]:
+        return WorkerError.from_file(self.error_file)
+
+
+@dataclasses.dataclass
+class WorkerFailure:
+    local_rank: int
+    global_rank: int
+    exitcode: int
+    error: Optional[WorkerError]
+
+    def describe(self) -> str:
+        base = f"rank {self.global_rank} (local {self.local_rank}) exit {self.exitcode}"
+        if self.error is not None:
+            base += f": {self.error.exception_type}: {self.error.message}"
+        return base
+
+
+class WorkerGroup:
+    """One round's local workers. Start → poll → (stop | reap)."""
+
+    def __init__(
+        self,
+        argv: list[str],
+        nproc: int,
+        base_env: dict[str, str],
+        run_dir: str,
+        log_dir: Optional[str] = None,
+        use_python: bool = True,
+    ):
+        self.argv = argv
+        self.nproc = nproc
+        self.base_env = base_env
+        self.run_dir = run_dir
+        self.log_dir = log_dir
+        self.use_python = use_python
+        self.workers: list[Worker] = []
+        #: optional callable local_rank -> extra env (e.g. the per-rank monitor socket)
+        self.per_rank_env = None
+
+    def start(self, round_no: int, first_global_rank: int, world_size: int) -> None:
+        if self.workers:
+            raise RuntimeError("worker group already started")
+        os.makedirs(self.run_dir, exist_ok=True)
+        cmd = ([sys.executable] if self.use_python else []) + self.argv
+        for local in range(self.nproc):
+            grank = first_global_rank + local
+            env = dict(os.environ)
+            env.update(self.base_env)
+            if self.per_rank_env is not None:
+                env.update(self.per_rank_env(local))
+            error_file = os.path.join(self.run_dir, f"err_r{round_no}_rank{grank}.json")
+            if os.path.exists(error_file):
+                os.unlink(error_file)
+            env.update(
+                {
+                    "RANK": str(grank),
+                    "LOCAL_RANK": str(local),
+                    "WORLD_SIZE": str(world_size),
+                    "LOCAL_WORLD_SIZE": str(self.nproc),
+                    "TPU_FT_RESTART_COUNT": str(round_no),
+                    ERROR_FILE_ENV: error_file,
+                }
+            )
+            stdout = stderr = None
+            wlog_dir = None
+            if self.log_dir:
+                wlog_dir = os.path.join(self.log_dir, f"round_{round_no}", f"rank_{grank}")
+                os.makedirs(wlog_dir, exist_ok=True)
+                stdout = open(os.path.join(wlog_dir, "stdout.log"), "ab")
+                stderr = open(os.path.join(wlog_dir, "stderr.log"), "ab")
+            # Each worker leads its own session/process group so stop() can signal
+            # the whole tree — a worker's own subprocesses (dataloaders, shell
+            # wrappers) must not outlive it into the next restart round.
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=stdout,
+                stderr=stderr,
+                start_new_session=True,
+            )
+            self.workers.append(
+                Worker(
+                    local_rank=local,
+                    global_rank=grank,
+                    proc=proc,
+                    error_file=error_file,
+                    log_dir=wlog_dir,
+                    _stdout=stdout,
+                    _stderr=stderr,
+                )
+            )
+        log.info(
+            f"started {self.nproc} workers (global ranks "
+            f"{first_global_rank}..{first_global_rank + self.nproc - 1} of {world_size})"
+        )
+
+    def poll(self) -> GroupState:
+        codes = [w.exitcode for w in self.workers]
+        if any(c not in (0, None) for c in codes):
+            return GroupState.FAILED
+        if all(c == 0 for c in codes):
+            return GroupState.SUCCEEDED
+        return GroupState.RUNNING
+
+    def failures(self) -> list[WorkerFailure]:
+        return [
+            WorkerFailure(
+                local_rank=w.local_rank,
+                global_rank=w.global_rank,
+                exitcode=w.exitcode,
+                error=w.error(),
+            )
+            for w in self.workers
+            if w.exitcode not in (0, None)
+        ]
+
+    def exitcodes(self) -> dict[int, Optional[int]]:
+        return {w.global_rank: w.exitcode for w in self.workers}
+
+    @staticmethod
+    def _signal_tree(pid: int, sig: int) -> None:
+        """Signal the worker's whole process group (it leads one), falling back to
+        the single pid if the group is already gone."""
+        try:
+            os.killpg(pid, sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def stop(self, grace: float = 15.0, sig: int = int(signal.SIGTERM)) -> None:
+        """Graceful stop: `sig` (after SIGCONT, in case a worker is stopped), then
+        SIGKILL leftovers after `grace` (reference ``_shutdown_rank`` escalation,
+        ``rank_monitor_server.py:176``)."""
+        for w in self.workers:
+            if w.exitcode is None:
+                self._signal_tree(w.pid, signal.SIGCONT)
+                self._signal_tree(w.pid, sig)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            if all(w.exitcode is not None for w in self.workers):
+                break
+            time.sleep(0.1)
+        for w in self.workers:
+            if w.exitcode is None:
+                log.warning(f"worker rank {w.global_rank} ignored signal; SIGKILL")
+                self._signal_tree(w.pid, signal.SIGKILL)
+            else:
+                # Reap stragglers the dead leader left behind in its group.
+                self._signal_tree(w.pid, signal.SIGKILL)
+        for w in self.workers:
+            try:
+                w.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                log.error(f"worker pid {w.pid} unreapable")
+        self._close_logs()
+
+    def reap(self) -> None:
+        for w in self.workers:
+            if w.exitcode is None:
+                w.proc.wait()
+        self._close_logs()
+
+    def _close_logs(self) -> None:
+        for w in self.workers:
+            for f in (w._stdout, w._stderr):
+                if f is not None:
+                    try:
+                        f.close()
+                    except OSError:
+                        pass
+            w._stdout = w._stderr = None

@@ -1,0 +1,346 @@
+"""Elastic rendezvous: a CAS state machine over the coordination KV store.
+
+Re-design of the reference's forked dynamic rendezvous
+(``fault_tolerance/_ft_rendezvous.py`` + ``rendezvous/c10d_rendezvous_backend.py``):
+the same membership contract — nodes join an open round; once ``min_nodes`` have
+arrived the leader waits a short last call, then closes the round, ranking the first
+``max_nodes`` joiners as *active* and the surplus as *spares* (the reference's
+``redundancy_list``, ``_ft_rendezvous.py:302-338``); late arrivals register as
+*waiting* so agents can trigger an upscale round (``upscaling_enabled``) — but built
+on the store's atomic compare-and-set instead of a vendored 3k-LoC state machine.
+Node liveness rides server-clock keep-alive stamps (``touch``/``stale_keys``), the
+same mechanism the in-process layer uses, rather than a bespoke keep-alive protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from tpu_resiliency.exceptions import FaultToleranceError, StoreError
+from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class RendezvousSettings:
+    min_nodes: int = 1
+    max_nodes: int = 1
+    join_timeout: float = 600.0
+    #: after min_nodes arrive, how long the leader holds the round open so
+    #: stragglers can join (as actives up to max_nodes, then as spares)
+    last_call_timeout: float = 1.0
+    keep_alive_interval: float = 2.0
+    keep_alive_timeout: float = 20.0
+    upscaling_enabled: bool = False
+    poll_interval: float = 0.25
+
+
+@dataclasses.dataclass
+class RendezvousOutcome:
+    round: int
+    node_rank: Optional[int]  # None ⇒ this node is a spare
+    active: list[str]
+    spares: list[str]
+    #: restart epoch captured when the round closed — supervisors compare against
+    #: the live epoch to see restart requests, including ones raised while they
+    #: were still spawning workers (reading the epoch only at supervise start
+    #: would lose those)
+    epoch: int = 0
+
+    @property
+    def is_spare(self) -> bool:
+        return self.node_rank is None
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.active)
+
+
+class StoreRendezvous:
+    """Per-agent handle on the shared rendezvous state.
+
+    State blob under ``state``::
+
+        {"round": int, "status": "open"|"closed", "seq": int,
+         "participants": {node_id: join_seq}, "waiting": {node_id: seq},
+         "active": [node_id...], "spares": [node_id...]}
+
+    All transitions are optimistic CAS on the whole blob; contention is tiny
+    (node-count writers at restart boundaries only).
+    """
+
+    def __init__(self, store: StoreView, node_id: str, settings: RendezvousSettings):
+        self.store = store
+        self.node_id = node_id
+        self.s = settings
+        self._ka_thread: Optional[threading.Thread] = None
+        self._ka_stop = threading.Event()
+
+    # -- keep-alive --------------------------------------------------------
+
+    def start_keepalive(self) -> None:
+        if self._ka_thread is not None:
+            return
+        self._ka_stop.clear()
+
+        def loop():
+            while not self._ka_stop.is_set():
+                try:
+                    self.store.touch(f"ka/{self.node_id}")
+                except Exception:
+                    pass
+                self._ka_stop.wait(self.s.keep_alive_interval)
+
+        self._ka_thread = threading.Thread(target=loop, name="rdzv-keepalive", daemon=True)
+        self._ka_thread.start()
+
+    def stop_keepalive(self) -> None:
+        self._ka_stop.set()
+        if self._ka_thread is not None:
+            self._ka_thread.join(5.0)
+            self._ka_thread = None
+
+    def dead_nodes(self) -> set[str]:
+        """Nodes whose keep-alive went stale, by the server clock."""
+        stale = self.store.stale_keys("ka/", self.s.keep_alive_timeout)
+        return {k.split("/", 1)[1] for k in stale}
+
+    def live_nodes(self) -> set[str]:
+        """Every agent with a fresh keep-alive — the pool available for a round."""
+        all_known = {k.split("/", 1)[1] for k in self.store.prefix_get("ka/")}
+        return all_known - self.dead_nodes()
+
+    # -- global signals ----------------------------------------------------
+
+    def restart_epoch(self) -> int:
+        return int(self.store.try_get("restart", 0))
+
+    def request_restart(self, reason: str) -> None:
+        log.info(f"[{self.node_id}] requesting restart round: {reason}")
+        self.store.list_append("restart_reasons", (self.node_id, reason, time.time()))
+        self.store.add("restart", 1)
+
+    def request_shutdown(self, reason: str) -> None:
+        self.store.set("shutdown", f"{self.node_id}: {reason}")
+
+    def shutdown_reason(self) -> Optional[str]:
+        return self.store.try_get("shutdown")
+
+    def mark_done(self, round_no: int) -> None:
+        self.store.set(f"done/{round_no}/{self.node_id}", True)
+
+    def done_nodes(self, round_no: int) -> set[str]:
+        return {k.rsplit("/", 1)[1] for k in self.store.prefix_get(f"done/{round_no}/")}
+
+    def waiting_count(self) -> int:
+        state = self.store.try_get("state")
+        if not state or state.get("status") != "closed":
+            return 0
+        return len(state.get("waiting", {}))
+
+    def set_health(self, healthy: bool, detail: str = "") -> None:
+        self.store.set(f"health/{self.node_id}", (bool(healthy), detail))
+
+    def healthy_live_nodes(self) -> set[str]:
+        dead = self.dead_nodes()
+        out = set()
+        for k, v in self.store.prefix_get("health/").items():
+            node = k.split("/", 1)[1]
+            if node in dead:
+                continue
+            ok = v[0] if isinstance(v, (tuple, list)) else bool(v)
+            if ok:
+                out.add(node)
+        return out
+
+    # -- the round state machine ------------------------------------------
+
+    def _cas(self, expected, desired) -> bool:
+        ok, _ = self.store.compare_set("state", expected, desired)
+        return ok
+
+    def next_round(self, prev_round: int = -1) -> RendezvousOutcome:
+        """Block until a round numbered > `prev_round` closes with us placed in it."""
+        self.start_keepalive()
+        self.store.touch(f"ka/{self.node_id}")
+        deadline = time.monotonic() + self.s.join_timeout
+        min_reached_at: Optional[float] = None
+        me = self.node_id
+        while time.monotonic() < deadline:
+            try:
+                cur = self.store.try_get("state")
+            except StoreError:
+                if prev_round < 0:
+                    # Never placed and the control plane is gone: the job completed
+                    # (or died) without us — behave like an idle spare.
+                    return RendezvousOutcome(round=0, node_rank=None, active=[], spares=[])
+                raise FaultToleranceError(
+                    f"coordination store lost during re-rendezvous (node {me})"
+                )
+            # Case 1: no state yet, or the last closed round is stale → open anew.
+            if cur is None or (cur["status"] == "closed" and cur["round"] <= prev_round):
+                nxt = {
+                    "round": (cur["round"] + 1) if cur else 0,
+                    "status": "open",
+                    "seq": 1,
+                    "participants": {me: 0},
+                    "waiting": {},
+                    "active": [],
+                    "spares": [],
+                }
+                min_reached_at = None
+                self._cas(cur, nxt)
+                continue
+            # Case 2: a closed round newer than what we had.
+            if cur["status"] == "closed":
+                if me in cur["active"]:
+                    return RendezvousOutcome(
+                        round=cur["round"],
+                        node_rank=cur["active"].index(me),
+                        active=list(cur["active"]),
+                        spares=list(cur["spares"]),
+                        epoch=cur.get("epoch", 0),
+                    )
+                if me in cur["spares"]:
+                    return RendezvousOutcome(
+                        round=cur["round"],
+                        node_rank=None,
+                        active=list(cur["active"]),
+                        spares=list(cur["spares"]),
+                        epoch=cur.get("epoch", 0),
+                    )
+                # Late arrival: advertise for the next (upscale) round.
+                if me not in cur.get("waiting", {}):
+                    nxt = dict(cur)
+                    nxt["waiting"] = dict(cur.get("waiting", {}))
+                    nxt["waiting"][me] = nxt["seq"]
+                    nxt["seq"] += 1
+                    self._cas(cur, nxt)
+                    continue
+                active = set(cur["active"])
+                try:
+                    done = self.done_nodes(cur["round"])
+                    dead = self.dead_nodes()
+                except StoreError:
+                    if prev_round < 0:
+                        return RendezvousOutcome(
+                            round=cur["round"], node_rank=None,
+                            active=list(cur["active"]), spares=list(cur["spares"]),
+                        )
+                    raise
+                if active <= done:
+                    # The job finished without needing us: report as an idle spare
+                    # so the agent exits cleanly.
+                    return RendezvousOutcome(
+                        round=cur["round"], node_rank=None,
+                        active=list(cur["active"]), spares=list(cur["spares"]),
+                    )
+                if active and active <= (dead | done):
+                    # Every remaining active died and no survivor is left to call
+                    # a restart round — a waiting node must reopen itself or the
+                    # job is lost with standby capacity available.
+                    nxt = {
+                        "round": cur["round"] + 1,
+                        "status": "open",
+                        "seq": 1,
+                        "participants": {me: 0},
+                        "waiting": {},
+                        "active": [],
+                        "spares": [],
+                    }
+                    min_reached_at = None
+                    if self._cas(cur, nxt):
+                        log.info(f"[{me}] actives all dead; reopened round {cur['round'] + 1}")
+                    continue
+                time.sleep(self.s.poll_interval)
+                continue
+            # Case 3: an open round.
+            parts = cur["participants"]
+            if me not in parts:
+                nxt = dict(cur)
+                nxt["participants"] = dict(parts)
+                nxt["participants"][me] = nxt["seq"]
+                nxt["seq"] += 1
+                self._cas(cur, nxt)
+                continue
+            dead = self.dead_nodes()
+            live_parts = {n: s for n, s in parts.items() if n == me or n not in dead}
+            if len(live_parts) >= self.s.min_nodes:
+                if min_reached_at is None:
+                    min_reached_at = time.monotonic()
+                order = sorted(live_parts, key=live_parts.get)
+                i_am_leader = order[0] == me
+                # Always hold the last-call window once min is reached — even at
+                # full strength — so surplus joiners land as spares instead of
+                # missing the round (the reference's redundancy nodes join in the
+                # same completion window, ``_ft_rendezvous.py:302-338``).
+                last_call_over = time.monotonic() - min_reached_at >= self.s.last_call_timeout
+                if i_am_leader and last_call_over:
+                    active = order[: self.s.max_nodes]
+                    spares = order[self.s.max_nodes :]
+                    closed = {
+                        "round": cur["round"],
+                        "status": "closed",
+                        "seq": cur["seq"],
+                        "participants": dict(live_parts),
+                        "waiting": {},
+                        "active": active,
+                        "spares": spares,
+                        "epoch": self.restart_epoch(),
+                    }
+                    if self._cas(cur, closed):
+                        log.info(
+                            f"[{me}] closed rendezvous round {cur['round']}: "
+                            f"active={active} spares={spares}"
+                        )
+                    continue
+            time.sleep(self.s.poll_interval)
+        raise FaultToleranceError(
+            f"rendezvous did not complete within {self.s.join_timeout}s "
+            f"(node {me}, waiting for round > {prev_round})"
+        )
+
+    def mark_exited(self) -> None:
+        """Record that this agent's process is leaving (success or failure)."""
+        self.store.set(f"exit/{self.node_id}", True)
+
+    def await_peers_exit(self, timeout: float = 20.0) -> None:
+        """Store-host duty: hold the server up until every placed peer has either
+        marked itself exited or gone keep-alive-stale — otherwise closing the store
+        rips the control plane out from under agents still coordinating."""
+        state = self.store.try_get("state") or {}
+        peers = (
+            set(state.get("active", []))
+            | set(state.get("spares", []))
+            | set(state.get("waiting", {}))
+        )
+        peers.discard(self.node_id)
+        deadline = time.monotonic() + timeout
+        while peers and time.monotonic() < deadline:
+            exited = {k.split("/", 1)[1] for k in self.store.prefix_get("exit/")}
+            remaining = peers - exited
+            if not remaining:
+                return
+            if remaining <= self.dead_nodes():
+                return
+            time.sleep(0.2)
+
+    def leave(self) -> None:
+        """Best-effort departure: drop our keep-alive and waiting registration."""
+        self.stop_keepalive()
+        try:
+            self.store.delete(f"ka/{self.node_id}")
+            cur = self.store.try_get("state")
+            if cur and self.node_id in cur.get("waiting", {}):
+                nxt = dict(cur)
+                nxt["waiting"] = {
+                    n: s for n, s in cur["waiting"].items() if n != self.node_id
+                }
+                self._cas(cur, nxt)
+        except Exception:
+            pass
