@@ -90,12 +90,8 @@ def rank_main(rank: int, world: int, port: int, args, result_q) -> None:
         start_step = 0
         latest = mgr.find_latest()
         if latest >= 0:
-            hollow, tensors, meta = mgr.load(latest)
-            sd = PyTreeStateDict.__new__(PyTreeStateDict)
-            sd._tree, sd._hollow, sd._tensors, sd._shardings = hollow, True, None, None
-            sd.insert_tensors(tensors)
-            sd.restore_tensor_device()
-            params = sd.tree["params"]
+            tree, meta = mgr.load_tree(latest)
+            params = tree["params"]
             start_step = int(meta["iteration"]) + 1
             print(f"[rank {fs.initial_rank}] resumed from step {start_step}", flush=True)
 

@@ -1,0 +1,286 @@
+"""Integration-layer tests: loop protocol + the four callbacks, mirroring the
+reference's ``tests/ptl_resiliency/unit`` pattern (fake trainer driving callbacks,
+real monitor server behind an env-var socket)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_resiliency.integrations import (
+    Callback,
+    FaultToleranceCallback,
+    FaultToleranceSectionsCallback,
+    HierarchicalCheckpointCallback,
+    LoopContext,
+    StopTraining,
+    StragglerDetectionCallback,
+    run_training,
+)
+from tpu_resiliency.platform import ipc
+from tpu_resiliency.telemetry.detector import Detector
+from tpu_resiliency.watchdog.config import FaultToleranceConfig
+from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
+
+
+class Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def __getattribute__(self, name):
+        if name.startswith("on_"):
+            events = object.__getattribute__(self, "events")
+
+            def hook(ctx, *a):
+                events.append(name)
+
+            return hook
+        return object.__getattribute__(self, name)
+
+
+def test_loop_hook_order_and_state_threading():
+    rec = Recorder()
+
+    def step(state, i):
+        return state + 1
+
+    ctx = run_training(
+        step,
+        state=0,
+        num_steps=3,
+        callbacks=[rec],
+        checkpoint_every=2,
+        checkpoint_fn=lambda s, i: None,
+        validate_every=3,
+        validate_fn=lambda s, i: {"val": s},
+    )
+    assert ctx.state == 3
+    assert rec.events[0] == "on_train_start"
+    assert rec.events[-1] == "on_train_end"
+    assert rec.events.count("on_step_start") == 3
+    assert rec.events.count("on_step_end") == 3
+    assert rec.events.count("on_checkpoint_start") == 1
+    assert rec.events.count("on_validation_start") == 1
+    assert ctx.metrics["val"] == 3
+
+
+def test_loop_stop_training_cooperative():
+    class Stopper(Callback):
+        def on_step_end(self, ctx):
+            if ctx.step == 1:
+                raise StopTraining
+
+    ctx = run_training(lambda s, i: s + 1, 0, 10, callbacks=[Stopper()])
+    assert ctx.state == 2  # stopped after step 1 completed
+
+
+def test_loop_exception_fires_hook_and_propagates():
+    seen = []
+
+    class Witness(Callback):
+        def on_exception(self, ctx, exc):
+            seen.append(repr(exc))
+
+    def step(state, i):
+        if i == 1:
+            raise ValueError("boom")
+        return state
+
+    with pytest.raises(ValueError):
+        run_training(step, 0, 5, callbacks=[Witness()])
+    assert seen and "boom" in seen[0]
+
+
+@pytest.fixture
+def monitor(tmp_path):
+    sock = str(tmp_path / "m.sock")
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=30.0,
+        rank_heartbeat_timeout=30.0,
+        workload_check_interval=0.5,
+    )
+    proc = RankMonitorServer.run_in_subprocess(cfg, sock)
+    old = os.environ.get(ipc.MONITOR_SOCKET_ENV)
+    os.environ[ipc.MONITOR_SOCKET_ENV] = sock
+    yield sock
+    if old is None:
+        os.environ.pop(ipc.MONITOR_SOCKET_ENV, None)
+    else:
+        os.environ[ipc.MONITOR_SOCKET_ENV] = old
+    proc.terminate()
+    proc.join(timeout=10)
+
+
+def test_ft_callback_heartbeats_and_finished_flag(monitor, tmp_path):
+    flag = str(tmp_path / "finished.flag")
+    sd_path = str(tmp_path / "ft_state.pkl")
+    cb = FaultToleranceCallback(
+        autoresume=True, finished_flag_path=flag, state_dict_path=sd_path
+    )
+    ctx = run_training(lambda s, i: s + 1, 0, 5, callbacks=[cb])
+    assert ctx.state == 5
+    assert cb.machine.heartbeats >= 5
+    assert cb.machine.finished
+    assert os.path.exists(flag)
+    assert os.path.exists(sd_path)  # calculated timeouts persisted
+
+    # Second run: the finished flag short-circuits training (autoresume contract).
+    cb2 = FaultToleranceCallback(autoresume=True, finished_flag_path=flag)
+    ctx2 = run_training(lambda s, i: s + 1, 0, 5, callbacks=[cb2])
+    assert ctx2.state == 0 and ctx2.should_stop
+
+
+def test_ft_callback_simulated_fault(monitor):
+    from tpu_resiliency.integrations.ft_callbacks import SimulatedFault
+
+    cb = FaultToleranceCallback(simulated_fault_step=2)
+    with pytest.raises(SimulatedFault, match="simulated fault"):
+        run_training(lambda s, i: s + 1, 0, 5, callbacks=[cb])
+    assert cb.machine.exception_seen and not cb.machine.finished
+
+
+def test_ft_sections_callback(monitor):
+    cb = FaultToleranceSectionsCallback()
+    ctx = run_training(
+        lambda s, i: s + 1,
+        0,
+        4,
+        callbacks=[cb],
+        checkpoint_every=2,
+        checkpoint_fn=lambda s, i: None,
+    )
+    assert ctx.state == 4
+    calc = cb.client.timeouts_calc
+    assert set(calc.section_max_elapsed) >= {"setup", "step", "checkpointing"}
+    assert all(v >= 0 for v in calc.section_max_elapsed.values())
+
+
+def test_straggler_callback_reports(monkeypatch):
+    if Detector.initialized:
+        Detector.shutdown()
+    cb = StragglerDetectionCallback(report_time_interval=0.0, threshold=0.75)
+
+    def step(state, i):
+        time.sleep(0.002)
+        return state + 1
+
+    ctx = run_training(step, 0, 20, callbacks=[cb])
+    assert ctx.state == 20
+    assert cb.last_report is not None
+    assert any("train_step" in n for n in cb.last_report.section_names)
+    assert not Detector.initialized  # shut down on train end
+
+
+def test_hierarchical_checkpoint_callback(tmp_path):
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+
+    mgr = LocalCheckpointManager(str(tmp_path / "local"), rank=0)
+    cb = HierarchicalCheckpointCallback(
+        local_manager=mgr,
+        global_dir=str(tmp_path / "global"),
+        local_every=2,
+        global_every=4,
+        to_state_dict=lambda s: {"w": s},
+        from_state_dict=lambda s, loaded: loaded["w"],
+    )
+    os.makedirs(str(tmp_path / "global"), exist_ok=True)
+
+    def step(state, i):
+        return state + jnp.ones(())
+
+    ctx = run_training(step, jnp.zeros(()), 8, callbacks=[cb])
+    assert float(ctx.state) == 8.0
+    # Local checkpoints exist for steps 2,4,6,8; global for 4,8.
+    assert mgr.find_latest() == 8
+    assert cb.latest_global_step() == 8
+
+    # Restore path: local is newest → used.
+    ctx2 = LoopContext()
+    ctx2.state = jnp.zeros(())
+    assert cb.restore_latest(ctx2)
+    assert float(ctx2.state) == 8.0 and ctx2.start_step == 8
+    cb.close()
+
+
+def test_checkpoint_callback_prefers_newest_tier(tmp_path):
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+
+    mgr = LocalCheckpointManager(str(tmp_path / "local"), rank=0)
+    cb = HierarchicalCheckpointCallback(
+        local_manager=mgr,
+        global_dir=str(tmp_path / "global"),
+        local_every=3,
+        global_every=4,
+        to_state_dict=lambda s: {"w": s},
+        from_state_dict=lambda s, loaded: loaded["w"],
+    )
+    os.makedirs(str(tmp_path / "global"), exist_ok=True)
+    ctx = run_training(lambda s, i: s + jnp.ones(()), jnp.zeros(()), 4, callbacks=[cb])
+    # local at step 3, global at step 4 → global wins.
+    ctx2 = LoopContext()
+    ctx2.state = jnp.zeros(())
+    assert cb.restore_latest(ctx2)
+    assert ctx2.start_step == 4 and float(ctx2.state) == 4.0
+    cb.close()
+
+
+def test_checkpoint_callback_rank_suffixed_global_restore(tmp_path):
+    """Global checkpoints saved with a rank suffix must be discoverable again."""
+    cb = HierarchicalCheckpointCallback(
+        global_dir=str(tmp_path / "g"),
+        global_every=2,
+        rank=0,
+        to_state_dict=lambda s: {"w": s},
+        from_state_dict=lambda s, loaded: loaded["w"],
+    )
+    os.makedirs(str(tmp_path / "g"), exist_ok=True)
+    run_training(lambda s, i: s + jnp.ones(()), jnp.zeros(()), 4, callbacks=[cb])
+    assert cb.latest_global_step() == 4
+    ctx = LoopContext()
+    ctx.state = jnp.zeros(())
+    assert cb.restore_latest(ctx)
+    assert ctx.start_step == 4 and float(ctx.state) == 4.0
+    cb.close()
+
+
+def test_checkpoint_callback_driven_by_loop_brackets(monitor, tmp_path):
+    """save_now wired as checkpoint_fn: saves happen inside the loop's checkpoint
+    brackets so the sections callback attributes them to 'checkpointing'."""
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+
+    mgr = LocalCheckpointManager(str(tmp_path / "local"), rank=0)
+    ckpt_cb = HierarchicalCheckpointCallback(
+        local_manager=mgr,
+        local_every=2,
+        to_state_dict=lambda s: {"w": s},
+        from_state_dict=lambda s, loaded: loaded["w"],
+        driven_by_loop=True,
+    )
+    sections = FaultToleranceSectionsCallback()
+    ctx = run_training(
+        lambda s, i: s + jnp.ones(()),
+        jnp.zeros(()),
+        4,
+        callbacks=[sections, ckpt_cb],
+        checkpoint_every=ckpt_cb.cadence,
+        checkpoint_fn=ckpt_cb.save_now,
+    )
+    assert float(ctx.state) == 4.0
+    assert mgr.find_latest() == 4
+    assert sections.client.timeouts_calc.section_max_elapsed.get("checkpointing", 0) > 0
+    ckpt_cb.close()
+
+
+def test_cooperative_stop_does_not_write_finished_flag(monitor, tmp_path):
+    flag = str(tmp_path / "f.flag")
+
+    class StopAtTwo(Callback):
+        def on_step_end(self, ctx):
+            if ctx.step == 2:
+                raise StopTraining
+
+    cb = FaultToleranceCallback(autoresume=True, finished_flag_path=flag)
+    run_training(lambda s, i: s + 1, 0, 100, callbacks=[cb, StopAtTwo()])
+    assert not os.path.exists(flag)  # job is NOT finished — must be rescheduled

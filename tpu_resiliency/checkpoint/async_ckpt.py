@@ -56,9 +56,17 @@ class AsyncCheckpointer:
     def async_save(
         self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None
     ) -> AsyncRequest:
-        sd = PyTreeStateDict(tree)
-        sd.pop_tensors()
-        sd.copy_tensors_to_host()
+        """``tree`` may be a raw pytree or an already-hollowed ``PyTreeStateDict``
+        (lets a caller saving to several tiers pay the D2H copy once)."""
+        if isinstance(tree, PyTreeStateDict):
+            sd = tree
+            if not sd.is_hollow:
+                sd.pop_tensors()
+            sd.copy_tensors_to_host()
+        else:
+            sd = PyTreeStateDict(tree)
+            sd.pop_tensors()
+            sd.copy_tensors_to_host()
         hollow_bytes = self._hollow_bytes(sd)
         target = self._rank_path(path, rank)
         req = AsyncRequest(
@@ -93,13 +101,9 @@ class AsyncCheckpointer:
         if not os.path.exists(target):
             raise CheckpointError(f"no checkpoint at {target}")
         hollow_b, tensors, meta = ckpt_format.read_payload(target)
-        sd = PyTreeStateDict.__new__(PyTreeStateDict)
-        sd._tree = pickle.loads(hollow_b)
-        sd._hollow = True
-        sd._tensors = list(tensors)
-        sd._shardings = None
-        sd.restore_tensor_device(shardings=shardings, device=device)
-        sd.insert_tensors(sd._tensors)
+        sd = PyTreeStateDict.from_hollow(
+            pickle.loads(hollow_b), tensors, shardings=shardings, device=device
+        )
         return sd.tree, meta
 
     def maybe_finalize(self, blocking: bool = False) -> list[int]:

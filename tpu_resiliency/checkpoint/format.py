@@ -58,7 +58,8 @@ def resolve_dtype(name: str) -> np.dtype:
 
 def _raw_view(a: np.ndarray) -> memoryview:
     # Extension dtypes (bfloat16) don't support the buffer protocol; uint8 view does.
-    return memoryview(a.view(np.uint8)).cast("B")
+    # Flatten first: a 0-d array can't change dtype via view.
+    return memoryview(np.ascontiguousarray(a).reshape(-1).view(np.uint8)).cast("B")
 
 
 def write_payload(

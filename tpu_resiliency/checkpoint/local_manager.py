@@ -239,6 +239,20 @@ class LocalCheckpointManager:
             hollow_b, tensors, meta = ckpt_format.deserialize_from_bytes(blob)
         return pickle.loads(hollow_b), tensors, meta
 
+    def load_tree(
+        self,
+        iteration: Optional[int] = None,
+        shardings=None,
+        device=None,
+    ) -> tuple[Any, dict]:
+        """``load`` + rebuild: returns ``(tree, meta)`` with tensors re-inserted and
+        placed per ``shardings``/``device`` (or the default device)."""
+        from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+
+        hollow, tensors, meta = self.load(iteration)
+        sd = PyTreeStateDict.from_hollow(hollow, tensors, shardings=shardings, device=device)
+        return sd.tree, meta
+
     def _held_owners(self, iteration: int) -> set[int]:
         return {i.owner for i in self.local_ids() if i.iteration == iteration}
 

@@ -79,6 +79,26 @@ class PyTreeStateDict:
         self._tensors: Optional[list] = None
         self._shardings: Optional[list] = None
 
+    @classmethod
+    def from_hollow(
+        cls,
+        hollow_tree: Any,
+        tensors: Sequence[Any],
+        shardings: Optional[Sequence[Any]] = None,
+        device: Any = None,
+    ) -> "PyTreeStateDict":
+        """Rebuild a full state dict from a loaded (hollow skeleton, payload) pair,
+        placing tensors back on device — the standard restore path after
+        ``LocalCheckpointManager.load`` / ``ckpt_format.read_payload``."""
+        sd = cls.__new__(cls)
+        sd._tree = hollow_tree
+        sd._hollow = True
+        sd._tensors = list(tensors)
+        sd._shardings = None
+        sd.restore_tensor_device(shardings=shardings, device=device)
+        sd.insert_tensors(sd._tensors)
+        return sd
+
     # -- introspection -----------------------------------------------------
 
     @property

@@ -1,1 +1,32 @@
+"""L5 framework integration: training-loop callbacks (the ``ptl_resiliency`` analogue).
 
+The reference binds resiliency into PyTorch-Lightning; here the seam is a minimal
+callback protocol over a JAX train loop (``loop.py``), with the same four callbacks:
+FT heartbeats, FT sections, straggler detection, hierarchical checkpointing.
+"""
+
+from tpu_resiliency.integrations.checkpoint_callback import HierarchicalCheckpointCallback
+from tpu_resiliency.integrations.ft_callbacks import (
+    FaultToleranceCallback,
+    FaultToleranceSectionsCallback,
+)
+from tpu_resiliency.integrations.loop import (
+    Callback,
+    CallbackRunner,
+    LoopContext,
+    StopTraining,
+    run_training,
+)
+from tpu_resiliency.integrations.straggler_callback import StragglerDetectionCallback
+
+__all__ = [
+    "Callback",
+    "CallbackRunner",
+    "LoopContext",
+    "StopTraining",
+    "run_training",
+    "FaultToleranceCallback",
+    "FaultToleranceSectionsCallback",
+    "StragglerDetectionCallback",
+    "HierarchicalCheckpointCallback",
+]

@@ -1,0 +1,98 @@
+"""Straggler-detection callback: per-step section timing + periodic scored reports.
+
+Analogue of the reference's ``StragglerDetectionCallback``
+(``ptl_resiliency/straggler_det_callback.py``): wraps the training step into a
+detection section (``:91-98`` via ``Detector.wrap_callables``; here the loop hooks
+bracket the step directly), calls ``generate_report_if_interval_elapsed`` each step,
+logs best/worst scores, exports per-rank scores into ``ctx.metrics``, and optionally
+requests a cooperative stop when stragglers are found (``trainer.should_stop``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpu_resiliency.integrations.loop import Callback, LoopContext
+from tpu_resiliency.telemetry.detector import Detector
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class StragglerDetectionCallback(Callback):
+    def __init__(
+        self,
+        report_time_interval: float = 300.0,
+        calc_relative_scores: bool = True,
+        calc_individual_scores: bool = False,
+        threshold: float = 0.75,
+        stop_if_detected: bool = False,
+        export_metrics: bool = True,
+        profiling_interval: int = 1,
+        section_name: str = "train_step",
+        store=None,
+        use_pallas: bool = False,
+    ):
+        self.threshold = threshold
+        self.stop_if_detected = stop_if_detected
+        self.export_metrics = export_metrics
+        self.section_name = section_name
+        self._init_kwargs = dict(
+            scores_to_compute=(
+                (["relative_perf_scores"] if calc_relative_scores else [])
+                + (["individual_perf_scores"] if calc_individual_scores else [])
+            ),
+            report_time_interval=report_time_interval,
+            profiling_interval=profiling_interval,
+            store=store,
+            use_pallas=use_pallas,
+        )
+        self._section = None
+        self.last_report = None
+
+    def on_train_start(self, ctx: LoopContext) -> None:
+        Detector.initialize(
+            rank=ctx.rank, world_size=ctx.world_size, **self._init_kwargs
+        )
+
+    def on_step_start(self, ctx: LoopContext) -> None:
+        self._section = Detector.detection_section(self.section_name)
+        self._section.__enter__()
+
+    def on_step_end(self, ctx: LoopContext) -> None:
+        if self._section is not None:
+            self._section.__exit__(None, None, None)
+            self._section = None
+        report = Detector.generate_report_if_interval_elapsed()
+        if report is not None:
+            self._handle_report(ctx, report)
+
+    def on_train_end(self, ctx: LoopContext) -> None:
+        if self._section is not None:
+            self._section.__exit__(None, None, None)
+            self._section = None
+        Detector.shutdown()
+
+    # -- report handling ---------------------------------------------------
+
+    def _handle_report(self, ctx: LoopContext, report) -> None:
+        self.last_report = report
+        flat = dict(report.perf_scores or {})
+        if flat:
+            best = max(flat, key=flat.get)
+            worst = min(flat, key=flat.get)
+            log.info(
+                f"straggler report: best rank {best}={flat[best]:.3f} "
+                f"worst rank {worst}={flat[worst]:.3f}"
+            )
+            if self.export_metrics:
+                ctx.metrics["straggler/best_score"] = float(flat[best])
+                ctx.metrics["straggler/worst_score"] = float(flat[worst])
+        stragglers = report.identify_stragglers(
+            perf_threshold=self.threshold, section_threshold=self.threshold
+        )
+        if stragglers.by_perf or stragglers.by_section:
+            log.warning(f"stragglers detected: {stragglers}")
+            if self.export_metrics:
+                ctx.metrics["straggler/detected"] = stragglers
+            if self.stop_if_detected:
+                ctx.should_stop = True
