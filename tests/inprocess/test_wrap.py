@@ -175,6 +175,37 @@ class TestMultiRank:
         assert codes[1] == 7
         assert results[0] == ("ok", 1, 1)  # survivor re-entered with world 1
 
+    def test_system_exit_terminates_rank_not_restart(self):
+        """SystemExit must terminate the raising rank (re-raised, rank recorded
+        terminated) while peers restart without it — not spin the raiser through
+        restart rounds (ADVICE r1: reference restarts only on Exception)."""
+
+        def body(rank, q):
+            from tpu_resiliency.inprocess.wrap import CallWrapper
+
+            attempts = []
+
+            @fast_wrapper()
+            def train(call: CallWrapper):
+                attempts.append(call.iteration)
+                if rank == 1:
+                    raise SystemExit(5)
+                deadline = time.monotonic() + 60.0
+                while call.iteration == 0 and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                return ("ok", call.iteration, call.frozen_state.active_world_size)
+
+            try:
+                q.put((rank, train()))
+            except SystemExit as e:
+                q.put((rank, ("exit", e.code, len(attempts))))
+
+        results, codes = run_world(2, body, timeout=120.0)
+        # Rank 1 left exactly once — no restart loop for BaseException.
+        assert results[1] == ("exit", 5, 1)
+        # Rank 0 restarted into a world of 1.
+        assert results[0] == ("ok", 1, 1)
+
     def test_spare_rank_activates_on_failure(self):
         """3 ranks, active world capped at 2: rank 2 starts as a reserve spare and
         takes over when rank 1 dies."""

@@ -165,6 +165,34 @@ def test_barrier_elastic_world_resets_absences(kv_server):
     c.close()
 
 
+def test_barrier_del_is_exact(kv_server):
+    """barrier_del drops exactly one name — iteration 1's cleanup must not take
+    iteration 10's barrier with it (the prefix-match hazard, ADVICE r1)."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.barrier_join("barrier/iteration/1", rank=0, world_size=2, timeout=0.0, wait=False)
+    c.barrier_join("barrier/iteration/10", rank=0, world_size=2, timeout=0.0, wait=False)
+    assert c.barrier_del("barrier/iteration/1")
+    assert c.barrier_status("barrier/iteration/1") is None
+    assert c.barrier_status("barrier/iteration/10") is not None
+    assert not c.barrier_del("barrier/iteration/1")  # already gone
+    c.close()
+
+
+def test_barrier_proxy_only_world_change_resets_absences(kv_server):
+    """A round held open purely by proxy (on_behalf) joins re-opens cleanly when a
+    real join arrives under a different world size: the stale absences refer to the
+    old rank numbering and must not phantom-cover the new round (ADVICE r1)."""
+    c = CoordStore("127.0.0.1", kv_server.port)
+    c.complete_barrier_for("po", rank=3, world_size=4)  # proxy-only, round open at 4
+    assert c.barrier_status("po")["absent"] == {3}
+    c.barrier_join("po", rank=0, world_size=2, timeout=0.0, wait=False)
+    st = c.barrier_status("po")
+    assert st["absent"] == set() and st["generation"] == 0
+    c.barrier_join("po", rank=1, world_size=2, timeout=0.0, wait=False)
+    assert c.barrier_status("po")["generation"] == 1
+    c.close()
+
+
 def test_complete_barrier_for_dead_rank(kv_server):
     """A monitor completes the barrier on behalf of a dead rank
     (reference monitor_process.py:260-282)."""

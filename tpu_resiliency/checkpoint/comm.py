@@ -32,6 +32,25 @@ log = get_logger(__name__)
 P2P_MAX_FRAME = 16 * 1024**3
 
 
+def _reachable_host() -> str:
+    """Best-effort address peers on other hosts can dial: the address the kernel
+    would route external traffic from, falling back to hostname resolution, then
+    loopback (single-host case)."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))  # no packets sent; just picks a route
+            return s.getsockname()[0]
+    except OSError:
+        pass
+    try:
+        addr = socket.gethostbyname(socket.gethostname())
+        if not addr.startswith("127."):
+            return addr
+    except OSError:
+        pass
+    return "127.0.0.1"
+
+
 class StoreComm:
     """Object collectives over the coordination store, scoped to a rank group.
 
@@ -137,13 +156,19 @@ class PeerExchange:
         self._accept_thread: Optional[threading.Thread] = None
         self._addr_cache: dict[int, tuple[str, int]] = {}
 
-    def start(self, host: str = "127.0.0.1", advertise_host: Optional[str] = None) -> None:
+    def start(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
         self._sock.listen(128)
         port = self._sock.getsockname()[1]
-        self.store.set(f"addr/{self.rank}", (advertise_host or host, port))
+        if advertise_host is None:
+            # Replication cliques span hosts by design (replication_jump), so the
+            # advertised address must be reachable off-host: a wildcard bind
+            # advertises this host's resolvable name, a specific bind advertises
+            # itself.
+            advertise_host = _reachable_host() if host == "0.0.0.0" else host
+        self.store.set(f"addr/{self.rank}", (advertise_host, port))
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name=f"p2p-accept-{self.rank}", daemon=True
         )

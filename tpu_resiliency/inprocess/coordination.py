@@ -198,5 +198,7 @@ class RestartCoordinator:
         if iteration < 0:
             return
         self.store.prefix_clear(f"iter/{iteration}/")
-        self.store.prefix_clear(f"barrier/iteration/{iteration}")
-        self.store.prefix_clear(f"barrier/completion/{iteration}")
+        # Exact deletes: a prefix match on "barrier/iteration/1" would also take
+        # iterations 10..19 with it.
+        self.store.barrier_del(f"barrier/iteration/{iteration}")
+        self.store.barrier_del(f"barrier/completion/{iteration}")

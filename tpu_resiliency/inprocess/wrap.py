@@ -318,7 +318,7 @@ class CallWrapper:
                     restart = True
                 except (RestartAbort, HealthCheckError):
                     raise
-                except BaseException as e:
+                except Exception as e:
                     state.fn_exception = e
                     coord.record_interruption(
                         iteration, state.rank, Interruption.EXCEPTION, repr(e)
@@ -328,6 +328,27 @@ class CallWrapper:
                         f"rank {state.rank}: wrapped fn raised {e!r} (iter {iteration})"
                     )
                     restart = True
+                except BaseException as e:
+                    # SystemExit / KeyboardInterrupt (and other non-Exception
+                    # BaseExceptions) mean the rank is leaving, not restarting:
+                    # record it terminated so peers restart without us, run the
+                    # terminate chain, and re-raise (reference restarts only on
+                    # Exception; its outer handler re-raises, ``wrap.py:558``).
+                    state.fn_exception = e
+                    coord.record_interruption(
+                        iteration, state.rank, Interruption.TERMINATED, repr(e)
+                    )
+                    monitor.acknowledge(drain=False)
+                    try:
+                        monitor.shutdown()
+                    except Exception:
+                        pass
+                    log.warning(
+                        f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
+                    )
+                    self._chain(w.terminate, state.freeze())
+                    self._leave()
+                    raise
 
                 # ---- restart path ----
                 if self.monitor_process is not None:

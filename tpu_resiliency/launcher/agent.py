@@ -121,7 +121,24 @@ class ElasticAgent:
         try:
             while True:
                 outcome = self.rdzv.next_round(prev_round)
+                # The restart budget is charged once per restart *round*, whoever
+                # caused it — a job whose failures rotate across N nodes must not
+                # get N × max_restarts rounds, and a correlated k-node failure that
+                # bumps the epoch k times is still one round. Round numbers are
+                # global and bump exactly once per re-rendezvous, so the delta is
+                # the right unit (upscale rounds count too; they are rare and the
+                # alternative lets an epoch-less reopened round slip uncharged).
+                if prev_round >= 0 and outcome.round > prev_round:
+                    self._restarts_used += outcome.round - prev_round
                 prev_round = outcome.round
+                if self._restarts_used > self.cfg.max_restarts:
+                    self.rdzv.request_shutdown(
+                        f"restart budget exhausted ({self.cfg.max_restarts})"
+                    )
+                    self.restarter.aborted()
+                    raise WorkersFailed(
+                        f"restart budget ({self.cfg.max_restarts}) exhausted", {}
+                    )
                 reason = self.rdzv.shutdown_reason()
                 if reason is not None:
                     raise WorkersFailed(f"workload shut down: {reason}", {})
@@ -289,8 +306,9 @@ class ElasticAgent:
         for f in failures:
             log.error(f"[{cfg.node_id}] worker failed: {f.describe()}")
         group.stop(cfg.term_grace)
-        self._restarts_used += 1
-        if self._restarts_used > cfg.max_restarts:
+        # Budget accounting lives in run() (epoch deltas); here we only pre-check
+        # whether the round we are about to request would bust it.
+        if self._restarts_used + 1 > cfg.max_restarts:
             self.rdzv.request_shutdown(
                 f"restart budget exhausted ({cfg.max_restarts}) after: "
                 f"{failures[0].describe() if failures else 'unknown'}"

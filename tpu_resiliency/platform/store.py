@@ -330,6 +330,12 @@ class KVServer:
                         f"barrier {name!r}: world_size {world_size} != in-progress "
                         f"round's {b.world_size}"
                     )
+                # Proxy-only round (world size held open by on_behalf joins with no
+                # real arrivals): a join under a different world size re-opens the
+                # round; the first-join branch below then clears the stale absences
+                # (last_world != world_size always holds here), which must not
+                # phantom-cover the new rank numbering.
+                b.world_size = 0
             if b.world_size == 0:  # first join of a round
                 if b.last_world and b.last_world != world_size:
                     # Elastic membership change: stale absences refer to the old
@@ -373,6 +379,13 @@ class KVServer:
                         # everyone; callers treat timeout as fatal anyway.
                         raise TimeoutError
             return self._ok(b.generation)
+
+    def _op_barrier_del(self, req: dict) -> dict:
+        """Drop barrier `name` exactly (no prefix semantics — ``barrier/iter/1`` must
+        not take ``barrier/iter/10`` with it)."""
+        with self._cond:
+            existed = self._barriers.pop(req["name"], None) is not None
+        return self._ok(existed)
 
     def _op_barrier_status(self, req: dict) -> dict:
         with self._cond:
@@ -645,6 +658,9 @@ class KVClient:
     def barrier_status(self, name: str) -> Optional[dict]:
         return self._call({"op": "barrier_status", "name": name})
 
+    def barrier_del(self, name: str) -> bool:
+        return self._call({"op": "barrier_del", "name": name})
+
 
 class StoreView:
     """A prefix-scoped coordination API over a :class:`KVClient`.
@@ -734,6 +750,9 @@ class StoreView:
 
     def barrier_status(self, name: str) -> Optional[dict]:
         return self.client.barrier_status(self._k(name))
+
+    def barrier_del(self, name: str) -> bool:
+        return self.client.barrier_del(self._k(name))
 
     # -- restart-coordination API -----------------------------------------
 
