@@ -148,6 +148,39 @@ class TestPeerExchange:
 
         assert run_ranks(world, body)[1] == (b"A", b"B")
 
+    def test_authenticated_exchange(self, make_store):
+        """With an auth key, peers bind off-loopback and must pass the HMAC
+        challenge; an unauthenticated client is rejected."""
+        world = 2
+
+        def body(rank):
+            ex = PeerExchange(make_store(), rank, timeout=30.0, auth_key="s3cret")
+            ex.start()
+            try:
+                ex.send(1 - rank, "t", f"auth-{rank}".encode())
+                got = ex.recv(1 - rank, "t").decode()
+                if rank == 0:
+                    # A keyless client cannot deliver to an authenticated peer.
+                    bad = PeerExchange(make_store(), 7, timeout=5.0, auth_key=None)
+                    try:
+                        bad.send(1, "t", b"evil")
+                        delivered = True
+                    except Exception:
+                        delivered = False
+                    return (got, delivered)
+                return got
+            finally:
+                ex.close()
+
+        results = run_ranks(world, body)
+        assert results[0] == ("auth-1", False)
+        assert results[1] == "auth-0"
+
+    def test_non_loopback_bind_requires_key(self, make_store):
+        ex = PeerExchange(make_store(), 0, auth_key=None)
+        with pytest.raises(ValueError):
+            ex.start(host="0.0.0.0")
+
 
 class TestCliqueReplication:
     def test_replicate_within_clique(self, make_store):

@@ -17,13 +17,16 @@ accelerator interconnect is reserved for the training program; checkpoint coordi
 
 from __future__ import annotations
 
+import hmac
+import os
+import secrets
 import socket
 import threading
 from typing import Any, Optional
 
 from tpu_resiliency.exceptions import CheckpointError, StoreTimeoutError
 from tpu_resiliency.platform import framing
-from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.platform.store import AUTH_KEY_ENV, StoreView, _hmac
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -145,10 +148,19 @@ class PeerExchange:
     (``checkpointing/local/replication/group_utils.py:394-465``).
     """
 
-    def __init__(self, store: StoreView, rank: int, timeout: float = 300.0):
+    def __init__(
+        self,
+        store: StoreView,
+        rank: int,
+        timeout: float = 300.0,
+        auth_key: Optional[str] = None,
+    ):
         self.store = store.scoped("p2p")
         self.rank = rank
         self.timeout = timeout
+        if auth_key is None:
+            auth_key = os.environ.get(AUTH_KEY_ENV) or None
+        self.auth_key = auth_key
         self._sock: Optional[socket.socket] = None
         self._inbox: dict[tuple[int, str], list[bytes]] = {}
         self._cond = threading.Condition()
@@ -156,7 +168,28 @@ class PeerExchange:
         self._accept_thread: Optional[threading.Thread] = None
         self._addr_cache: dict[int, tuple[str, int]] = {}
 
-    def start(self, host: str = "0.0.0.0", advertise_host: Optional[str] = None) -> None:
+    def start(self, host: Optional[str] = None, advertise_host: Optional[str] = None) -> None:
+        """Bind the listener and publish its address.
+
+        Frames are pickled, so an unauthenticated off-host listener would be remote
+        code execution. The rules mirror :class:`KVServer`: with an auth key (arg or
+        ``$TPU_RESILIENCY_STORE_KEY``) the default bind is ``0.0.0.0`` and every
+        accepted connection must pass an HMAC challenge; without one the default is
+        loopback, and an explicit non-loopback bind raises.
+        """
+        if host is None:
+            host = "0.0.0.0" if self.auth_key else "127.0.0.1"
+            if not self.auth_key:
+                log.warning(
+                    "PeerExchange: no auth key set — binding loopback only; "
+                    f"cross-host replication requires ${AUTH_KEY_ENV}"
+                )
+        elif host not in ("127.0.0.1", "localhost", "::1") and not self.auth_key:
+            raise ValueError(
+                f"refusing to bind PeerExchange on non-loopback {host!r} without an "
+                f"auth key (frames are pickled; unauthenticated exposure is remote "
+                f"code execution). Pass auth_key= or set ${AUTH_KEY_ENV}."
+            )
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, 0))
@@ -196,6 +229,8 @@ class PeerExchange:
 
     def _recv_conn(self, conn: socket.socket) -> None:
         try:
+            if not self._handshake_server(conn):
+                return
             msg = framing.recv_obj(conn, max_frame=P2P_MAX_FRAME)
             src, tag, blob = msg["src"], msg["tag"], msg["blob"]
             with self._cond:
@@ -208,6 +243,32 @@ class PeerExchange:
                 conn.close()
             except OSError:
                 pass
+
+    def _handshake_server(self, conn: socket.socket) -> bool:
+        """Challenge/response before any pickled payload is parsed (mirrors
+        ``KVServer._handshake``). No-op when auth is off (loopback-only bind)."""
+        nonce = secrets.token_bytes(16)
+        framing.send_obj(conn, {"v": 1, "auth": self.auth_key is not None, "nonce": nonce})
+        if self.auth_key is None:
+            return True
+        conn.settimeout(30.0)
+        reply = framing.recv_obj(conn, max_frame=1024)
+        ok = isinstance(reply, dict) and hmac.compare_digest(
+            reply.get("mac", b""), _hmac(self.auth_key, nonce)
+        )
+        if not ok:
+            log.warning("p2p: rejected connection with bad auth")
+        conn.settimeout(None)
+        return ok
+
+    def _handshake_client(self, conn: socket.socket) -> None:
+        hello = framing.recv_obj(conn, max_frame=1024)
+        if isinstance(hello, dict) and hello.get("auth"):
+            if self.auth_key is None:
+                raise CheckpointError(
+                    f"p2p peer requires authentication; set ${AUTH_KEY_ENV}"
+                )
+            framing.send_obj(conn, {"mac": _hmac(self.auth_key, hello["nonce"])})
 
     def _peer_addr(self, peer: int) -> tuple[str, int]:
         if peer not in self._addr_cache:
@@ -223,6 +284,7 @@ class PeerExchange:
         host, port = self._peer_addr(dst)
         with socket.create_connection((host, port), timeout=self.timeout) as conn:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._handshake_client(conn)
             framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
 
     def recv(self, src: int, tag: str, timeout: Optional[float] = None) -> bytes:

@@ -236,6 +236,17 @@ class CallWrapper:
             # Dropping the link makes the monitor treat us as dead → barrier proxy.
             self.monitor_process.abandon()
 
+    def _terminate_and_leave(self, monitor, state) -> None:
+        """Rank-departure cleanup shared by the abort and BaseException exits:
+        silence the monitor, run the terminate chain, and leave the job."""
+        monitor.acknowledge(drain=False)
+        try:
+            monitor.shutdown()
+        except Exception:
+            pass
+        self._chain(self.w.terminate, state.freeze())
+        self._leave()
+
     def _shutdown_clean(self) -> None:
         try:
             self.coord.set_job_done()
@@ -338,16 +349,10 @@ class CallWrapper:
                     coord.record_interruption(
                         iteration, state.rank, Interruption.TERMINATED, repr(e)
                     )
-                    monitor.acknowledge(drain=False)
-                    try:
-                        monitor.shutdown()
-                    except Exception:
-                        pass
                     log.warning(
                         f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
                     )
-                    self._chain(w.terminate, state.freeze())
-                    self._leave()
+                    self._terminate_and_leave(monitor, state)
                     raise
 
                 # ---- restart path ----
@@ -398,13 +403,7 @@ class CallWrapper:
                 gc.collect()
             except (RestartAbort, HealthCheckError) as e:
                 log.error(f"rank {state.rank}: leaving restart loop: {e!r}")
-                monitor.acknowledge(drain=False)
-                try:
-                    monitor.shutdown()
-                except Exception:
-                    pass
-                self._chain(w.terminate, state.freeze())
-                self._leave()
+                self._terminate_and_leave(monitor, state)
                 raise
             finally:
                 if not restart and monitor._thread.is_alive():

@@ -5,12 +5,15 @@ from tpu_resiliency.telemetry.reporting import Report, ReportGenerator, Straggle
 from tpu_resiliency.telemetry.ring_buffer import DeviceRings, HostRingBuffer
 from tpu_resiliency.telemetry.scoring import (
     TelemetryScores,
+    make_sharded_scorer,
     masked_median,
     masked_total,
     robust_z,
     score_round,
     score_round_jit,
+    score_round_sharded,
 )
+from tpu_resiliency.telemetry.sharded import MeshTelemetry, TelemetryState
 from tpu_resiliency.telemetry.statistics import ALL_STATISTICS, Statistic, compute_stats
 
 __all__ = [
@@ -25,11 +28,15 @@ __all__ = [
     "DeviceRings",
     "HostRingBuffer",
     "TelemetryScores",
+    "MeshTelemetry",
+    "TelemetryState",
+    "make_sharded_scorer",
     "masked_median",
     "masked_total",
     "robust_z",
     "score_round",
     "score_round_jit",
+    "score_round_sharded",
     "Statistic",
     "ALL_STATISTICS",
     "compute_stats",

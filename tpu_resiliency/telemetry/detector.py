@@ -89,6 +89,7 @@ class Detector:
     _wrapped: list = []
     _use_pallas: bool = False
     _node_name: Optional[str] = None
+    _mesh_telemetry = None  # Optional[MeshTelemetry]: the zero-gather report path
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -107,10 +108,18 @@ class Detector:
         max_signals: int = 64,
         use_pallas: bool = False,
         node_name: Optional[str] = None,
+        device_telemetry=None,
     ) -> None:
+        """``device_telemetry``: a :class:`~tpu_resiliency.telemetry.sharded.MeshTelemetry`
+        whose rank axis spans the job (one row per Detector rank). When set — and the
+        job runs one JAX process per rank (``jax.process_count() == world_size``) —
+        ``generate_report`` skips the store summary gather entirely: the store carries
+        only the name-column agreement, and per-rank summaries travel as shards of a
+        mesh array reduced by ICI/DCN collectives (the north-star path)."""
         if cls.initialized:
             raise ResiliencyError("Detector already initialized")
         cls.initialized = True
+        cls._mesh_telemetry = device_telemetry
         cls.scores_to_compute = tuple(scores_to_compute)
         cls.gather_on_rank0 = gather_on_rank0
         cls.profiling_interval = max(1, profiling_interval)
@@ -142,6 +151,7 @@ class Detector:
         cls._registry = None
         cls._generator = None
         cls._interval_tracker = None
+        cls._mesh_telemetry = None
         cls.store = None
         cls.initialized = False
 
@@ -235,6 +245,62 @@ class Detector:
 
     # -- report generation -------------------------------------------------
 
+    COLUMNS_KEY = "telemetry/columns"
+
+    @classmethod
+    def _sync_columns(cls) -> tuple[str, ...]:
+        """Agree on a global, append-only signal→column order via store CAS.
+
+        Per-rank registries assign indices in first-use order, which differs across
+        ranks; the mesh summary path aligns columns *positionally* in a sharded
+        array, so it needs one authoritative order. A CAS loop appends locally-new
+        names (sorted) to a single store tuple; every rank then adopts the same
+        list. Append-only ⇒ per-column carried state (EWMA / historical min) in the
+        MeshTelemetry stays valid across rounds and late joiners.
+        """
+        local = set(cls._rings)
+        while True:
+            cur = cls.store.try_get(cls.COLUMNS_KEY)
+            cur_t = tuple(cur) if cur else ()
+            missing = sorted(local - set(cur_t))
+            if not missing:
+                break
+            ok, _ = cls.store.compare_set(cls.COLUMNS_KEY, cur, cur_t + tuple(missing))
+            if ok:
+                break
+        cls.store.barrier("telemetry/columns_sync", cls.rank, cls.world_size, 300.0)
+        return tuple(cls.store.get(cls.COLUMNS_KEY, timeout=60.0))
+
+    @classmethod
+    def _generate_mesh_report(cls, local: dict) -> Optional[Report]:
+        """The zero-gather report path: store for column names only, summaries ride
+        the mesh (``MeshTelemetry.score_local_summary``)."""
+        mt = cls._mesh_telemetry
+        names = cls._sync_columns()
+        cap = mt.n_signals
+        if len(names) > cap:
+            raise ResiliencyError(
+                f"{len(names)} signals exceed MeshTelemetry capacity {cap}"
+            )
+        med = np.full((1, cap), np.inf, dtype=np.float32)
+        wgt = np.zeros((1, cap), dtype=np.float32)
+        cnt = np.zeros((1, cap), dtype=np.int32)
+        col = {n: j for j, n in enumerate(names)}
+        for n, st in local.items():
+            j = col.get(n)
+            if j is None:
+                continue
+            med[0, j] = st["median"]
+            wgt[0, j] = st["total"]
+            cnt[0, j] = st["count"]
+        report = mt.report_from_summary(
+            med, wgt, cnt, rank=cls.rank, signal_names=names
+        )
+        cls._reset_rings()
+        if cls.gather_on_rank0 and cls.rank != 0:
+            return None
+        return report
+
     @classmethod
     def generate_report(cls) -> Optional[Report]:
         """Aggregate summaries across ranks and run the device scoring round.
@@ -247,9 +313,17 @@ class Detector:
         """
         if not cls.initialized:
             raise ResiliencyError("Detector.initialize() must be called first")
+        import jax
         import jax.numpy as jnp
 
         local = cls.local_summary()
+        if (
+            cls._mesh_telemetry is not None
+            and cls.store is not None
+            and cls.world_size > 1
+            and jax.process_count() == cls.world_size
+        ):
+            return cls._generate_mesh_report(local)
         if cls.store is not None and cls.world_size > 1:
             round_idx = cls._generator.iteration
             ns = f"telemetry/round/{round_idx}"
@@ -257,10 +331,19 @@ class Detector:
             cls.store.set(f"{ns}/summary/{cls.rank}", local)
             cls.store.barrier(f"{ns}/publish", cls.rank, cls.world_size, 300.0)
             cls._registry.merge(cls.store, key=f"{ns}/names")
+            # One batched fetch, not O(world) sequential round-trips; the barrier
+            # above guarantees every rank's summary is present. (prefix_get keys
+            # come back relative to the store *view*, so index by full key.)
+            raw = cls.store.prefix_get(f"{ns}/summary/")
             summaries = [
-                cls.store.get(f"{ns}/summary/{r}", timeout=60.0)
-                for r in range(cls.world_size)
+                raw.get(f"{ns}/summary/{r}", {}) for r in range(cls.world_size)
             ]
+            if cls.rank == 0 and round_idx > 0:
+                # Everyone is past round round_idx-1 (they joined this round's
+                # barrier), so its namespace is garbage; without this the store
+                # grows for the job's lifetime. Trailing '/' keeps round 1 from
+                # matching round 10.
+                cls.store.prefix_clear(f"telemetry/round/{round_idx - 1}/")
         else:
             summaries = [local]
 

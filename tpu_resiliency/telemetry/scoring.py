@@ -17,9 +17,17 @@ host-side Python dict/tensor pack-unpack loops + ``all_reduce``/``gather``
 - **straggler mask** = perf score below threshold (reference default 0.75,
   ``reporting.py:84-151``) or robust-z below −z_threshold.
 
-When the ``[ranks, ...]`` arrays are sharded over a mesh axis, the cross-rank
-reductions (min/median/MAD) lower to XLA collectives over ICI; on a single chip the
-whole pipeline is one fused XLA program with zero host round-trips.
+Two execution modes share this one pipeline:
+
+- **single-program** (``axis_name=None``): the ``[R, ...]`` matrix lives on one chip
+  (or is fully replicated) and the cross-rank reductions are plain axis-0 ops in one
+  fused XLA program;
+- **mesh-sharded** (``axis_name='rank axis'``): the matrix is sharded over a mesh axis
+  and the function runs inside ``jax.shard_map`` — the same reductions become XLA
+  collectives over ICI (``lax.pmin`` for the reference-min, a tiny ``all_gather`` of
+  the [R] perf vector for the median/MAD), replacing the reference's host-side
+  ``all_reduce``/``gather`` (``reporting.py:255-296,338-419``) with zero host hops.
+  Use :func:`score_round_sharded` to apply it to mesh-sharded arrays.
 """
 
 from __future__ import annotations
@@ -30,9 +38,14 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 EPS = 1e-12
 MAD_SCALE = 1.4826  # makes MAD a consistent sigma estimator under normality
+# Perf scores live in (0, 1]; when every healthy rank scores identically the MAD
+# degenerates to ~0 and float jitter (1e-7-ish) over EPS would z-flag the whole
+# fleet. The floor says: deviations under ~3e-3 in score units are never outliers.
+MAD_FLOOR = 1e-3
 DEFAULT_THRESHOLD = 0.75  # reference identify_stragglers default (reporting.py:84)
 DEFAULT_Z_THRESHOLD = 3.0
 DEFAULT_EWMA_ALPHA = 0.5
@@ -68,14 +81,19 @@ def masked_total(data: jax.Array, counts: jax.Array) -> jax.Array:
     return jnp.where(valid, data, 0.0).sum(axis=-1)
 
 
-def relative_scores(medians: jax.Array, valid: jax.Array) -> jax.Array:
+def relative_scores(
+    medians: jax.Array, valid: jax.Array, axis_name: Optional[str] = None
+) -> jax.Array:
     """[R, S] relative scores vs the fastest rank per signal.
 
     The reference computes the reference-median as an all-reduce MIN over ranks of each
     signal's median (``reporting.py:255-296``); here that is a masked ``min`` along the
-    rank axis of the sharded medians matrix.
+    rank axis — lowered to an ICI ``pmin`` collective when the rank axis is sharded
+    over a mesh (``axis_name``).
     """
     ref = jnp.min(jnp.where(valid, medians, jnp.inf), axis=0, keepdims=True)
+    if axis_name is not None:
+        ref = lax.pmin(ref, axis_name)
     scores = ref / jnp.maximum(medians, EPS)
     # Signals nobody measured have ref=inf; signals this rank didn't measure score 1.
     scores = jnp.where(jnp.isfinite(ref), scores, 1.0)
@@ -100,11 +118,17 @@ def perf_scores(section_scores: jax.Array, weights: jax.Array, valid: jax.Array)
     return (section_scores * w).sum(axis=1) / denom
 
 
-def robust_z(x: jax.Array) -> jax.Array:
-    """Median/MAD z-score along the rank axis."""
-    med = jnp.median(x)
-    mad = jnp.median(jnp.abs(x - med))
-    return (x - med) / (MAD_SCALE * mad + EPS)
+def robust_z(x: jax.Array, axis_name: Optional[str] = None) -> jax.Array:
+    """Median/MAD z-score along the rank axis.
+
+    The median is not a pairwise reduction, so the sharded path all-gathers the per-
+    rank perf vector — R floats over ICI, the one unavoidable full-exchange, and tiny
+    (16 KB at 4096 ranks) next to the [R,S,W] telemetry it replaces on the host path.
+    """
+    full = x if axis_name is None else lax.all_gather(x, axis_name, tiled=True)
+    med = jnp.median(full)
+    mad = jnp.median(jnp.abs(full - med))
+    return (x - med) / jnp.maximum(MAD_SCALE * mad, MAD_FLOOR)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -149,6 +173,7 @@ def score_round(
     z_threshold: float = DEFAULT_Z_THRESHOLD,
     alpha: float = DEFAULT_EWMA_ALPHA,
     medians_and_weights: Optional[tuple[jax.Array, jax.Array]] = None,
+    axis_name: Optional[str] = None,
 ) -> TelemetryScores:
     """The fused scoring pipeline over raw telemetry windows.
 
@@ -159,6 +184,10 @@ def score_round(
 
     ``medians_and_weights`` short-circuits the reduction stage with precomputed
     ``(medians [R,S], weights [R,S])`` — the hook used by the Pallas kernel path.
+
+    ``axis_name`` marks the rank axis as mesh-sharded: the function must then be
+    called inside ``shard_map`` (see :func:`score_round_sharded`), R becomes the
+    *local* shard size, and cross-rank reductions ride ICI collectives.
     """
     if medians_and_weights is None:
         medians = masked_median(data, counts)
@@ -166,10 +195,10 @@ def score_round(
     else:
         medians, weights = medians_and_weights
     valid = counts > 0
-    section = relative_scores(medians, valid)
+    section = relative_scores(medians, valid, axis_name)
     indiv, new_min = individual_scores(medians, valid, historical_min)
     perf = perf_scores(section, weights, valid)
-    z = robust_z(perf)
+    z = robust_z(perf, axis_name)
     ewma = alpha * perf + (1.0 - alpha) * prev_ewma
     straggler = (perf < threshold) | (z < -z_threshold)
     return TelemetryScores(
@@ -202,3 +231,59 @@ def score_round_jit(
         z_threshold=z_threshold,
         alpha=alpha,
     )
+
+
+@functools.lru_cache(maxsize=16)
+def make_sharded_scorer(
+    mesh,
+    axis: str,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    z_threshold: float = DEFAULT_Z_THRESHOLD,
+    alpha: float = DEFAULT_EWMA_ALPHA,
+):
+    """Build a jitted scoring fn over a mesh-sharded rank axis. Cached per
+    (mesh, axis, thresholds) so per-round callers don't re-trace.
+
+    Input/output arrays are sharded ``P(axis)`` on dim 0; each device holds its own
+    ranks' telemetry and the cross-rank reductions lower to collectives over the mesh
+    (the north-star replacement for the reference's host gather,
+    ``reporting.py:255-296``). Returns ``fn(data, counts, prev_ewma, historical_min)
+    -> TelemetryScores`` with every leaf still sharded ``P(axis)``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(axis)
+    body = functools.partial(
+        score_round,
+        threshold=threshold,
+        z_threshold=z_threshold,
+        alpha=alpha,
+        axis_name=axis,
+    )
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=TelemetryScores(*([spec] * 7)),
+    )
+    return jax.jit(sharded)
+
+
+def score_round_sharded(
+    data,
+    counts,
+    prev_ewma,
+    historical_min,
+    *,
+    mesh,
+    axis: str,
+    threshold: float = DEFAULT_THRESHOLD,
+    z_threshold: float = DEFAULT_Z_THRESHOLD,
+    alpha: float = DEFAULT_EWMA_ALPHA,
+) -> TelemetryScores:
+    """One mesh-sharded scoring round (see :func:`make_sharded_scorer`)."""
+    fn = make_sharded_scorer(
+        mesh, axis, threshold=threshold, z_threshold=z_threshold, alpha=alpha
+    )
+    return fn(data, counts, prev_ewma, historical_min)
