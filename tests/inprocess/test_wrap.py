@@ -175,6 +175,41 @@ class TestMultiRank:
         assert codes[1] == 7
         assert results[0] == ("ok", 1, 1)  # survivor re-entered with world 1
 
+    def test_degraded_rank_demoted_without_dying(self):
+        """The health-vector decisions loop (VERDICT r1 item 2): a slow-but-alive
+        rank recorded degraded is excluded from the active world on the next
+        restart round — a healthy spare takes its slot — without the slow rank
+        ever dying."""
+
+        def body(rank, q):
+            from tpu_resiliency.inprocess.rank_assignment import DemoteDegraded
+            from tpu_resiliency.inprocess.wrap import CallWrapper
+
+            @fast_wrapper(rank_assignment=DemoteDegraded(max_active_world_size=2))
+            def train(call: CallWrapper):
+                fs = call.frozen_state
+                if call.iteration == 0:
+                    if rank == 0:
+                        # Telemetry policy publishes: rank 1 is degraded.
+                        call.coord.set_degraded({1})
+                        time.sleep(0.3)
+                        raise RuntimeError("force a restart round")
+                    deadline = time.monotonic() + 30.0
+                    while time.monotonic() < deadline:
+                        time.sleep(0.05)
+                return ("ok", call.iteration, fs.mode.name, fs.active_rank,
+                        fs.active_world_size)
+
+            q.put((rank, train()))
+
+        results, codes = run_world(3, body, timeout=120.0)
+        assert codes == [0, 0, 0]
+        # Iteration 1: ranks 0 and 2 active; degraded rank 1 is alive but spent the
+        # round in reserve (a reserve rank's wrapper returns None on completion).
+        assert results[1] is None
+        assert results[0] == ("ok", 1, "ACTIVE", 0, 2)
+        assert results[2] == ("ok", 1, "ACTIVE", 1, 2)
+
     def test_system_exit_terminates_rank_not_restart(self):
         """SystemExit must terminate the raising rank (re-raised, rank recorded
         terminated) while peers restart without it — not spin the raiser through

@@ -1,0 +1,160 @@
+"""Health-vector policy: streak promotion, hysteresis recovery, sinks, and the
+decision couplings (replication avoidance; DemoteDegraded is covered in
+tests/inprocess)."""
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpoint.replication import ExchangePlan
+from tpu_resiliency.telemetry.policy import (
+    HealthVectorPolicy,
+    coordinator_sink,
+)
+from tpu_resiliency.telemetry.reporting import Report
+
+
+def make_report(perf: dict[int, float], iteration=0) -> Report:
+    world = len(perf)
+    return Report(
+        rank=0,
+        world_size=world,
+        iteration=iteration,
+        section_names=("step",),
+        relative_section_scores={"step": perf[0]},
+        individual_section_scores={"step": 1.0},
+        perf_scores=dict(perf),
+        z_scores={r: 0.0 for r in perf},
+        ewma_scores=dict(perf),
+    )
+
+
+HEALTHY = {0: 1.0, 1: 0.98, 2: 0.99, 3: 1.0}
+SLOW2 = {0: 1.0, 1: 0.98, 2: 0.4, 3: 1.0}
+
+
+class TestHealthVectorPolicy:
+    def test_patience_before_degraded(self):
+        p = HealthVectorPolicy(patience=2, recovery=2)
+        d1 = p.observe(make_report(SLOW2))
+        assert d1.flagged == {2} and d1.degraded == frozenset()
+        d2 = p.observe(make_report(SLOW2))
+        assert d2.newly_degraded == {2} and p.degraded == {2}
+
+    def test_single_noisy_round_does_not_degrade(self):
+        p = HealthVectorPolicy(patience=2, recovery=2)
+        p.observe(make_report(SLOW2))
+        d = p.observe(make_report(HEALTHY))  # clean round resets the streak
+        assert d.degraded == frozenset()
+        p.observe(make_report(SLOW2))
+        assert p.degraded == frozenset()  # streak restarted at 1
+
+    def test_recovery_hysteresis(self):
+        p = HealthVectorPolicy(patience=1, recovery=3)
+        p.observe(make_report(SLOW2))
+        assert p.degraded == {2}
+        p.observe(make_report(HEALTHY))
+        p.observe(make_report(HEALTHY))
+        assert p.degraded == {2}  # still held: recovery needs 3 clean rounds
+        d = p.observe(make_report(HEALTHY))
+        assert d.recovered == {2} and p.degraded == frozenset()
+
+    def test_sink_called_on_change_only(self):
+        seen = []
+        p = HealthVectorPolicy(patience=1, recovery=1, sinks=[seen.append])
+        p.observe(make_report(SLOW2))
+        p.observe(make_report(SLOW2))  # no change: still degraded
+        p.observe(make_report(HEALTHY))
+        assert len(seen) == 2
+        assert seen[0].newly_degraded == {2}
+        assert seen[1].recovered == {2}
+
+    def test_coordinator_sink_publishes(self, coord_store):
+        from tpu_resiliency.inprocess.coordination import RestartCoordinator
+
+        coord = RestartCoordinator(coord_store, world_size=4)
+        p = HealthVectorPolicy(patience=1, recovery=1, sinks=[coordinator_sink(coord)])
+        p.observe(make_report(SLOW2))
+        assert coord.degraded_ranks() == {2}
+        p.observe(make_report(HEALTHY))
+        assert coord.degraded_ranks() == frozenset()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            HealthVectorPolicy(patience=0)
+
+
+class TestDemoteDegraded:
+    def _ctx(self, world, terminated=(), degraded=(), rank=0):
+        from tpu_resiliency.inprocess.rank_assignment import RankAssignmentCtx
+        from tpu_resiliency.inprocess.state import State
+
+        st = State(rank=rank, world_size=world)
+        return RankAssignmentCtx(st, frozenset(terminated), frozenset(degraded))
+
+    def test_degraded_yields_to_healthy(self):
+        from tpu_resiliency.inprocess.rank_assignment import DemoteDegraded
+        from tpu_resiliency.inprocess.state import Mode
+
+        # world 4, cap 3, rank 1 degraded: actives are 0,2,3; rank 1 reserves.
+        ctx = DemoteDegraded(3)(self._ctx(4, degraded={1}, rank=1))
+        assert ctx.state.mode is Mode.INACTIVE and ctx.state.active_rank is None
+        ctx = DemoteDegraded(3)(self._ctx(4, degraded={1}, rank=3))
+        assert ctx.state.mode is Mode.ACTIVE and ctx.state.active_rank == 2
+
+    def test_degraded_fills_in_when_no_healthy_spare(self):
+        from tpu_resiliency.inprocess.rank_assignment import DemoteDegraded
+        from tpu_resiliency.inprocess.state import Mode
+
+        # world 3, cap 3: the degraded rank must stay active (slow beats absent),
+        # but is renumbered last.
+        ctx = DemoteDegraded(3)(self._ctx(3, degraded={0}, rank=0))
+        assert ctx.state.mode is Mode.ACTIVE and ctx.state.active_rank == 2
+
+
+class TestExcludeSelfSink:
+    def test_fires_only_on_own_demotion(self):
+        from tpu_resiliency.telemetry.policy import exclude_self_sink
+
+        class FakeClient:
+            def __init__(self):
+                self.sent = []
+                self.rank_info = None
+
+            def send_workload_control_request(self, action, reason=""):
+                self.sent.append((action, reason))
+
+        client = FakeClient()
+        p = HealthVectorPolicy(
+            patience=1, recovery=1, sinks=[exclude_self_sink(client, rank=2)]
+        )
+        p.observe(make_report(SLOW2))
+        assert len(client.sent) == 1
+        from tpu_resiliency.watchdog.data import WorkloadAction
+
+        assert client.sent[0][0] is WorkloadAction.ExcludeThisNode
+        # Recovery does not re-fire the exclusion.
+        p.observe(make_report(HEALTHY))
+        assert len(client.sent) == 1
+
+
+class TestReplicationAvoidsDegraded:
+    def test_healthy_holder_preferred(self):
+        # Rank 0 lost its shard; ranks 1 (degraded) and 2 (healthy) both hold it.
+        plan = ExchangePlan.build(
+            wanted={0: 0}, holders={1: {0}, 2: {0}}, avoid={1}
+        )
+        assert plan.recvs[0] == [(2, 0)]
+
+    def test_degraded_holder_used_as_last_resort(self):
+        plan = ExchangePlan.build(wanted={0: 0}, holders={1: {0}}, avoid={1})
+        assert plan.recvs[0] == [(1, 0)]
+
+    def test_load_balance_within_health_class(self):
+        # Two healthy holders: load spreads between them even with a degraded third.
+        plan = ExchangePlan.build(
+            wanted={0: 0, 3: 0},
+            holders={1: {0}, 2: {0}, 4: {0}},
+            avoid={4},
+        )
+        srcs = sorted(src for (src, _) in [plan.recvs[0][0], plan.recvs[3][0]])
+        assert srcs == [1, 2]

@@ -74,6 +74,7 @@ class ExchangePlan:
     def build(
         wanted: dict[int, int],
         holders: dict[int, set[int]],
+        avoid: frozenset[int] | set[int] = frozenset(),
     ) -> "ExchangePlan":
         """``wanted[rank] = owner_rank_of_needed_shard`` (skip ranks that hold their own);
         ``holders[rank] = set of owner-ranks whose shards rank holds locally``.
@@ -82,6 +83,10 @@ class ExchangePlan:
         with the fewest sends assigned so far, ties broken by rank order (the reference
         picks a random live holder, ``strategies.py:142-188``; deterministic choice keeps
         every rank's independently-computed plan identical without a broadcast).
+
+        ``avoid``: ranks the health-vector policy holds degraded — they are chosen as
+        senders only when no healthy holder exists (recovery should never queue behind
+        the slowest NIC in the clique; BASELINE target 5).
         """
         sends: dict[int, list[tuple[int, int]]] = {}
         recvs: dict[int, list[tuple[int, int]]] = {}
@@ -93,7 +98,7 @@ class ExchangePlan:
                 raise CheckpointError(
                     f"no live holder for shard of rank {owner} needed by rank {dst}"
                 )
-            src = min(candidates, key=lambda r: (load.get(r, 0), r))
+            src = min(candidates, key=lambda r: (r in avoid, load.get(r, 0), r))
             load[src] = load.get(src, 0) + 1
             sends.setdefault(src, []).append((dst, owner))
             recvs.setdefault(dst, []).append((src, owner))
@@ -154,13 +159,15 @@ class CliqueReplicationStrategy:
         my_needed_owner: Optional[int],
         my_held_owners: set[int],
         get_blob,
+        avoid: frozenset[int] | set[int] = frozenset(),
     ) -> Optional[bytes]:
         """Global shard routing after rank loss / reassignment.
 
         ``my_needed_owner``: owner-rank of the shard this rank needs but does not hold
         (``None`` if satisfied locally). ``my_held_owners``: owner-ranks of shards held
         locally. ``get_blob(owner)`` loads a held shard's bytes for sending. All ranks
-        must call this collectively. Returns the received blob, or ``None``.
+        must call this collectively with the same ``avoid`` set (degraded ranks are
+        deprioritized as senders). Returns the received blob, or ``None``.
         """
         gathered = self.comm.all_gather(
             (self.comm.rank, my_needed_owner, sorted(my_held_owners)), tag="retrieve-meta"
@@ -169,7 +176,7 @@ class CliqueReplicationStrategy:
         holders = {r: set(held) for r, _, held in gathered}
         if not wanted:
             return None
-        plan = ExchangePlan.build(wanted, holders)
+        plan = ExchangePlan.build(wanted, holders, avoid=avoid)
         tag = f"retr/{self._round}"
         self._round += 1
         for dst, owner in plan.sends.get(self.comm.rank, []):

@@ -26,6 +26,7 @@ from tpu_resiliency.inprocess.progress_watchdog import ProgressWatchdog
 from tpu_resiliency.inprocess.rank_assignment import (
     ActivateAllRanks,
     ActiveWorldSizeDivisibleBy,
+    DemoteDegraded,
     FillGaps,
     FilterCountGroupedByKey,
     Layer,
@@ -61,6 +62,7 @@ __all__ = [
     "LayerFlag",
     "LogCompletion",
     "LogTerminate",
+    "DemoteDegraded",
     "MaxActiveWorldSize",
     "Mode",
     "MonitorConfig",

@@ -108,6 +108,17 @@ class RestartCoordinator:
     def terminated_ranks(self) -> frozenset[int]:
         return frozenset(self.store.set_get("terminated"))
 
+    # -- degraded ranks (health-vector policy) -----------------------------
+
+    def set_degraded(self, ranks) -> None:
+        """Replace the advisory degraded set (telemetry policy output). Unlike
+        ``terminated``, degraded status is reversible — a recovered rank leaves the
+        set — so this is a plain value, not a grow-only set."""
+        self.store.set("degraded", sorted(int(r) for r in ranks))
+
+    def degraded_ranks(self) -> frozenset[int]:
+        return frozenset(self.store.try_get("degraded", ()) or ())
+
     # -- heartbeats (monitor processes) ------------------------------------
 
     def heartbeat(self, rank: int) -> None:

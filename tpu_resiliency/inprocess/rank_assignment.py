@@ -37,6 +37,9 @@ class RankAssignmentCtx:
 
     state: State
     terminated_ranks: frozenset[int] = frozenset()
+    #: advisory: ranks the health-vector policy holds degraded (alive but slow);
+    #: assignments may demote them to spares but must not require their absence
+    degraded_ranks: frozenset[int] = frozenset()
 
 
 RankAssignment = Callable[[RankAssignmentCtx], RankAssignmentCtx]
@@ -115,6 +118,34 @@ class MaxActiveWorldSize:
         n = len(surv) if cap is None else min(cap, len(surv))
         assignment: dict[int, Optional[int]] = {}
         for i, r in enumerate(surv):
+            assignment[r] = i if i < n else None
+        return _apply_global(ctx, assignment)
+
+
+@dataclasses.dataclass
+class DemoteDegraded:
+    """Health-vector demotion: degraded-but-alive ranks yield their active slots to
+    healthy spares (the decisions loop of BASELINE target 5).
+
+    Survivors are ordered healthy-first (each group keeping ascending initial-rank
+    order) and the first ``max_active_world_size`` become ACTIVE — so a degraded
+    rank drops to INACTIVE reserve exactly when a healthy rank exists to take its
+    place, and fills in (better slow than absent) when none does. With
+    ``max_active_world_size=None`` every survivor stays active and degraded ranks
+    are merely renumbered last (useful to pin them to the tail of the mesh).
+    """
+
+    max_active_world_size: Optional[int] = None
+
+    def __call__(self, ctx: RankAssignmentCtx) -> RankAssignmentCtx:
+        surv = _survivors(ctx)
+        healthy = [r for r in surv if r not in ctx.degraded_ranks]
+        degraded = [r for r in surv if r in ctx.degraded_ranks]
+        ordered = healthy + degraded
+        cap = self.max_active_world_size
+        n = len(ordered) if cap is None else min(cap, len(ordered))
+        assignment: dict[int, Optional[int]] = {}
+        for i, r in enumerate(ordered):
             assignment[r] = i if i < n else None
         return _apply_global(ctx, assignment)
 

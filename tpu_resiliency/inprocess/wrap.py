@@ -138,11 +138,27 @@ class CallWrapper:
             host = wrapper.store_host
         if wrapper.store_port is not None:
             port = wrapper.store_port
-        self.store, self.server = host_store(
-            self.state.rank, host, port, prefix=wrapper.store_prefix
-        )
-        if self.server is not None:
-            os.environ.setdefault("TPU_RESILIENCY_STORE_PORT", str(self.server.port))
+        prefix = wrapper.store_prefix
+        external = os.environ.get("TPU_RESILIENCY_STORE_EXTERNAL") == "1"
+        if external:
+            # Layered restart: we run under a launcher that already hosts the
+            # coordination store — connect as a client (rank 0 must NOT bind the
+            # port again), and scope this incarnation's restart state by the
+            # launcher round so a respawned process never sees its dead
+            # predecessor's terminated/interrupted records (the in-job ↔
+            # in-process coupling, reference ``in_job_and_in_process_example``).
+            launcher_round = os.environ.get("TPU_FT_RESTART_COUNT", "0")
+            prefix = f"{prefix}r{launcher_round}/"
+            from tpu_resiliency.platform.store import CoordStore
+
+            self.store = CoordStore(host, port, prefix=prefix)
+            self.server = None
+        else:
+            self.store, self.server = host_store(
+                self.state.rank, host, port, prefix=prefix
+            )
+            if self.server is not None:
+                os.environ.setdefault("TPU_RESILIENCY_STORE_PORT", str(self.server.port))
         self.coord = RestartCoordinator(self.store, self.state.world_size)
 
         self.monitor_process: Optional[MonitorProcess] = None
@@ -267,7 +283,9 @@ class CallWrapper:
         w, state, coord = self.w, self.state, self.coord
 
         # Initial assignment (reference ``wrap.py:404-406``).
-        ctx = RankAssignmentCtx(state, coord.terminated_ranks())
+        ctx = RankAssignmentCtx(
+            state, coord.terminated_ranks(), coord.degraded_ranks()
+        )
         state = w.rank_assignment(ctx).state
         state.set_distributed_vars()
 
@@ -389,7 +407,7 @@ class CallWrapper:
                         f"unproxied dead ranks or store loss"
                     ) from e
                 terminated = coord.terminated_ranks()
-                ctx = RankAssignmentCtx(state, terminated)
+                ctx = RankAssignmentCtx(state, terminated, coord.degraded_ranks())
                 state = w.rank_assignment(ctx).state
                 if state.mode == Mode.TERMINATED:
                     raise RestartAbort("excluded by rank assignment")

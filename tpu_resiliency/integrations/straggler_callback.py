@@ -31,11 +31,17 @@ class StragglerDetectionCallback(Callback):
         section_name: str = "train_step",
         store=None,
         use_pallas: bool = False,
+        health_policy=None,
     ):
+        """``health_policy``: an optional
+        :class:`~tpu_resiliency.telemetry.policy.HealthVectorPolicy` fed every
+        report — its sinks close the loop to restart demotion / node exclusion /
+        replication avoidance (BASELINE target 5)."""
         self.threshold = threshold
         self.stop_if_detected = stop_if_detected
         self.export_metrics = export_metrics
         self.section_name = section_name
+        self.health_policy = health_policy
         self._init_kwargs = dict(
             scores_to_compute=(
                 (["relative_perf_scores"] if calc_relative_scores else [])
@@ -96,3 +102,5 @@ class StragglerDetectionCallback(Callback):
                 ctx.metrics["straggler/detected"] = stragglers
             if self.stop_if_detected:
                 ctx.should_stop = True
+        if self.health_policy is not None:
+            self.health_policy.observe(report)

@@ -215,6 +215,10 @@ class ElasticAgent:
             "GROUP_RANK": str(node_rank),
             "TPU_RESILIENCY_STORE_HOST": cfg.store_host,
             "TPU_RESILIENCY_STORE_PORT": str(cfg.store_port),
+            # Tells an inprocess.Wrapper in the worker to ride this store as a
+            # client (scoped by launcher round) instead of hosting its own —
+            # the layered in-job + in-process coupling.
+            "TPU_RESILIENCY_STORE_EXTERNAL": "1",
             ipc.LAUNCHER_SOCKET_ENV: self._launcher_socket,
         }
         group = WorkerGroup(

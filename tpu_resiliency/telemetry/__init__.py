@@ -13,6 +13,12 @@ from tpu_resiliency.telemetry.scoring import (
     score_round_jit,
     score_round_sharded,
 )
+from tpu_resiliency.telemetry.policy import (
+    HealthDecision,
+    HealthVectorPolicy,
+    coordinator_sink,
+    exclude_self_sink,
+)
 from tpu_resiliency.telemetry.sharded import MeshTelemetry, TelemetryState
 from tpu_resiliency.telemetry.statistics import ALL_STATISTICS, Statistic, compute_stats
 
@@ -30,6 +36,10 @@ __all__ = [
     "TelemetryScores",
     "MeshTelemetry",
     "TelemetryState",
+    "HealthDecision",
+    "HealthVectorPolicy",
+    "coordinator_sink",
+    "exclude_self_sink",
     "make_sharded_scorer",
     "masked_median",
     "masked_total",
