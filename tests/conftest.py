@@ -9,6 +9,15 @@ Must run before the first ``import jax`` anywhere in the test process.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Subprocess-spawning tests (launcher e2e, WorkerGroup, layered restart) must be able
+# to import tpu_resiliency from a fresh clone without a pip install: put the repo root
+# on PYTHONPATH for every child this test session spawns.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in os.environ.get("PYTHONPATH", "").split(os.pathsep):
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO_ROOT, os.environ.get("PYTHONPATH", "")) if p
+    )
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -94,18 +94,51 @@ class MonitorThread:
         return self._fired.is_set()
 
     def acknowledge(self, drain: bool = True) -> None:
-        """Main has taken the restart path: stop injecting, then drain stragglers."""
-        self._armed.clear()
-        self._ack.set()
-        self._quiesced.wait(timeout=10.0)
-        if drain:
-            # A final injection may already be scheduled: give the interpreter a few
-            # bytecode boundaries to deliver it where we can catch it.
-            for _ in range(3):
-                try:
-                    time.sleep(0.01)
-                except RankShouldRestart:
-                    pass
+        """Main has taken the restart path: stop injecting, then drain stragglers.
+
+        Every step is retried through a late delivery: an injection scheduled just
+        before ``_armed`` cleared can land at any bytecode boundary in here, and a
+        delivery that surfaces while a C call is returning can be mangled into
+        ``SystemError("error return without exception set")`` — the CPython hazard the
+        reference guards with its ``sys.unraisablehook`` re-raise
+        (``/root/reference/src/nvidia_resiliency_ext/inprocess/monitor_thread.py:87-105``).
+        After this returns, no injection is scheduled, pending, or deliverable: the
+        caller's subsequent store/barrier work cannot be torn.
+        """
+        quiesced = False
+        clean = 0
+        attempts = 0
+        while True:
+            # One covered region for the whole body: a delivery at ANY internal
+            # boundary (loop checks, assignments, the except body itself) lands
+            # back in this try on the next pass. The irreducible escape window is
+            # the few handler-entry bytecodes between a delivery and re-entering
+            # the try — unavoidable in pure CPython, and orders of magnitude
+            # smaller than one store round-trip.
+            try:
+                self._armed.clear()
+                self._ack.set()
+                if not quiesced:
+                    # Monitor loop exits on ack; after the quiesce event no new
+                    # injection can be scheduled.
+                    self._quiesced.wait(timeout=10.0)
+                    quiesced = True
+                if not drain or not self._fired.is_set():
+                    # Never fired ⇒ async_raise was never called ⇒ nothing can be
+                    # pending: the common local-exception restart skips the drain.
+                    return
+                # At most one injection can still be pending (scheduled before
+                # _armed cleared, not yet delivered). Async exceptions deliver at
+                # the next eval-loop boundary, so require a streak of clean sleeps
+                # before declaring the thread drained.
+                while clean < 3 and attempts < 400:
+                    attempts += 1
+                    time.sleep(0.005)
+                    clean += 1
+                return
+            except (RankShouldRestart, SystemError):
+                clean = 0
+                continue
 
     def shutdown(self, timeout: float = 10.0) -> None:
         self._ack.set()

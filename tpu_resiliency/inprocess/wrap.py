@@ -252,10 +252,29 @@ class CallWrapper:
             # Dropping the link makes the monitor treat us as dead → barrier proxy.
             self.monitor_process.abandon()
 
+    @staticmethod
+    def _quiesce(monitor) -> None:
+        """Retry ``monitor.acknowledge()`` through late async deliveries: an
+        injection scheduled just before the handler ran can land on the CALL
+        bytecode itself or anywhere inside acknowledge — catch it here and go
+        again (acknowledge is idempotent). Convergence: every retry re-clears
+        ``_armed``/re-sets ``_ack``, and the monitor never schedules a new
+        injection once ack is set, so the pending count only falls."""
+        while True:
+            try:
+                monitor.acknowledge()
+                return
+            except (RankShouldRestart, SystemError):
+                continue
+
     def _terminate_and_leave(self, monitor, state) -> None:
         """Rank-departure cleanup shared by the abort and BaseException exits:
-        silence the monitor, run the terminate chain, and leave the job."""
-        monitor.acknowledge(drain=False)
+        silence the monitor, run the terminate chain, and leave the job. Full
+        quiesce (not a bare acknowledge): this is also the exit for fn-raised
+        RestartAbort/HealthCheckError, which bypasses the restart handler's
+        quiesce — a pending injection must not tear the terminate chain or the
+        record_terminated store write."""
+        self._quiesce(monitor)
         try:
             monitor.shutdown()
         except Exception:
@@ -341,37 +360,52 @@ class CallWrapper:
                     monitor.shutdown()  # before the store closes under its poll loop
                     self._shutdown_clean()
                     return ret
-                except RankShouldRestart:
-                    monitor.acknowledge()
-                    log.info(f"rank {state.rank}: restart signalled (iter {iteration})")
-                    restart = True
                 except (RestartAbort, HealthCheckError):
                     raise
-                except Exception as e:
-                    state.fn_exception = e
-                    coord.record_interruption(
-                        iteration, state.rank, Interruption.EXCEPTION, repr(e)
-                    )
-                    monitor.acknowledge()
-                    log.warning(
-                        f"rank {state.rank}: wrapped fn raised {e!r} (iter {iteration})"
-                    )
-                    restart = True
                 except BaseException as e:
-                    # SystemExit / KeyboardInterrupt (and other non-Exception
-                    # BaseExceptions) mean the rank is leaving, not restarting:
-                    # record it terminated so peers restart without us, run the
-                    # terminate chain, and re-raise (reference restarts only on
-                    # Exception; its outer handler re-raises, ``wrap.py:558``).
-                    state.fn_exception = e
-                    coord.record_interruption(
-                        iteration, state.rank, Interruption.TERMINATED, repr(e)
-                    )
-                    log.warning(
-                        f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
-                    )
-                    self._terminate_and_leave(monitor, state)
-                    raise
+                    # ONE handler for every other unwind — restart signal, user
+                    # exception, process-leaving BaseException — so the uncovered
+                    # async-delivery window is a single handler entry, not three.
+                    # Quiesce BEFORE any store traffic: while the monitor is armed,
+                    # an injection can land inside the store client and escape this
+                    # handler, killing a healthy rank (the round-2 delivery race).
+                    # After _quiesce() the thread is acknowledged and drained, so
+                    # the coordination calls below cannot be torn.
+                    self._quiesce(monitor)
+                    if isinstance(e, RankShouldRestart) or (
+                        isinstance(e, SystemError) and monitor.fired
+                    ):
+                        # A mangled delivery (SystemError out of a returning C call
+                        # while an injection was pending) is the restart signal it
+                        # was meant to be — this rank is healthy.
+                        log.info(
+                            f"rank {state.rank}: restart signalled (iter {iteration}, {e!r})"
+                        )
+                        restart = True
+                    elif isinstance(e, Exception):
+                        state.fn_exception = e
+                        coord.record_interruption(
+                            iteration, state.rank, Interruption.EXCEPTION, repr(e)
+                        )
+                        log.warning(
+                            f"rank {state.rank}: wrapped fn raised {e!r} (iter {iteration})"
+                        )
+                        restart = True
+                    else:
+                        # SystemExit / KeyboardInterrupt mean the rank is leaving,
+                        # not restarting: record it terminated so peers restart
+                        # without us, run the terminate chain, and re-raise
+                        # (reference restarts only on Exception; its outer handler
+                        # re-raises, ``wrap.py:558``).
+                        state.fn_exception = e
+                        coord.record_interruption(
+                            iteration, state.rank, Interruption.TERMINATED, repr(e)
+                        )
+                        log.warning(
+                            f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
+                        )
+                        self._terminate_and_leave(monitor, state)
+                        raise
 
                 # ---- restart path ----
                 if self.monitor_process is not None:
