@@ -1,0 +1,154 @@
+"""Layered in-job + in-process restart, end to end.
+
+The core product scenario (reference
+``examples/fault_tolerance/in_job_and_in_process_example.py`` +
+``rank_monitor_state_machine.py:127-145``): workers wrapped with ``inprocess.Wrapper``
+run under ``tpu-ft-launcher`` and share the launcher-hosted coordination store
+(``TPU_RESILIENCY_STORE_EXTERNAL``). Two fault classes must route to the right layer:
+
+(a) an exception inside the wrapped fn → the in-process layer restarts the function;
+    the launcher never sees a failed worker (``TPU_FT_RESTART_COUNT`` stays 0);
+(b) a worker process death → the in-job layer respawns the round; respawned wrappers
+    form a fresh restart world scoped by the new launcher round.
+
+Both restarters must narrate their state machines via the ``[NestedRestarter]``
+log-line contract.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+WORKER = """
+import os, sys, time
+
+from tpu_resiliency.inprocess.nested_restarter import NestedRestarter
+from tpu_resiliency.inprocess.wrap import CallWrapper, Wrapper
+
+rank = int(os.environ["RANK"])
+launcher_round = int(os.environ["TPU_FT_RESTART_COUNT"])
+outdir = {outdir!r}
+
+nr = NestedRestarter()
+
+
+@Wrapper(
+    initialize=nr.on_initialize,
+    abort=nr.on_abort,
+    completion=nr.on_completion,
+    terminate=nr.on_terminate,
+    monitor_interval=0.05,
+    last_call_wait=0.1,
+    soft_timeout=10.0,
+    hard_timeout=20.0,
+    heartbeat_interval=0.2,
+    heartbeat_timeout=10.0,
+    barrier_timeout=45.0,
+    completion_timeout=45.0,
+)
+def train(call: CallWrapper):
+    it = call.iteration
+    with open(os.path.join(outdir, "trace_%d.log" % rank), "a") as f:
+        f.write("round=%d iter=%d\\n" % (launcher_round, it))
+    if launcher_round == 0:
+        if it == 0 and rank == 1:
+            # (a) handled by the in-process layer: the launcher must not notice.
+            raise RuntimeError("inprocess-handled fault")
+        if it >= 1 and rank == 1:
+            # (b) process death: only the in-job layer can handle this.
+            os._exit(13)
+        # Healthy ranks park until a restart signal (or the launcher's respawn
+        # tears us down as part of the in-job round).
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+        sys.exit(9)  # parked forever: the test failed
+    return "ok"
+
+
+result = train()
+print("WORKER_OK rank=%d round=%d result=%s" % (rank, launcher_round, result), flush=True)
+"""
+
+
+def test_layered_inprocess_then_injob_restart(tmp_path):
+    outdir = tmp_path / "traces"
+    outdir.mkdir()
+    script = tmp_path / "layered.py"
+    script.write_text(WORKER.format(outdir=str(outdir)))
+
+    env = dict(os.environ)
+    env["TPU_RESILIENCY_LOG_LEVEL"] = "INFO"
+    log_dir = tmp_path / "logs"
+    cmd = [
+        sys.executable, "-m", "tpu_resiliency.launcher.launch",
+        "--nproc-per-node", "2",
+        "--rdzv-endpoint", f"127.0.0.1:{free_port()}",
+        "--max-restarts", "3",
+        "--no-ft-monitors",
+        "--rdzv-last-call", "0.2",
+        "--monitor-interval", "0.1",
+        "--run-dir", str(tmp_path / "run"),
+        "--log-dir", str(log_dir),
+        str(script),
+    ]
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=240, env=env, cwd=str(tmp_path)
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+    # --- fault (a): the in-process layer handled the exception -----------------
+    # Rank 0's trace shows wrapper iterations 0 AND 1 within launcher round 0:
+    # the function restarted without the launcher respawning anything.
+    trace0 = (outdir / "trace_0.log").read_text().splitlines()
+    assert "round=0 iter=0" in trace0
+    assert "round=0 iter=1" in trace0
+
+    # --- fault (b): the in-job layer respawned the round -----------------------
+    # Both ranks re-entered at launcher round 1, wrapper iteration 0 (a fresh
+    # in-process world scoped by the new launcher round), and completed.
+    trace1 = (outdir / "trace_1.log").read_text().splitlines()
+    assert "round=1 iter=0" in trace0
+    assert "round=1 iter=0" in trace1
+    worker_stdout = "".join(p.read_text() for p in sorted(log_dir.rglob("stdout.log")))
+    assert "WORKER_OK rank=0 round=1" in worker_stdout
+    assert "WORKER_OK rank=1 round=1" in worker_stdout
+
+    # Exactly one in-job restart was charged: the exception in (a) consumed no
+    # launcher budget, so no worker ever saw a round beyond 1.
+    assert "round=2" not in worker_stdout
+    assert not (log_dir / "round_2").exists()
+
+    # --- the NestedRestarter log-line contract ---------------------------------
+    # In-job lines narrate the launcher's state machine on the agent's stderr.
+    injob = [ln for ln in r.stderr.splitlines() if "[NestedRestarter] name=[InJob]" in ln]
+    assert any("state=initialize" in ln for ln in injob)
+    assert any("state=handling_start" in ln for ln in injob)
+    assert any("state=handling_completed" in ln for ln in injob)
+
+    # In-process lines narrate each wrapper's machine on the worker's stderr
+    # (captured per round/rank under --log-dir).
+    worker_logs = sorted(log_dir.rglob("stderr.log"))
+    assert worker_logs, f"no captured worker logs under {log_dir}"
+    inproc = [
+        ln
+        for p in worker_logs
+        for ln in p.read_text().splitlines()
+        if "[NestedRestarter] name=[InProcess]" in ln
+    ]
+    assert any("state=initialize" in ln for ln in inproc)
+    # Fault (a) drove some wrapper through a full handling cycle.
+    assert any("state=handling_start" in ln for ln in inproc)
+    assert any("state=handling_completed" in ln for ln in inproc)
+    # The successful round finalized.
+    assert any("state=finalized" in ln for ln in inproc)
