@@ -32,16 +32,28 @@ class StragglerDetectionCallback(Callback):
         store=None,
         use_pallas: bool = False,
         health_policy=None,
+        use_device_mesh: bool = False,
+        mesh_signal_capacity: int = 16,
     ):
         """``health_policy``: an optional
         :class:`~tpu_resiliency.telemetry.policy.HealthVectorPolicy` fed every
         report — its sinks close the loop to restart demotion / node exclusion /
-        replication avoidance (BASELINE target 5)."""
+        replication avoidance (BASELINE target 5).
+
+        ``use_device_mesh``: route report rounds through the mesh-sharded scoring
+        path (:class:`~tpu_resiliency.telemetry.sharded.MeshTelemetry`) instead of
+        the per-rank store gather. Requires one JAX process per rank
+        (``jax.process_count() == world_size``, i.e. each worker called
+        ``jax.distributed.initialize``); outside that configuration the callback
+        logs once and falls back to the store path. ``mesh_signal_capacity`` caps
+        the number of distinct timed signals the compiled scorer carries."""
         self.threshold = threshold
         self.stop_if_detected = stop_if_detected
         self.export_metrics = export_metrics
         self.section_name = section_name
         self.health_policy = health_policy
+        self.use_device_mesh = use_device_mesh
+        self.mesh_signal_capacity = mesh_signal_capacity
         self._init_kwargs = dict(
             scores_to_compute=(
                 (["relative_perf_scores"] if calc_relative_scores else [])
@@ -55,9 +67,44 @@ class StragglerDetectionCallback(Callback):
         self._section = None
         self.last_report = None
 
+    def _build_mesh_telemetry(self, ctx: LoopContext):
+        """One telemetry row per rank on a one-device-per-process mesh — the
+        configuration ``Detector._generate_mesh_report`` scores with zero per-rank
+        store gathers (summaries travel as shards, reduced by XLA collectives)."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tpu_resiliency.telemetry.sharded import MeshTelemetry
+
+        if ctx.world_size <= 1 or jax.process_count() != ctx.world_size:
+            log.info(
+                "use_device_mesh requested but job is not one-JAX-process-per-rank "
+                f"(process_count={jax.process_count()}, world={ctx.world_size}); "
+                "falling back to the store summary path"
+            )
+            return None
+        per_proc = [
+            [d for d in jax.devices() if d.process_index == p][0]
+            for p in range(ctx.world_size)
+        ]
+        mesh = Mesh(np.array(per_proc), ("ranks",))
+        return MeshTelemetry(
+            mesh,
+            "ranks",
+            n_ranks=ctx.world_size,
+            signal_names=tuple(f"c{i}" for i in range(self.mesh_signal_capacity)),
+        )
+
     def on_train_start(self, ctx: LoopContext) -> None:
+        device_telemetry = (
+            self._build_mesh_telemetry(ctx) if self.use_device_mesh else None
+        )
         Detector.initialize(
-            rank=ctx.rank, world_size=ctx.world_size, **self._init_kwargs
+            rank=ctx.rank,
+            world_size=ctx.world_size,
+            device_telemetry=device_telemetry,
+            **self._init_kwargs,
         )
 
     def on_step_start(self, ctx: LoopContext) -> None:
