@@ -12,7 +12,10 @@ the Pallas fused-median variant) running as one compiled program.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}; details go to stderr.
 """
 
+import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -116,10 +119,17 @@ def device_scoring(data, counts, use_pallas):
     return per_step, out
 
 
-def device_ring_scoring(data, counts):
-    """The full north-star hot loop: device-resident sharded rings fed in-jit
-    (donated carry) + the mesh scoring program, every step. Ingestion cost is
-    included — this is what a train step actually pays."""
+def device_ring_scoring(data, counts, report_interval=100):
+    """The real north-star hot loop, decomposed the way a train loop pays for it:
+
+    - **push**: every step appends its ``[R, S]`` timings to the device-resident
+      sharded rings from inside the jitted step (donated carry) — paid per step;
+    - **score**: the fused scoring program runs once per *report* (reference default
+      cadence is minutes; ``report_interval`` steps here is conservative).
+
+    The honest per-step cost is ``push + score / report_interval``. Round 2
+    reported only the two endpoints (score-only 0.09 ms; push+score-every-step
+    9.09 ms) — neither is what users pay."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -140,17 +150,72 @@ def device_ring_scoring(data, counts):
     # warm both programs
     state, out = mt.score(state)
     jax.block_until_ready((state, out))
+
+    # -- push-only: what EVERY train step pays ------------------------------
+    push_iters = ITERS * 10
+    t0 = time.perf_counter()
+    for i in range(push_iters):
+        state = mt.push(state, rows[i % W])
+    jax.block_until_ready(state)
+    per_push = (time.perf_counter() - t0) / push_iters
+
+    # -- score: what a report round pays ------------------------------------
     t0 = time.perf_counter()
     for i in range(ITERS):
-        state = mt.push(state, rows[i % W])
+        state = mt.push(state, rows[i % W])  # keep counts non-zero between scores
         state, out = mt.score(state)
     jax.block_until_ready((state, out))
-    per_step = (time.perf_counter() - t0) / ITERS
+    per_score = (time.perf_counter() - t0) / ITERS - per_push
+
+    per_step = per_push + per_score / report_interval
+
     # Rebuild a full window so the F1 check sees real scores, not a 1-sample round.
     for i in range(W):
         state = mt.push(state, rows[i])
     _, out = mt.score(state)
-    return per_step, out
+    return per_step, per_push, per_score, out
+
+
+REPORT_INTERVAL = 100
+
+
+def run_variant_inprocess(variant: str) -> dict:
+    """Measure one device variant; invoked in a fresh subprocess by main() so
+    variants can't contaminate each other's dispatch latency (observed: measuring
+    the ring path after host-baseline + another compiled variant in one process
+    inflates push dispatch ~30×; isolated processes reproduce 0.02-0.03 ms)."""
+    data, counts, truth = make_telemetry()
+    if variant == "rings":
+        per_step, per_push, per_score, out = device_ring_scoring(
+            data, counts, REPORT_INTERVAL
+        )
+        mask = np.asarray(out.straggler)
+        return {
+            "per_step": per_step,
+            "per_push": per_push,
+            "per_score": per_score,
+            "f1": f1(mask, truth),
+        }
+    per_step, out = device_scoring(data, counts, use_pallas=(variant == "pallas"))
+    mask = np.asarray(out.straggler)
+    return {"per_step": per_step, "f1": f1(mask, truth)}
+
+
+def run_variant_subprocess(variant: str) -> dict | None:
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--variant", variant],
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if r.returncode != 0:
+            print(f"device[{variant}] failed:\n{r.stderr[-2000:]}", file=sys.stderr)
+            return None
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:
+        print(f"device[{variant}] failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def main():
@@ -171,43 +236,90 @@ def main():
     on_tpu = jax.default_backend() == "tpu"
 
     results = {}
-    variants = [("xla", False)] + ([("pallas", True)] if on_tpu else [])
-    for name, use_pallas in variants:
-        try:
-            per_step, out = device_scoring(data, counts, use_pallas)
-            mask = np.asarray(out.straggler)
-            results[name] = (per_step, f1(mask, truth))
+    for name in ["xla"] + (["pallas"] if on_tpu else []):
+        res = run_variant_subprocess(name)
+        if res is not None:
+            results[name] = (res["per_step"], res["f1"])
             print(
-                f"device[{name}]: {per_step * 1e3:.3f} ms/step, F1={results[name][1]:.3f}",
+                f"device[{name}]: {res['per_step'] * 1e3:.4f} ms/step, F1={res['f1']:.3f}",
                 file=sys.stderr,
             )
+
+    report_interval = REPORT_INTERVAL
+    rings = None
+    res = run_variant_subprocess("rings")
+    if res is None and not results:
+        # Every subprocess failed (e.g. a runtime that refuses a second client):
+        # degrade to an in-process measurement rather than emitting nothing.
+        print("all variant subprocesses failed; measuring in-process", file=sys.stderr)
+        try:
+            res = run_variant_inprocess("rings")
         except Exception as e:
-            print(f"device[{name}] failed: {e!r}", file=sys.stderr)
-    try:
-        per_step, out = device_ring_scoring(data, counts)
-        mask = np.asarray(out.straggler)
+            print(f"in-process rings failed too: {e!r}", file=sys.stderr)
+            res = None
+    if res is not None:
+        per_step, per_push, per_score = res["per_step"], res["per_push"], res["per_score"]
+        rings = (per_step, per_push, per_score, res["f1"])
         print(
-            f"device[rings: in-jit push + score]: {per_step * 1e3:.3f} ms/step, "
-            f"F1={f1(mask, truth):.3f}",
+            f"device[rings, honest hot loop]: push {per_push * 1e3:.4f} ms/step + "
+            f"score {per_score * 1e3:.3f} ms/report / {report_interval} steps "
+            f"= {per_step * 1e3:.4f} ms/step, F1={rings[3]:.3f}",
             file=sys.stderr,
         )
-        results["rings"] = (per_step, f1(mask, truth))
-    except Exception as e:
-        print(f"device[rings] failed: {e!r}", file=sys.stderr)
 
-    best_name, (best_s, best_f1) = min(results.items(), key=lambda kv: kv[1][0])
-    print(f"best variant: {best_name}", file=sys.stderr)
+    for name, (s, f) in results.items():
+        print(f"score-only[{name}]: {s * 1e3:.4f} ms/report", file=sys.stderr)
+    if rings is None and not results:
+        print(
+            json.dumps(
+                {
+                    "metric": "telemetry hot-loop cost (ALL VARIANTS FAILED; see stderr)",
+                    "value": None,
+                    "unit": "ms/step",
+                    "vs_baseline": 0,
+                }
+            )
+        )
+        return
+    if rings is None:
+        # Fall back to the score-only fused number if the ring path broke.
+        best_name, (best_s, best_f1) = min(results.items(), key=lambda kv: kv[1][0])
+        metric = (
+            f"fused telemetry scoring latency, {R} ranks x {S} signals x {W} window "
+            f"(F1={best_f1:.3f})"
+        )
+        value_s = best_s
+        vs = base_s / best_s
+    else:
+        per_step, per_push, per_score, rings_f1 = rings
+        metric = (
+            f"telemetry hot-loop cost, {R} ranks x {S} signals x {W} window: in-jit "
+            f"ring push/step + fused scoring/report amortized over {report_interval} "
+            f"steps (push {per_push * 1e3:.4f} ms, score {per_score * 1e3:.3f} ms, "
+            f"F1={rings_f1:.3f})"
+        )
+        value_s = per_step
+        # Baseline pays its host report at the same cadence plus zero per-step cost
+        # (its per-step ingestion is host-dict appends, unmeasurably small but also
+        # off-device); compare amortized report cost against amortized honest cost.
+        vs = (base_s / report_interval) / per_step
     print(
         json.dumps(
             {
-                "metric": f"fused telemetry scoring latency, {R} ranks x {S} signals x {W} window (F1={best_f1:.3f})",
-                "value": round(best_s * 1e3, 4),
+                "metric": metric,
+                "value": round(value_s * 1e3, 4),
                 "unit": "ms/step",
-                "vs_baseline": round(base_s / best_s, 2),
+                "vs_baseline": round(vs, 2),
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default=None, help="internal: measure one variant")
+    args = ap.parse_args()
+    if args.variant:
+        print(json.dumps(run_variant_inprocess(args.variant)))
+    else:
+        main()

@@ -106,8 +106,8 @@ def test_push_inside_jitted_step_with_donation(mesh):
 
     for _ in range(5):
         state, y = step(state, x)
-    assert int(np.asarray(state.cursor)[0]) == 5
-    np.testing.assert_allclose(np.asarray(state.data)[:, 0, :5], 8.0)
+    assert int(np.asarray(state.cursor)) == 5
+    np.testing.assert_allclose(np.asarray(state.data)[:5, :, 0], 8.0)
 
 
 def test_summary_path_matches_ring_path(mesh):

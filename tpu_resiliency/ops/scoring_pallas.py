@@ -17,11 +17,13 @@ The downstream scoring math (cross-rank min, weighted perf score, robust-z, EWMA
 plain ``jnp`` in ``telemetry/scoring.py`` — it is O(R·S) and XLA fuses it into a couple
 of reductions.
 
-Measured on v5e-1 (4096×64×32): XLA's native sort-based ``masked_median`` wins (~0.03-0.16
-ms/step vs ~5.7 ms for this kernel — the O(W²) rank-counting trades poorly against XLA's
-vectorized sort at W=32, and the W-minor layout pads 32→128 lanes). The scoring pipeline
-therefore defaults to the XLA path (``use_pallas=False``); this kernel is kept as the
-hand-fusion alternative and exercised by tests + bench for correctness parity.
+Measured on v5e-1 (4096×64×32), in **process-isolated** benchmarks (see BASELINE.md
+"Pallas verdict"): this kernel scores a round in 0.028-0.030 ms — parity to slightly
+faster than XLA's sort-based ``masked_median`` lowering (0.028-0.11 ms across runs).
+Earlier rounds reported it ~100× slower; that was an in-process measurement-ordering
+artifact, not the kernel. The pipeline default stays ``use_pallas=False`` (XLA is
+equally fast and shape-generic); the kernel is the hand-fusion alternative, exercised
+by tests + bench for parity.
 """
 
 from __future__ import annotations

@@ -4,8 +4,8 @@ The reference aggregates straggler telemetry by packing host dicts into tensors 
 running ``all_reduce``/``gather`` through NCCL with Python pack/unpack loops on every
 report (``straggler/reporting.py:255-296,338-419``); round 1 of this framework still
 gathered pickled summaries through the coordination store one rank at a time. This
-module is the replacement: telemetry lives in HBM as a ``[R, S, W]`` ring array
-**sharded over a mesh axis** (each device owns its ranks' rows), is appended to from
+module is the replacement: telemetry lives in HBM as a window-major ``[W, R, S]``
+ring array **sharded over a mesh axis** (each device owns its ranks' rows), is appended to from
 inside the jitted train step (donated carry — no host round-trip per step), and is
 scored by the fused pipeline under ``jax.shard_map`` where the cross-rank reductions
 are XLA collectives over ICI (``telemetry/scoring.py``). Host Python touches the data
@@ -47,11 +47,18 @@ DEFAULT_WINDOW = 32
 
 @dataclasses.dataclass
 class TelemetryState:
-    """The device-resident carry: rings + scoring state, all sharded ``P(axis)``."""
+    """The device-resident carry: rings + scoring state, sharded over the rank axis.
 
-    data: Any  # f32 [R, S, W] timing windows
+    Ring layout is ``[W, R, S]`` (window-major): one push writes the contiguous
+    ``[1, R, S]`` slab at the cursor via ``dynamic_update_slice`` — O(R·S) bytes
+    touched in-place on the donated buffer, where an ``[R, S, W]`` one-hot scatter
+    re-materialized the whole O(R·S·W) ring every step (the round-2 push cost).
+    The scorer consumes ``[R, S, W]``; the transpose happens once per *report*,
+    amortized to noise."""
+
+    data: Any  # f32 [W, R, S] timing windows, window-major
     counts: Any  # i32 [R, S] valid samples per window
-    cursor: Any  # i32 [R] ring write position
+    cursor: Any  # i32 [] scalar ring write position (ranks advance in lockstep)
     ewma: Any  # f32 [R] smoothed perf score, carried across reports
     hist_min: Any  # f32 [R, S] rank-historical best medians
 
@@ -143,20 +150,23 @@ class MeshTelemetry:
     def init_state(self) -> TelemetryState:
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         r, s, w = self.n_ranks, self.n_signals, self.window
         shard = self._row_sharding
+        data_shard = NamedSharding(self.mesh, P(None, self.axis))
+        replicated = NamedSharding(self.mesh, P())
 
         def init():
             return TelemetryState(
-                data=jnp.zeros((r, s, w), jnp.float32),
+                data=jnp.zeros((w, r, s), jnp.float32),
                 counts=jnp.zeros((r, s), jnp.int32),
-                cursor=jnp.zeros((r,), jnp.int32),
+                cursor=jnp.zeros((), jnp.int32),
                 ewma=jnp.ones((r,), jnp.float32),
                 hist_min=jnp.full((r, s), jnp.inf, jnp.float32),
             )
 
-        out_shardings = TelemetryState(shard, shard, shard, shard, shard)
+        out_shardings = TelemetryState(data_shard, shard, replicated, shard, shard)
         return jax.jit(init, out_shardings=out_shardings)()
 
     # -- in-jit ingestion --------------------------------------------------
@@ -164,15 +174,17 @@ class MeshTelemetry:
     @staticmethod
     def _push_impl(state: TelemetryState, values) -> TelemetryState:
         import jax.numpy as jnp
+        from jax import lax
 
-        w = state.data.shape[-1]
+        w = state.data.shape[0]
         values = jnp.asarray(values, state.data.dtype)
-        idx = state.cursor % w  # [R]
-        # One-hot scatter along the window axis: pure elementwise + broadcast, so the
-        # update shards over the rank axis with no collectives and no host sync.
-        slot = jnp.arange(w, dtype=jnp.int32)[None, None, :] == idx[:, None, None]
+        idx = state.cursor % w
+        # Contiguous [1, R, S] slab write at the cursor: with the donated carry this
+        # lowers to an in-place dynamic-update-slice touching O(R·S) bytes; the
+        # start offset is only in the unsharded window axis, so the update shards
+        # over the rank axis with no collectives and no host sync.
         return TelemetryState(
-            data=jnp.where(slot, values[:, :, None], state.data),
+            data=lax.dynamic_update_slice(state.data, values[None], (idx, 0, 0)),
             counts=jnp.minimum(state.counts + 1, w),
             cursor=state.cursor + 1,
             ewma=state.ewma,
@@ -192,7 +204,10 @@ class MeshTelemetry:
     def _score_reset_impl(self, state: TelemetryState):
         import jax.numpy as jnp
 
-        scores = self._scorer(state.data, state.counts, state.ewma, state.hist_min)
+        # The scorer consumes [R, S, W]; this transpose is per-report, not per-step,
+        # and stays local to each shard (the window axis is unsharded).
+        data_rsw = jnp.transpose(state.data, (1, 2, 0))
+        scores = self._scorer(data_rsw, state.counts, state.ewma, state.hist_min)
         new_state = TelemetryState(
             data=state.data,  # stale samples are masked by counts=0
             counts=jnp.zeros_like(state.counts),
