@@ -1,0 +1,181 @@
+"""Miniature resilient training app: the analogue of the reference's
+``tests/inprocess/app.py`` (a real distributed workload driven through real faults
+by ``test_app.py``), re-designed TPU-first.
+
+Each rank is a standalone JAX process with a local device mesh. The train loop is a
+*sharded jitted* step on the tiny transformer (``models/transformer.py``): tokens are
+sharded over the mesh's ``dp`` axis while params stay replicated, so XLA inserts the
+cross-device gradient reduction — a real collective inside the step. Local
+checkpoints are clique-replicated across ranks (factor 2), so every rank's disk holds
+its peer's shard mirror.
+
+The restart contract exercised end to end (SURVEY §7 step 5):
+
+- iteration 0, world 2: train + replicated checkpoints; one rank is killed hard;
+- iteration 1, world 1: the survivor re-enters with a RESHAPED mesh (the dp/tp split
+  changes with the active world), re-jits, restores its own shard onto the new mesh's
+  shardings, and reconstructs the dead rank's state from the clique mirror on its own
+  disk (``LocalCheckpointManager.load_shard``) — no store gather, no dead-peer I/O.
+
+Invoked as: ``python app.py <rank> <world> <steps> <kill_step> <ckpt_root>``
+(RANK/WORLD_SIZE/TPU_RESILIENCY_STORE_* come from the environment, set by test_app).
+Prints ``APP-RESULT {json}`` on success.
+"""
+
+import json
+import os
+import sys
+import time
+
+rank_arg, world_arg, steps_arg, kill_step_arg, ckpt_root = sys.argv[1:6]
+RANK, WORLD = int(rank_arg), int(world_arg)
+STEPS, KILL_STEP = int(steps_arg), int(kill_step_arg)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_resiliency.checkpoint import (
+    CliqueReplicationStrategy,
+    LocalCheckpointManager,
+    PyTreeStateDict,
+)
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
+from tpu_resiliency.inprocess.wrap import CallWrapper, Wrapper
+from tpu_resiliency.models.transformer import TransformerConfig, make_train_step, init_params
+from tpu_resiliency.platform.store import CoordStore, store_addr_from_env
+
+CFG = TransformerConfig.tiny(n_layers=1, d_model=32, d_ff=64, n_heads=2, n_kv_heads=1,
+                             max_seq_len=16, dtype=jnp.float32)
+BATCH, SEQ = 4, 16
+SAVE_STEPS = (2, 4)
+
+
+def build_mesh(active_world: int) -> Mesh:
+    """The mesh RESHAPES with the world: 2 ranks → local (dp=2, tp=2);
+    1 rank → local (dp=4, tp=1). Restart must re-jit against the new split."""
+    devs = np.asarray(jax.devices()[:4])
+    if active_world >= 2:
+        return Mesh(devs.reshape(2, 2), ("dp", "tp"))
+    return Mesh(devs.reshape(4, 1), ("dp", "tp"))
+
+
+def make_ckpt_stack(store_prefix: str, rank: int, world: int):
+    """Fresh per-iteration checkpoint stack. World >= 2: store comm + clique
+    replication (factor 2). World 1: purely local."""
+    if world < 2:
+        return LocalCheckpointManager(ckpt_root, rank=rank)
+    host, port = store_addr_from_env()
+    store = CoordStore(host, port, prefix=store_prefix)
+    comm = StoreComm(store.scoped("comm/"), rank, list(range(world)), timeout=60.0)
+    ex = PeerExchange(store.scoped("px/"), rank, timeout=60.0)
+    ex.start()  # bind the p2p listener + publish this rank's address
+    repl = CliqueReplicationStrategy(
+        StoreComm(store.scoped("repl/"), rank, list(range(world)), timeout=60.0),
+        ex,
+        replication_jump=1,
+        replication_factor=2,
+    )
+    return LocalCheckpointManager(ckpt_root, rank=rank, comm=comm, replication=repl)
+
+
+@Wrapper(
+    monitor_interval=0.05,
+    last_call_wait=0.1,
+    # Generous progress timeouts: the first XLA compile of the sharded step runs
+    # tens of seconds on CPU, and the watchdog's pending-call auto-heartbeat
+    # cannot fire inside a long C++ call (same reality as the reference's 60 s
+    # default soft timeout).
+    soft_timeout=120.0,
+    hard_timeout=240.0,
+    heartbeat_interval=0.2,
+    heartbeat_timeout=30.0,
+    barrier_timeout=240.0,
+    completion_timeout=240.0,
+)
+def train(call: CallWrapper):
+    fs = call.frozen_state
+    me, active_world, it = fs.initial_rank, fs.active_world_size, fs.iteration
+    mesh = build_mesh(active_world)
+    replicated = NamedSharding(mesh, P())
+    tokens_sharding = NamedSharding(mesh, P("dp"))
+
+    train_step, init_opt = make_train_step(CFG)
+    step_jit = jax.jit(train_step)
+
+    rng = np.random.default_rng(1234 + me)
+    params = jax.device_put(init_params(jax.random.PRNGKey(0), CFG), replicated)
+    opt_state = jax.device_put(init_opt(params), replicated)
+    # The rank-owned shard: proves post-shrink reconstruction from clique mirrors.
+    stats = jnp.zeros((8,), jnp.float32) + float(me) * 100.0
+
+    mgr = make_ckpt_stack(f"app/iter{it}/", me, active_world if it == 0 else 1)
+    start_step = 0
+    recovered_stats = None
+    latest = mgr.find_latest()
+    if latest >= 0:
+        shardings = [replicated] * len(
+            jax.tree_util.tree_leaves({"params": params, "opt": opt_state, "stats": stats})
+        )
+        tree, meta = mgr.load_tree(latest, shardings=shardings)
+        params, opt_state, stats = tree["params"], tree["opt"], tree["stats"]
+        start_step = int(meta["iteration"]) + 1
+        if active_world < WORLD:
+            # Survivor path: rebuild the dead ranks' shards from local mirrors.
+            recovered_stats = {}
+            for owner in range(WORLD):
+                if owner == me:
+                    recovered_stats[owner] = np.asarray(stats)
+                    continue
+                hollow, tensors, _ = mgr.load_shard(owner, latest)
+                sd = PyTreeStateDict.from_hollow(
+                    hollow, tensors, shardings=[replicated] * len(tensors)
+                )
+                recovered_stats[owner] = np.asarray(sd.tree["stats"])
+
+    mesh_shape = dict(mesh.shape)
+    loss = jnp.zeros(())  # stays zero when the restored start_step is >= STEPS
+    for step in range(start_step, STEPS):
+        if it == 0 and me == 1 and step == KILL_STEP:
+            os._exit(9)  # hard death: the survivor must carry on without us
+        tokens = jax.device_put(
+            jnp.asarray(rng.integers(0, CFG.vocab_size, (BATCH, SEQ)), jnp.int32),
+            tokens_sharding,
+        )
+        params, opt_state, loss = step_jit(params, opt_state, tokens)
+        stats = stats + 1.0
+        call.ping()
+        time.sleep(0.25)
+        if it == 0 and step in SAVE_STEPS:
+            mgr.save(
+                step,
+                PyTreeStateDict({"params": params, "opt": opt_state, "stats": stats}),
+                is_async=False,
+            )
+    loss.block_until_ready()
+    mgr.close()
+    return {
+        "rank": me,
+        "iteration": it,
+        "active_world": active_world,
+        "mesh": mesh_shape,
+        "start_step": start_step,
+        "final_loss": float(loss),
+        "stats": np.asarray(stats).tolist(),
+        "recovered_stats": (
+            {k: v.tolist() for k, v in recovered_stats.items()}
+            if recovered_stats is not None
+            else None
+        ),
+    }
+
+
+if __name__ == "__main__":
+    result = train()
+    print("APP-RESULT " + json.dumps(result), flush=True)

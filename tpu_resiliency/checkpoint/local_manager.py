@@ -216,13 +216,12 @@ class LocalCheckpointManager:
         my_id = CkptID(iteration, self.rank, self.session)
         path = self._path(my_id)
         if os.path.exists(path):
-            blob = None
             if self.comm is not None and self.replication is not None:
                 # Participate in the collective retrieve even when locally satisfied.
                 self.replication.retrieve(
                     None, self._held_owners(iteration), lambda o: self._read_blob(iteration, o)
                 )
-            hollow_b, tensors, meta = ckpt_format.read_payload(path)
+            return self._read_local_shard(iteration, self.rank)
         else:
             if self.replication is None:
                 raise CheckpointError(
@@ -252,6 +251,37 @@ class LocalCheckpointManager:
         hollow, tensors, meta = self.load(iteration)
         sd = PyTreeStateDict.from_hollow(hollow, tensors, shardings=shardings, device=device)
         return sd.tree, meta
+
+    def load_shard(
+        self, owner: int, iteration: Optional[int] = None
+    ) -> tuple[Any, list, dict]:
+        """Load a locally-held shard belonging to ``owner`` (own shard or a clique
+        mirror) — the reshard path after a world shrink: a survivor reconstructs a
+        departed rank's state from the mirror its replication clique left on this
+        rank's disk. Strictly local, no collective participation — including the
+        default ``iteration``, which is the newest iteration whose ``owner`` shard
+        is on this rank's disk (NOT ``find_latest()``, whose coverage agreement
+        would all-gather over a group that may contain the dead peer). Returns
+        ``(hollow_tree, host_tensors, meta)`` like :meth:`load`."""
+        if iteration is None:
+            held = [i.iteration for i in self.local_ids() if i.owner == owner]
+            if not held:
+                raise CheckpointError(
+                    f"rank {self.rank} holds no shards for owner {owner}"
+                )
+            iteration = max(held)
+        return self._read_local_shard(iteration, owner)
+
+    def _read_local_shard(self, iteration: int, owner: int) -> tuple[Any, list, dict]:
+        """Shared local-disk read tail for :meth:`load` / :meth:`load_shard`."""
+        path = self._path(CkptID(iteration, owner, self.session))
+        if not os.path.exists(path):
+            raise CheckpointError(
+                f"rank {self.rank} holds no shard for owner {owner} @ iteration "
+                f"{iteration} (held: {sorted(self._held_owners(iteration))})"
+            )
+        hollow_b, tensors, meta = ckpt_format.read_payload(path)
+        return pickle.loads(hollow_b), tensors, meta
 
     def _held_owners(self, iteration: int) -> set[int]:
         return {i.owner for i in self.local_ids() if i.iteration == iteration}
