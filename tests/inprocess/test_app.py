@@ -52,9 +52,14 @@ def test_app_restart_reshards_and_recovers(tmp_path):
             )
         )
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=240)
-        outs.append((p.returncode, out, err))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     # Rank 1 died hard at KILL_STEP.
     assert outs[1][0] == 9, f"rank 1: rc={outs[1][0]}\n{outs[1][1]}\n{outs[1][2]}"

@@ -90,6 +90,7 @@ def test_layered_inprocess_then_injob_restart(tmp_path):
     env = dict(os.environ)
     env["TPU_RESILIENCY_LOG_LEVEL"] = "INFO"
     log_dir = tmp_path / "logs"
+    events_file = tmp_path / "events.jsonl"
     cmd = [
         sys.executable, "-m", "tpu_resiliency.launcher.launch",
         "--nproc-per-node", "2",
@@ -100,6 +101,7 @@ def test_layered_inprocess_then_injob_restart(tmp_path):
         "--monitor-interval", "0.1",
         "--run-dir", str(tmp_path / "run"),
         "--log-dir", str(log_dir),
+        "--events-file", str(events_file),
         str(script),
     ]
     r = subprocess.run(
@@ -152,3 +154,32 @@ def test_layered_inprocess_then_injob_restart(tmp_path):
     assert any("state=handling_completed" in ln for ln in inproc)
     # The successful round finalized.
     assert any("state=finalized" in ln for ln in inproc)
+
+    # --- the structured event stream tells the same story, machine-readably ----
+    from tpu_resiliency.utils.events import read_events
+
+    evs = read_events(str(events_file))
+    kinds = [(e["source"], e["kind"]) for e in evs]
+    assert ("launcher", "rendezvous_round") in kinds
+    assert ("launcher", "worker_failed") in kinds
+    assert ("launcher", "restart_requested") in kinds
+    assert ("launcher", "round_succeeded") in kinds
+    assert ("inprocess", "iteration_start") in kinds
+    assert ("inprocess", "fn_exception") in kinds
+    assert ("inprocess", "restart_signalled") in kinds
+    assert ("inprocess", "completed") in kinds
+    # The in-process layer handled fault (a) inside launcher round 0: its restart
+    # events precede the in-job worker_failed record.
+    first_inproc_restart = next(
+        i for i, k in enumerate(kinds) if k == ("inprocess", "restart_signalled")
+    )
+    first_worker_failed = next(
+        i for i, k in enumerate(kinds) if k == ("launcher", "worker_failed")
+    )
+    assert first_inproc_restart < first_worker_failed
+    # Two rendezvous rounds total (0 and the respawn), each completed.
+    rounds = {e["round"] for e in evs if e["kind"] == "rendezvous_round"}
+    assert rounds == {0, 1}
+    # Exactly one worker death was recorded, with its exit code.
+    deaths = [e for e in evs if e["kind"] == "worker_failed"]
+    assert len(deaths) == 1 and deaths[0]["exitcode"] == 13

@@ -1,0 +1,93 @@
+"""Structured event stream: records, sinks, env wiring, @prof timing."""
+
+import json
+import os
+
+import pytest
+
+from tpu_resiliency.utils import events
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    old = os.environ.pop(events.EVENTS_FILE_ENV, None)
+    yield
+    events.clear_sinks()
+    if old is not None:
+        os.environ[events.EVENTS_FILE_ENV] = old
+
+
+def test_record_to_jsonl_sink(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    sink = events.JsonlSink(path)
+    events.add_sink(sink)
+    events.record("launcher", "rendezvous_round", round=3, world_size=8)
+    events.record("inprocess", "restart_signalled", iteration=1)
+    sink.close()
+    recs = events.read_events(path)
+    assert [r["kind"] for r in recs] == ["rendezvous_round", "restart_signalled"]
+    assert recs[0]["source"] == "launcher" and recs[0]["round"] == 3
+    assert recs[0]["pid"] == os.getpid()
+    assert "ts" in recs[0]
+
+
+def test_env_var_wires_sink(tmp_path):
+    path = str(tmp_path / "env_ev.jsonl")
+    os.environ[events.EVENTS_FILE_ENV] = path
+    events.record("watchdog", "hang_detected", global_rank=5, reason="hb timeout")
+    recs = events.read_events(path)
+    assert len(recs) == 1 and recs[0]["global_rank"] == 5
+
+
+def test_rank_from_env(tmp_path, monkeypatch):
+    path = str(tmp_path / "r.jsonl")
+    events.add_sink(events.JsonlSink(path))
+    monkeypatch.setenv("RANK", "7")
+    events.record("checkpoint", "ckpt_saved", iteration=40)
+    assert events.read_events(path)[0]["rank"] == 7
+
+
+def test_sink_failure_never_raises():
+    def bad_sink(ev):
+        raise RuntimeError("sink down")
+
+    events.add_sink(bad_sink)
+    events.record("launcher", "anything")  # must not raise
+
+
+def test_reserved_payload_keys_do_not_collide(tmp_path):
+    path = str(tmp_path / "c.jsonl")
+    events.add_sink(events.JsonlSink(path))
+    events.record("x", "y", ts=123, pid=-1)
+    rec = events.read_events(path)[0]
+    assert rec["source"] == "x" and rec["ts"] != 123  # envelope wins
+    assert rec["p_ts"] == 123 and rec["p_pid"] == -1
+
+
+def test_prof_decorator(tmp_path):
+    path = str(tmp_path / "p.jsonl")
+    events.add_sink(events.JsonlSink(path))
+
+    @events.prof("checkpoint")
+    def work(x):
+        return x * 2
+
+    @events.prof("checkpoint", name="explode")
+    def bad():
+        raise ValueError("nope")
+
+    assert work(21) == 42
+    with pytest.raises(ValueError):
+        bad()
+    recs = events.read_events(path)
+    assert recs[0]["kind"] == "timing" and recs[0]["name"] == "work" and recs[0]["ok"]
+    assert recs[1]["name"] == "explode" and not recs[1]["ok"]
+    assert "ValueError" in recs[1]["error"]
+    assert recs[0]["duration_s"] >= 0
+
+
+def test_read_events_tolerates_torn_line(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(json.dumps({"kind": "a"}) + "\n" + '{"kind": "b", "tru')
+    assert [r["kind"] for r in events.read_events(str(path))] == ["a"]

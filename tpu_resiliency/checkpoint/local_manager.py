@@ -31,6 +31,7 @@ from tpu_resiliency.checkpoint.comm import StoreComm
 from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
 from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 import pickle
@@ -161,10 +162,19 @@ class LocalCheckpointManager:
         """Verify coverage of ``iteration`` across ranks, then prune older iterations."""
         covered = self._covered_iterations()
         if iteration not in covered:
+            record_event(
+                "checkpoint", "ckpt_save_incomplete", iteration=iteration,
+                owner_rank=self.rank, covered=sorted(covered)[-3:],
+            )
             raise CheckpointError(
                 f"checkpoint iteration {iteration} incomplete after save "
                 f"(covered: {sorted(covered)[-3:]})"
             )
+        # Only after coverage verification: ckpt_saved is a durability signal.
+        record_event(
+            "checkpoint", "ckpt_saved", iteration=iteration, owner_rank=self.rank,
+            held=sorted(i.owner for i in self.local_ids() if i.iteration == iteration),
+        )
         # Keep only the newest fully-covered iteration (the reference's retention
         # policy: local ckpts are a recovery buffer, not an archive).
         for ckpt_id in self.local_ids():

@@ -53,6 +53,7 @@ from tpu_resiliency.inprocess.rank_assignment import (
 )
 from tpu_resiliency.inprocess.state import Mode, State
 from tpu_resiliency.platform.store import host_store, store_addr_from_env
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -315,6 +316,11 @@ class CallWrapper:
                 self.monitor_process.start_iteration(iteration)
 
             frozen = state.freeze()
+            record_event(
+                "inprocess", "iteration_start", iteration=iteration,
+                initial_rank=state.initial_rank, active_rank=state.active_rank,
+                active_world=state.active_world_size, mode=state.mode.name,
+            )
             abort_fn = (
                 (lambda: self._chain(w.abort, state.freeze())) if w.abort else None
             )
@@ -357,6 +363,10 @@ class CallWrapper:
                         # rank's iteration-barrier wait and eject a healthy rank.
                         raise RankShouldRestart from None
                     self._chain(w.completion, state.freeze())
+                    record_event(
+                        "inprocess", "completed", iteration=iteration,
+                        initial_rank=state.initial_rank,
+                    )
                     monitor.shutdown()  # before the store closes under its poll loop
                     self._shutdown_clean()
                     return ret
@@ -381,6 +391,10 @@ class CallWrapper:
                         log.info(
                             f"rank {state.rank}: restart signalled (iter {iteration}, {e!r})"
                         )
+                        record_event(
+                            "inprocess", "restart_signalled", iteration=iteration,
+                            initial_rank=state.initial_rank,
+                        )
                         restart = True
                     elif isinstance(e, Exception):
                         state.fn_exception = e
@@ -389,6 +403,10 @@ class CallWrapper:
                         )
                         log.warning(
                             f"rank {state.rank}: wrapped fn raised {e!r} (iter {iteration})"
+                        )
+                        record_event(
+                            "inprocess", "fn_exception", iteration=iteration,
+                            initial_rank=state.initial_rank, error=repr(e),
                         )
                         restart = True
                     else:
@@ -403,6 +421,10 @@ class CallWrapper:
                         )
                         log.warning(
                             f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
+                        )
+                        record_event(
+                            "inprocess", "rank_terminated", iteration=iteration,
+                            initial_rank=state.initial_rank, error=repr(e),
                         )
                         self._terminate_and_leave(monitor, state)
                         raise

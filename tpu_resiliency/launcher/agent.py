@@ -35,6 +35,7 @@ from tpu_resiliency.launcher.rendezvous import (
 )
 from tpu_resiliency.platform import ipc
 from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
 from tpu_resiliency.watchdog.data import WorkloadAction, WorkloadControlRequest
@@ -136,6 +137,10 @@ class ElasticAgent:
                         f"restart budget exhausted ({self.cfg.max_restarts})"
                     )
                     self.restarter.aborted()
+                    record_event(
+                        "launcher", "budget_exhausted",
+                        node_id=self.cfg.node_id, max_restarts=self.cfg.max_restarts,
+                    )
                     raise WorkersFailed(
                         f"restart budget ({self.cfg.max_restarts}) exhausted", {}
                     )
@@ -210,6 +215,11 @@ class ElasticAgent:
             f"[{cfg.node_id}] round {outcome.round}: node_rank={node_rank} "
             f"world={world_size} nodes={outcome.active} spares={outcome.spares}"
         )
+        record_event(
+            "launcher", "rendezvous_round", round=outcome.round,
+            node_id=cfg.node_id, node_rank=node_rank, world_size=world_size,
+            active=list(outcome.active), spares=list(outcome.spares),
+        )
         base_env = {
             "NODE_RANK": str(node_rank),
             "GROUP_RANK": str(node_rank),
@@ -259,6 +269,10 @@ class ElasticAgent:
                 group.reap()
                 self._last_exitcodes = {k: v for k, v in group.exitcodes().items()}
                 self.rdzv.mark_done(outcome.round)
+                record_event(
+                    "launcher", "round_succeeded", round=outcome.round,
+                    node_id=cfg.node_id, exitcodes=dict(self._last_exitcodes),
+                )
                 return self._await_group_completion(outcome, epoch0)
             if state is GroupState.FAILED:
                 return self._handle_failure(group, outcome)
@@ -309,6 +323,11 @@ class ElasticAgent:
         failures = group.failures()
         for f in failures:
             log.error(f"[{cfg.node_id}] worker failed: {f.describe()}")
+            record_event(
+                "launcher", "worker_failed", round=outcome.round,
+                node_id=cfg.node_id, global_rank=f.global_rank,
+                exitcode=f.exitcode, detail=f.describe(),
+            )
         group.stop(cfg.term_grace)
         # Budget accounting lives in run() (epoch deltas); here we only pre-check
         # whether the round we are about to request would bust it.
@@ -326,6 +345,10 @@ class ElasticAgent:
         if cfg.restart_policy == "min-healthy":
             self.rdzv.set_health(False, failures[0].describe() if failures else "")
             self._wait_min_healthy()
+        record_event(
+            "launcher", "restart_requested", round=outcome.round, node_id=cfg.node_id,
+            reason="; ".join(f.describe() for f in failures),
+        )
         self.rdzv.request_restart(
             f"node {cfg.node_id}: " + "; ".join(f.describe() for f in failures)
         )
@@ -377,6 +400,11 @@ class ElasticAgent:
             log.info(
                 f"[{self.cfg.node_id}] control request {msg.action.name} "
                 f"from rank {msg.sender.global_rank if msg.sender else '?'}: {msg.reason}"
+            )
+            record_event(
+                "launcher", "control_request", node_id=self.cfg.node_id,
+                action=msg.action.name, reason=msg.reason,
+                sender=msg.sender.global_rank if msg.sender else None,
             )
             if msg.action is WorkloadAction.ExcludeThisNode:
                 return "excluded"

@@ -28,6 +28,7 @@ from typing import Optional
 
 from tpu_resiliency.launcher.agent import AgentConfig, ElasticAgent, WorkersFailed
 from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore, KVServer
+from tpu_resiliency.utils.events import EVENTS_FILE_ENV
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
 
@@ -81,6 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--upscaling-enabled", action="store_true")
     p.add_argument("--term-grace", type=float, default=15.0)
     p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
+    p.add_argument(
+        "--events-file",
+        default=None,
+        help="JSONL structured-event stream shared by the agent and every worker "
+        "(exports $TPU_RESILIENCY_EVENTS_FILE; default: inherit the env var)",
+    )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
     p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
@@ -204,6 +211,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         else FaultToleranceConfig()
     )
     ft_cfg = FaultToleranceConfig.from_args(ft_ns, base=base_ft)
+
+    if args.events_file:
+        # One exported variable wires the whole tree: the agent records through it
+        # and every spawned worker/monitor inherits it (events.py env sink).
+        os.environ[EVENTS_FILE_ENV] = os.path.abspath(args.events_file)
 
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     store, server, store_host, store_port = host_or_connect_store(args.rdzv_endpoint)

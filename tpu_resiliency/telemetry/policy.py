@@ -27,6 +27,7 @@ from typing import Callable, Optional
 
 from tpu_resiliency.telemetry import scoring
 from tpu_resiliency.telemetry.reporting import Report
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -108,6 +109,12 @@ class HealthVectorPolicy:
             flagged=frozenset(flagged),
         )
         if decision.changed:
+            record_event(
+                "telemetry", "degraded_set",
+                degraded=sorted(decision.degraded),
+                newly=sorted(decision.newly_degraded),
+                recovered=sorted(decision.recovered),
+            )
             log.warning(
                 f"health vector: degraded={sorted(decision.degraded)} "
                 f"(+{sorted(newly)} -{sorted(recovered)})"

@@ -1,0 +1,208 @@
+"""Structured event stream: machine-consumable records of the resiliency story.
+
+Analogue of the reference's torchelastic events/metrics layer
+(``fault_tolerance/_torch_elastic_compat/events/__init__.py`` — structured event
+records with pluggable handlers — and ``metrics/api.py``'s ``@prof`` timing
+decorator, used at ``launcher.py:247,548,640``). Log lines tell humans what
+happened; this stream tells machines: every rendezvous round, restart, fault
+detection, checkpoint save, and degraded-set transition is one self-describing
+record.
+
+Design:
+
+- :class:`Event`: ``(ts, source, kind, payload)`` plus process identity (pid, rank
+  when known) — everything JSON-serializable.
+- Pluggable sinks registered per process (``add_sink``); the default wiring is
+  environment-driven: ``TPU_RESILIENCY_EVENTS_FILE=<path>`` attaches a JSONL sink,
+  so a launcher enables one stream for itself and every worker it spawns by
+  exporting a single variable. JSONL lines are written in one ``write()`` call —
+  atomic under POSIX append semantics for lines < PIPE_BUF, so all processes of a
+  node can share one file.
+- ``record(source, kind, **payload)``: fire-and-forget; a sink failure never
+  breaks the workload (events are observability, not control flow).
+- ``@prof``: times a callable and records a ``timing`` event with success/failure,
+  the reference's ``@prof`` metric decorator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+EVENTS_FILE_ENV = "TPU_RESILIENCY_EVENTS_FILE"
+
+
+@dataclasses.dataclass
+class Event:
+    ts: float
+    source: str
+    kind: str
+    payload: dict
+    pid: int = dataclasses.field(default_factory=os.getpid)
+    rank: Optional[int] = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "ts": self.ts,
+                "source": self.source,
+                "kind": self.kind,
+                "pid": self.pid,
+                "rank": self.rank,
+                **{f"p_{k}" if k in ("ts", "source", "kind", "pid", "rank") else k: v
+                   for k, v in self.payload.items()},
+            },
+            default=repr,
+        )
+
+
+class JsonlSink:
+    """Appends one JSON line per event. Safe to share across processes: each event
+    is a single ``write()`` of one line."""
+
+    def __init__(self, path: str):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._lock = threading.Lock()
+        self._f = open(path, "a", buffering=1)
+
+    def __call__(self, event: Event) -> None:
+        with self._lock:
+            self._f.write(event.to_json() + "\n")
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+
+class LoggingSink:
+    """Mirrors events into the standard log at DEBUG (for interleaved debugging)."""
+
+    def __call__(self, event: Event) -> None:
+        log.debug(f"[event] {event.to_json()}")
+
+
+_sinks: list[Callable[[Event], None]] = []
+_sinks_lock = threading.Lock()
+_env_wired_for: Optional[str] = None
+
+
+def add_sink(sink: Callable[[Event], None]) -> None:
+    with _sinks_lock:
+        _sinks.append(sink)
+
+
+def remove_sink(sink: Callable[[Event], None]) -> None:
+    with _sinks_lock:
+        try:
+            _sinks.remove(sink)
+        except ValueError:
+            pass
+
+
+def clear_sinks() -> None:
+    with _sinks_lock:
+        _sinks.clear()
+    global _env_wired_for
+    _env_wired_for = None
+
+
+def _wire_env_sink() -> None:
+    """Attach (once per path) the JSONL sink named by $TPU_RESILIENCY_EVENTS_FILE.
+    Re-checked on every record so a launcher exporting the variable after import
+    still takes effect, and forked/spawned children wire themselves lazily."""
+    global _env_wired_for
+    path = os.environ.get(EVENTS_FILE_ENV)
+    if not path or path == _env_wired_for:
+        return
+    with _sinks_lock:
+        if _env_wired_for == path:
+            return
+        try:
+            _sinks.append(JsonlSink(path))
+            _env_wired_for = path
+        except OSError as e:
+            log.warning(f"cannot open events file {path!r}: {e}")
+            _env_wired_for = path  # don't retry every event
+
+
+def record(source: str, kind: str, **payload: Any) -> None:
+    """Record one event; never raises. ``rank`` is read from $RANK when present."""
+    _wire_env_sink()
+    with _sinks_lock:
+        sinks = list(_sinks)
+    if not sinks:
+        return
+    rank_s = os.environ.get("RANK")
+    ev = Event(
+        ts=time.time(),
+        source=source,
+        kind=kind,
+        payload=payload,
+        rank=int(rank_s) if rank_s and rank_s.isdigit() else None,
+    )
+    for sink in sinks:
+        try:
+            sink(ev)
+        except Exception:
+            log.debug("event sink failed", exc_info=True)
+
+
+def prof(source: str, name: Optional[str] = None):
+    """Decorator: time the call, record a ``timing`` event with success/failure
+    (reference ``metrics/api.py`` ``@prof``)."""
+
+    def deco(fn: Callable):
+        label = name or getattr(fn, "__name__", "call")
+
+        def wrapped(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                out = fn(*args, **kwargs)
+            except BaseException as e:
+                record(
+                    source, "timing", name=label,
+                    duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
+                )
+                raise
+            record(
+                source, "timing", name=label,
+                duration_s=time.perf_counter() - t0, ok=True,
+            )
+            return out
+
+        wrapped.__name__ = getattr(fn, "__name__", label)
+        wrapped.__wrapped__ = fn
+        return wrapped
+
+    return deco
+
+
+def read_events(path: str) -> list[dict]:
+    """Parse a JSONL event file (tolerates torn trailing lines)."""
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out

@@ -20,6 +20,7 @@ import time
 from typing import Optional
 
 from tpu_resiliency.platform import framing
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import RankLoggerAdapter, get_logger
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
 from tpu_resiliency.watchdog.data import (
@@ -258,11 +259,13 @@ class RankMonitorServer:
                 if self.session is None or self.session.terminated:
                     continue
                 now = time.monotonic()
+                cause = "hang"
                 reason = self._hb_timeout_elapsed(now) or self._section_timeout_elapsed(now)
                 if reason is None and self._health_failure is not None:
                     reason = f"health check failed: {self._health_failure}"
+                    cause = "health"
                 if reason is not None:
-                    self._terminate_rank(reason)
+                    self._terminate_rank(reason, cause)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -273,9 +276,17 @@ class RankMonitorServer:
     def _on_health_failure(self, check: HealthCheck) -> None:
         self._health_failure = check.describe()
 
-    def _terminate_rank(self, reason: str) -> None:
+    def _terminate_rank(self, reason: str, cause: str = "hang") -> None:
         s = self.session
         s.terminated = True
+        # Distinct kinds: hang (heartbeat/section timeout) vs health (device/node
+        # check failure) — consumers triage the two very differently.
+        record_event(
+            "watchdog",
+            "hang_detected" if cause == "hang" else "health_terminated",
+            global_rank=s.info.global_rank,
+            pid=s.info.pid, reason=reason,
+        )
         self.restarter.handling_start(f"reason={reason!r}")
         self.log.error(f"terminating rank {s.info.global_rank} (pid {s.info.pid}): {reason}")
         self.restarter.handling_processing()
