@@ -1,0 +1,43 @@
+"""BASELINE configs 1-3 replay harnesses run in CI (scripts/bench_configs.py):
+section-timing parity at 64 ranks, heartbeat-replay hang detection at 256 ranks,
+and 5%-slow-node detection at 1024 ranks — each must detect perfectly (F1=1.0)
+and, for config 2, within the analytical latency budget."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_baseline_configs_1_2_3(tmp_path):
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_configs.py"),
+            "--out-dir", str(tmp_path),
+            "--iters", "3",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+    assert r.returncode == 0, r.stderr
+
+    results = {}
+    for n in (1, 2, 3):
+        with open(tmp_path / f"BENCH_config{n}.json") as f:
+            results[n] = json.loads(f.read())
+
+    assert results[1]["f1"] == 1.0 and results[1]["flagged"] == [17]
+    assert results[1]["parity_semantics_ok"] is True
+
+    assert results[2]["f1"] == 1.0
+    # Detected within the analytical budget: hb_timeout + hb_interval + tick.
+    assert results[2]["detection_latency_s"] <= results[2]["latency_budget_s"]
+    # The 256-rank per-tick scan is microseconds, not milliseconds.
+    assert results[2]["scan_us_per_tick"] < 10_000
+
+    assert results[3]["f1"] == 1.0 and results[3]["ranks"] == 1024

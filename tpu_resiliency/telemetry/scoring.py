@@ -233,6 +233,33 @@ def score_round_jit(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("threshold", "z_threshold", "alpha"))
+def score_summary_jit(
+    medians,
+    weights,
+    counts,
+    prev_ewma,
+    historical_min,
+    threshold: float = DEFAULT_THRESHOLD,
+    z_threshold: float = DEFAULT_Z_THRESHOLD,
+    alpha: float = DEFAULT_EWMA_ALPHA,
+):
+    """One compiled program for the summary path (window reduction already done):
+    eager dispatch here costs dozens of small device round-trips per report, which
+    dominates report latency on remote-dispatch backends."""
+    dummy = jnp.zeros(medians.shape + (1,), medians.dtype)
+    return score_round(
+        dummy,
+        counts,
+        prev_ewma,
+        historical_min,
+        threshold=threshold,
+        z_threshold=z_threshold,
+        alpha=alpha,
+        medians_and_weights=(medians, weights),
+    )
+
+
 @functools.lru_cache(maxsize=16)
 def make_sharded_scorer(
     mesh,

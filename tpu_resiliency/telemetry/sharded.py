@@ -327,12 +327,16 @@ class MeshTelemetry:
         self, scores: scoring.TelemetryScores, *, rank: int = 0,
         signal_names: Optional[Sequence[str]] = None,
     ) -> Report:
+        import jax
+
         scores = self._replicate(scores)
-        section = np.asarray(scores.section_scores)
-        indiv = np.asarray(scores.individual_section_scores)
-        perf = np.asarray(scores.perf)
-        z = np.asarray(scores.z)
-        ewma = np.asarray(scores.ewma)
+        # One batched device→host transfer (see ReportGenerator._materialize).
+        host = jax.device_get(scores)
+        section = np.asarray(host.section_scores)
+        indiv = np.asarray(host.individual_section_scores)
+        perf = np.asarray(host.perf)
+        z = np.asarray(host.z)
+        ewma = np.asarray(host.ewma)
         names = tuple(signal_names) if signal_names is not None else self.signal_names
         return Report(
             rank=rank,
