@@ -38,7 +38,7 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 
-def f1(pred: set, truth: set, universe: int) -> float:
+def f1(pred: set, truth: set) -> float:
     tp = len(pred & truth)
     fp = len(pred - truth)
     fn = len(truth - pred)
@@ -91,7 +91,7 @@ def config1(iters: int) -> dict:
         "config": 1,
         "ranks": ranks,
         "report_ms": round(report_ms, 4),
-        "f1": round(f1(flagged, slow, ranks), 4),
+        "f1": round(f1(flagged, slow), 4),
         "flagged": sorted(flagged),
         "parity_semantics_ok": bool(parity),
     }
@@ -157,14 +157,17 @@ def config2(_: int) -> dict:
     # Latency from the hang (last heartbeat the rank would have sent) to the tick
     # that flagged it. Expected: hb_timeout .. hb_timeout + hb_interval + tick.
     last_hb_sent = hang_at - hb_interval
-    latency = detected.get(hang_rank, float("inf")) - last_hb_sent
+    # None (JSON null), not inf: json.dumps would emit the non-standard Infinity.
+    latency = (
+        round(detected[hang_rank] - last_hb_sent, 3) if hang_rank in detected else None
+    )
     return {
         "config": 2,
         "ranks": ranks,
         "hang_rank": hang_rank,
-        "detection_latency_s": round(latency, 3),
+        "detection_latency_s": latency,
         "latency_budget_s": hb_timeout + hb_interval + check_interval,
-        "f1": round(f1(pred, truth, ranks), 4),
+        "f1": round(f1(pred, truth), 4),
         "scan_us_per_tick": round(float(np.mean(scan_times)) * 1e6, 2),
     }
 
@@ -206,7 +209,7 @@ def config3(iters: int) -> dict:
         "ranks": ranks,
         "slow_fraction": 0.05,
         "report_ms": round(report_ms, 4),
-        "f1": round(f1(pred, slow, ranks), 4),
+        "f1": round(f1(pred, slow), 4),
     }
 
 

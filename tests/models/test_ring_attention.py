@@ -1,0 +1,73 @@
+"""Ring attention must be THE SAME function as dense causal attention, just
+sharded: same outputs, same gradients, on a real multi-device mesh with the
+sequence axis sharded and K/V blocks rotating over ``ppermute``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_resiliency.models import transformer as tfm
+from tpu_resiliency.parallel import mesh as pmesh
+from tpu_resiliency.parallel.ring_attention import make_ring_attn_fn
+
+
+def make_mesh(dp, sp, tp):
+    devs = np.asarray(jax.devices()[: dp * sp * tp]).reshape(dp, sp, tp)
+    return Mesh(devs, ("dp", "sp", "tp"))
+
+
+@pytest.mark.parametrize("dp,sp,tp", [(2, 2, 2), (2, 4, 1), (1, 8, 1)])
+def test_kernel_matches_dense_attention(dp, sp, tp):
+    mesh = make_mesh(dp, sp, tp)
+    b, t, h, dh = 4, 32, 4, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b, t, h, dh)), jnp.float32) for _ in range(3)
+    )
+
+    dense = tfm._attention(q, k, v)
+
+    spec = NamedSharding(mesh, P("dp", "sp", "tp", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    ring = jax.jit(make_ring_attn_fn(mesh))(qs, ks, vs)
+
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-5)
+    # The output stays sequence-sharded — no hidden full replication.
+    assert not ring.sharding.is_fully_replicated
+
+
+def test_forward_and_grads_match_dense():
+    """Full transformer forward + loss grads: ring over an (dp=2, sp=2, tp=2) mesh
+    vs dense on the same inputs."""
+    mesh = make_mesh(2, 2, 2)
+    cfg = tfm.TransformerConfig.tiny(n_layers=2, dtype=jnp.float32)
+    params = tfm.init_params(jax.random.PRNGKey(1), cfg)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+
+    dense_loss, dense_grads = jax.value_and_grad(tfm.loss_fn)(params, tokens, cfg)
+
+    pshard = pmesh.tree_shardings(mesh, pmesh.param_specs(cfg))
+    params_s = jax.device_put(params, pshard)
+    tokens_s = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+    attn_fn = make_ring_attn_fn(mesh)
+
+    ring_loss, ring_grads = jax.jit(
+        jax.value_and_grad(lambda p, tk: tfm.loss_fn(p, tk, cfg, attn_fn=attn_fn))
+    )(params_s, tokens_s)
+
+    np.testing.assert_allclose(float(ring_loss), float(dense_loss), rtol=1e-5)
+    flat_d, _ = jax.tree_util.tree_flatten(dense_grads)
+    flat_r, _ = jax.tree_util.tree_flatten(ring_grads)
+    for gd, gr in zip(flat_d, flat_r):
+        np.testing.assert_allclose(
+            np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_default_split_exercises_all_axes():
+    split = pmesh.default_split(8)
+    assert split == {"dp": 2, "tp": 2, "sp": 2}
+    assert split["sp"] > 1  # the sequence axis is real, not decorative

@@ -34,7 +34,6 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
-    use_ring_attention: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -171,16 +170,26 @@ def forward(
 
 
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig, **kw) -> jax.Array:
-    """Next-token cross-entropy over tokens [B, T]."""
-    logits = forward(params, tokens[:, :-1], cfg, **kw)
+    """Next-token cross-entropy over tokens [B, T].
+
+    The forward pass runs on the FULL sequence and the last position's logits are
+    dropped afterwards (rather than slicing tokens first): a sequence-sharded
+    batch keeps its ``T % sp == 0`` divisibility through attention, and the
+    trailing slice is a local no-collective op on the logits.
+    """
+    logits = forward(params, tokens, cfg, **kw)[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return nll.mean()
 
 
-def make_train_step(cfg: TransformerConfig, optimizer=None):
-    """Returns ``(train_step, init_opt_state)`` — jit-ready pure functions."""
+def make_train_step(cfg: TransformerConfig, optimizer=None, attn_fn=None):
+    """Returns ``(train_step, init_opt_state)`` — jit-ready pure functions.
+
+    ``attn_fn`` overrides the dense attention (e.g.
+    :func:`~tpu_resiliency.parallel.ring_attention.make_ring_attn_fn` for a
+    sequence-sharded mesh)."""
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
@@ -189,7 +198,7 @@ def make_train_step(cfg: TransformerConfig, optimizer=None):
         return optimizer.init(params)
 
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn=attn_fn)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

@@ -50,12 +50,14 @@ def build_mesh(
 
 
 def default_split(n_devices: int) -> dict[str, int]:
-    """A sensible (dp, tp, sp) split for n devices: tp up to 4, rest dp."""
-    tp = 1
-    for cand in (4, 2):
-        if n_devices % cand == 0:
-            tp = cand
-            break
+    """A sensible (dp, tp, sp) split for n devices.
+
+    All three axes are real: 8 devices → (dp=2, tp=2, sp=2) — the training step
+    runs tensor-parallel matmuls, a data-parallel gradient reduction, AND ring
+    attention over the sequence axis (``parallel/ring_attention.py``)."""
+    if n_devices % 8 == 0:
+        return {"dp": n_devices // 4, "tp": 2, "sp": 2}
+    tp = 2 if n_devices % 2 == 0 else 1
     return {"dp": n_devices // tp, "tp": tp, "sp": 1}
 
 

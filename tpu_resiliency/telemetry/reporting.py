@@ -214,12 +214,7 @@ class ReportGenerator:
         return self._materialize(res, section_names, rank)
 
     def _materialize(self, res: scoring.TelemetryScores, section_names, rank: int) -> Report:
-        import jax
-
-        # ONE batched device→host transfer of the whole scores pytree: per-array
-        # np.asarray costs a full transfer round-trip each on remote-dispatch
-        # backends (measured 335 ms vs 80 ms per report over the TPU tunnel).
-        host = jax.device_get(res)
+        host = scoring.scores_to_host(res)
         section = np.asarray(host.section_scores)
         indiv = np.asarray(host.individual_section_scores)
         perf = np.asarray(host.perf)

@@ -233,6 +233,14 @@ def score_round_jit(
     )
 
 
+def scores_to_host(res: "TelemetryScores") -> "TelemetryScores":
+    """ONE batched device->host transfer of a scores pytree. Report materializers
+    must use this instead of per-array np.asarray: each per-array transfer costs a
+    full round-trip on remote-dispatch backends (measured 335 ms vs 80 ms per
+    report over the TPU tunnel)."""
+    return jax.device_get(res)
+
+
 @functools.partial(jax.jit, static_argnames=("threshold", "z_threshold", "alpha"))
 def score_summary_jit(
     medians,

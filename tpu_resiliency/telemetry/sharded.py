@@ -327,11 +327,8 @@ class MeshTelemetry:
         self, scores: scoring.TelemetryScores, *, rank: int = 0,
         signal_names: Optional[Sequence[str]] = None,
     ) -> Report:
-        import jax
-
         scores = self._replicate(scores)
-        # One batched device→host transfer (see ReportGenerator._materialize).
-        host = jax.device_get(scores)
+        host = scoring.scores_to_host(scores)
         section = np.asarray(host.section_scores)
         indiv = np.asarray(host.individual_section_scores)
         perf = np.asarray(host.perf)
