@@ -1,0 +1,103 @@
+"""KVServer scale soak: 1024 persistent clients against one coordinator.
+
+The server is deliberately thread-per-connection (requests are small and rare —
+it is a control plane, not a data plane). This soak pins down the measured
+ceiling that design carries at the advertised rank counts: 1024 live connections
+(= 1024 server threads), a full-world barrier, a world-wide heartbeat tick, and
+the batched scans the detector/monitor paths rely on. The measured numbers are
+recorded in the KVServer docstring (platform/store.py).
+"""
+
+import time
+
+import pytest
+
+from tpu_resiliency.platform.store import CoordStore
+
+N = 1024
+
+
+@pytest.fixture
+def clients(kv_server):
+    out = []
+    yield out
+    for c in out:
+        try:
+            c.close()
+        except Exception:
+            pass
+
+
+def test_1024_client_soak(kv_server, clients):
+    t0 = time.perf_counter()
+    for _ in range(N):
+        clients.append(CoordStore("127.0.0.1", kv_server.port, timeout=120.0))
+    connect_s = time.perf_counter() - t0
+
+    # One small write + server-stamped heartbeat per rank (the per-tick pattern
+    # of monitor processes).
+    t0 = time.perf_counter()
+    for i, c in enumerate(clients):
+        c.set(f"soak/k/{i}", i)
+        c.touch(f"soak/hb/{i}")
+    write_s = time.perf_counter() - t0
+
+    # Full-world barrier: every rank registers arrival (non-blocking joins — the
+    # proxy-join path), then the last join releases the generation.
+    t0 = time.perf_counter()
+    for i, c in enumerate(clients):
+        c.barrier_join("soak/barrier", i, N, timeout=0.0, wait=False)
+    status = clients[0].barrier_status("soak/barrier")
+    barrier_s = time.perf_counter() - t0
+    assert status is not None and status["generation"] == 1
+
+    # The batched reads the hot paths use: one prefix_get over the world's
+    # summaries, one server-side stale scan over the world's heartbeats.
+    t0 = time.perf_counter()
+    everything = clients[0].prefix_get("soak/k/")
+    scan = clients[0].stale_keys("soak/hb/", max_age=3600.0)
+    read_s = time.perf_counter() - t0
+    assert len(everything) == N
+    assert scan == {}  # nothing stale
+
+    total = connect_s + write_s + barrier_s + read_s
+    print(
+        f"\nsoak@{N}: connect {connect_s:.2f}s, {2 * N} ops {write_s:.2f}s "
+        f"({2 * N / write_s:.0f} ops/s), barrier {barrier_s:.2f}s, "
+        f"batched reads {read_s * 1e3:.1f}ms, total {total:.2f}s"
+    )
+    # Generous ceilings: the point is catching collapse (thread exhaustion,
+    # quadratic scans), not micro-benchmarks on shared CI hardware.
+    assert connect_s < 60.0
+    assert write_s < 60.0
+    assert barrier_s < 60.0
+    assert read_s < 10.0
+
+
+def test_concurrent_blocking_waiters(kv_server, clients):
+    """128 clients blocking server-side in a waiting barrier join (each pinning a
+    server thread in a condition wait) must all release when the last rank joins."""
+    import threading
+
+    world = 128
+    for _ in range(world):
+        clients.append(CoordStore("127.0.0.1", kv_server.port, timeout=60.0))
+    released = []
+    lock = threading.Lock()
+
+    def join(i):
+        clients[i].barrier_join("soak/wait", i, world, timeout=30.0)
+        with lock:
+            released.append(i)
+
+    threads = [threading.Thread(target=join, args=(i,)) for i in range(world - 1)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # everyone parked in the server-side wait
+    clients[world - 1].barrier_join("soak/wait", world - 1, world, timeout=30.0)
+    for t in threads:
+        t.join(timeout=30.0)
+    elapsed = time.perf_counter() - t0
+    assert len(released) == world - 1
+    assert elapsed < 30.0

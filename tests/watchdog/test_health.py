@@ -1,0 +1,95 @@
+"""TPU/host runtime health sources: runtime inventory + HBM pressure, host memory
+pressure, and interconnect link-error monitoring with topology discovery — all with
+injectable paths/thresholds so every branch is testable without hardware (the
+reference's ``link_down_path_template`` pattern, ``health_check.py:325``)."""
+
+import jax
+
+from tpu_resiliency.watchdog import HostMemoryCheck, IciLinkCheck, TpuRuntimeCheck
+
+
+class TestTpuRuntimeCheck:
+    def test_healthy_on_live_runtime(self):
+        check = TpuRuntimeCheck()
+        assert check() is True
+        assert check.last_failure is None
+
+    def test_device_count_drop_detected(self):
+        have = len(jax.local_devices())
+        check = TpuRuntimeCheck(expect_devices=have + 1)
+        assert check() is False
+        assert "device count dropped" in check.last_failure
+        assert "device count dropped" in check.describe()
+
+    def test_hbm_threshold_not_tripped_on_cpu(self):
+        # CPU devices report no usable memory stats: the criterion is skipped,
+        # never false-positived.
+        check = TpuRuntimeCheck(hbm_usage_threshold=0.0)
+        assert check() is True
+
+
+class TestHostMemoryCheck:
+    def write_meminfo(self, tmp_path, total_kb, avail_kb):
+        p = tmp_path / "meminfo"
+        p.write_text(
+            f"MemTotal:       {total_kb} kB\n"
+            f"MemFree:        {avail_kb} kB\n"
+            f"MemAvailable:   {avail_kb} kB\n"
+            "Buffers:        0 kB\n"
+        )
+        return str(p)
+
+    def test_healthy_above_floor(self, tmp_path):
+        path = self.write_meminfo(tmp_path, 16_000_000, 8_000_000)
+        assert HostMemoryCheck(0.05, meminfo_path=path)() is True
+
+    def test_pressure_below_floor(self, tmp_path):
+        path = self.write_meminfo(tmp_path, 16_000_000, 200_000)  # 1.25%
+        assert HostMemoryCheck(0.05, meminfo_path=path)() is False
+
+    def test_unreadable_meminfo_is_not_fatal(self, tmp_path):
+        assert HostMemoryCheck(meminfo_path=str(tmp_path / "missing"))() is True
+        bad = tmp_path / "bad"
+        bad.write_text("garbage\n")
+        assert HostMemoryCheck(meminfo_path=str(bad))() is True
+
+
+class TestIciLinkCheck:
+    def make_topology(self, tmp_path, n=4):
+        for i in range(n):
+            d = tmp_path / f"accel{i}"
+            d.mkdir()
+            (d / "link_downed").write_text("0\n")
+        return IciLinkCheck(
+            device_glob=str(tmp_path / "accel*"),
+            link_down_path_template=str(tmp_path / "{device}" / "link_downed"),
+        )
+
+    def test_discovery_maps_devices_to_counters(self, tmp_path):
+        check = self.make_topology(tmp_path)
+        topo = check.discover()
+        assert sorted(topo) == [f"accel{i}" for i in range(4)]
+        assert all(path.endswith("link_downed") for path in topo.values())
+
+    def test_counter_increase_flags_the_right_link(self, tmp_path):
+        check = self.make_topology(tmp_path)
+        assert check() is True  # baseline
+        assert check() is True  # steady
+        (tmp_path / "accel2" / "link_downed").write_text("3\n")
+        assert check() is False
+        assert check.failed_links == ["accel2"]
+        assert "accel2" in check.describe()
+        # Sticky until reset (the reference marks the node unhealthy, not flapping).
+        (tmp_path / "accel2" / "link_downed").write_text("3\n")
+        assert check() is False
+        check.reset()
+        assert check() is True  # new baseline accepted
+
+    def test_missing_counter_files_are_skipped(self, tmp_path):
+        (tmp_path / "accel9").mkdir()  # device without a counter file
+        check = IciLinkCheck(
+            device_glob=str(tmp_path / "accel*"),
+            link_down_path_template=str(tmp_path / "{device}" / "link_downed"),
+        )
+        assert check.discover() == {}
+        assert check() is True

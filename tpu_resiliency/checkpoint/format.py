@@ -6,6 +6,19 @@ to: hollow metadata (small pickle) followed by each leaf's raw bytes, streamed
 sequentially — large contiguous writes are how you saturate local NVMe, and the hollow /
 payload split means the metadata can be read without touching the payload.
 
+**Measured justification for single-stream (the reference fans out per-bucket
+writers, ``filesystem_async.py:232-334,558``):** on this class of host storage,
+writing a 1 GiB tree (fsync'd, warm, alternating runs —
+``scripts/bench_ckpt_io.py``) measured single-stream at 0.30 GB/s median vs 0.16
+GB/s for a 4-way thread fan-out: concurrent streams halve throughput by
+interleaving what would be contiguous writes. Writes here are also already
+asynchronous to the train loop (``async_core``), so writer parallelism buys no
+step-time; it would only shorten the background window. Revisit only for storage
+where one stream cannot saturate the device (e.g. striped NVMe arrays or object
+stores) — measure with the same script first, then split at the leaf level
+(each leaf's offset is in the header, so a reader-compatible multi-writer needs
+only pwrite-at-offset into the same container).
+
 Atomicity follows the reference's ``.dirty``-then-rename protocol
 (``checkpointing/local/ckpt_managers/local_manager.py:110-131``): write to
 ``<path>.dirty``, fsync, ``os.replace``. A crash leaves only ``.dirty`` files, which

@@ -34,11 +34,17 @@ class StragglerDetectionCallback(Callback):
         health_policy=None,
         use_device_mesh: bool = False,
         mesh_signal_capacity: int = 16,
+        profile_programs_every: Optional[int] = None,
     ):
         """``health_policy``: an optional
         :class:`~tpu_resiliency.telemetry.policy.HealthVectorPolicy` fed every
         report — its sinks close the loop to restart demotion / node exclusion /
         replication avoidance (BASELINE target 5).
+
+        ``profile_programs_every``: every Nth step, bracket the step in an XLA
+        profiler window and feed per-compiled-program device times into the scored
+        matrix as ``prog/...`` signals (the CUPTI capture-every-Nth-entry analogue,
+        reference ``profiling_interval``). Tracing is not free — use O(100).
 
         ``use_device_mesh``: route report rounds through the mesh-sharded scoring
         path (:class:`~tpu_resiliency.telemetry.sharded.MeshTelemetry`) instead of
@@ -54,6 +60,9 @@ class StragglerDetectionCallback(Callback):
         self.health_policy = health_policy
         self.use_device_mesh = use_device_mesh
         self.mesh_signal_capacity = mesh_signal_capacity
+        self.profile_programs_every = profile_programs_every
+        self._program_profiler = None
+        self._step_count = 0
         self._init_kwargs = dict(
             scores_to_compute=(
                 (["relative_perf_scores"] if calc_relative_scores else [])
@@ -108,6 +117,13 @@ class StragglerDetectionCallback(Callback):
         )
 
     def on_step_start(self, ctx: LoopContext) -> None:
+        if self.profile_programs_every:
+            if self._program_profiler is None:
+                from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
+
+                self._program_profiler = DeviceTimeProfiler()
+            if self._step_count % self.profile_programs_every == 0:
+                self._program_profiler.start()
         self._section = Detector.detection_section(self.section_name)
         self._section.__enter__()
 
@@ -115,14 +131,28 @@ class StragglerDetectionCallback(Callback):
         if self._section is not None:
             self._section.__exit__(None, None, None)
             self._section = None
+        self._step_count += 1
+        if self._program_profiler is not None and self._program_profiler.active:
+            self._program_profiler.stop()
+            Detector.record_program_samples(self._program_profiler.drain())
         report = Detector.generate_report_if_interval_elapsed()
         if report is not None:
             self._handle_report(ctx, report)
+
+    def _close_profiler_window(self) -> None:
+        if self._program_profiler is not None and self._program_profiler.active:
+            self._program_profiler.stop()
+
+    def on_exception(self, ctx: LoopContext, exc: BaseException) -> None:
+        # A step that dies mid-window must not leak the process-global JAX trace:
+        # the restarted loop's fresh profiler would find it active and crash.
+        self._close_profiler_window()
 
     def on_train_end(self, ctx: LoopContext) -> None:
         if self._section is not None:
             self._section.__exit__(None, None, None)
             self._section = None
+        self._close_profiler_window()
         Detector.shutdown()
 
     # -- report handling ---------------------------------------------------

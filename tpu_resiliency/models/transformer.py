@@ -156,7 +156,17 @@ def forward(
     attn_fn=None,
     position_offset: int = 0,
 ) -> jax.Array:
-    """tokens [B, T] int32 → logits [B, T, V] (float32)."""
+    """tokens [B, T] int32 → logits [B, T, V] (float32).
+
+    ``position_offset`` is applied to RoPE and to the DEFAULT dense attention's
+    causal mask only; a custom ``attn_fn`` (e.g. ring attention) owns its own
+    position bookkeeping, so combining the two is rejected rather than silently
+    producing a mask anchored at 0."""
+    if attn_fn is not None and position_offset:
+        raise ValueError(
+            "position_offset is only applied to the default dense attention; "
+            "a custom attn_fn must handle positions itself"
+        )
     attn_fn = attn_fn or functools.partial(_attention, causal_offset=position_offset)
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_tables(cfg, tokens.shape[1], position_offset)

@@ -39,6 +39,7 @@ log = get_logger(__name__)
 
 SECTION_PREFIX = "sec/"
 DEVICE_PREFIX = "dev/"
+PROGRAM_PREFIX = "prog/"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,6 +222,18 @@ class Detector:
             setattr(cid.obj, cid.name, make_wrapper(orig, section_name))
             cls._wrapped.append((cid.obj, cid.name, orig))
 
+    @classmethod
+    def record_program_samples(cls, samples: dict[str, list[float]]) -> None:
+        """Feed per-compiled-program device times (``DeviceTimeProfiler.drain()``)
+        into the scored matrix as ``prog/...`` signals — the CUPTI-kernel-summaries
+        analogue (reference ``straggler.py:198-226`` kernel summaries)."""
+        if not cls.initialized:
+            raise ResiliencyError("Detector.initialize() must be called first")
+        for name, secs in samples.items():
+            ring = cls._ring(PROGRAM_PREFIX + name)
+            for sec in secs:
+                ring.push(sec)
+
     # -- summaries ---------------------------------------------------------
 
     @classmethod
@@ -279,9 +292,16 @@ class Detector:
         names = cls._sync_columns()
         cap = mt.n_signals
         if len(names) > cap:
-            raise ResiliencyError(
-                f"{len(names)} signals exceed MeshTelemetry capacity {cap}"
+            # A report round must never take training down. The agreed column list
+            # is identical on every rank (store CAS), so every rank makes this same
+            # decision for this and all future rounds: drop to the store path.
+            log.warning(
+                f"{len(names)} signals exceed MeshTelemetry capacity {cap}; "
+                "falling back to the store summary path permanently (raise the "
+                "mesh signal capacity, or record fewer dynamic signals)"
             )
+            cls._mesh_telemetry = None
+            return None  # caller retries via the store path
         med = np.full((1, cap), np.inf, dtype=np.float32)
         wgt = np.zeros((1, cap), dtype=np.float32)
         cnt = np.zeros((1, cap), dtype=np.int32)
@@ -323,7 +343,10 @@ class Detector:
             and cls.world_size > 1
             and jax.process_count() == cls.world_size
         ):
-            return cls._generate_mesh_report(local)
+            report = cls._generate_mesh_report(local)
+            if cls._mesh_telemetry is not None:
+                return report
+            # Capacity fallback tripped mid-round: continue into the store path.
         if cls.store is not None and cls.world_size > 1:
             round_idx = cls._generator.iteration
             ns = f"telemetry/round/{round_idx}"

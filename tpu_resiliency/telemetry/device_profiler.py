@@ -1,0 +1,182 @@
+"""Per-compiled-program device timing from the XLA profiler: the CUPTI equivalent.
+
+The reference's straggler detector feeds on CUPTI per-kernel wall times captured by a
+C++ activity-buffer extension (``straggler/cupti_src/CuptiProfiler.cpp:96-203``) with a
+``start/stop/get_stats/reset`` contract. Per-kernel timing does not exist under XLA —
+kernels are fused into whole compiled programs — so the TPU-native signal is the
+**per-XLA-module device time**: the profiler's device plane records one event per
+program execution (``XLA Modules`` line) with the true on-device duration
+(``device_duration_ps``), no host dispatch included. This is the deliberate semantic
+change SURVEY §7 calls out ("matching CUPTI fidelity"): program-level granularity,
+device-exact durations.
+
+:class:`DeviceTimeProfiler` preserves the reference contract:
+
+- ``start()`` / ``stop()`` bracket a capture window (run a window every Nth report
+  interval, like CUPTI's ``profiling_interval`` — tracing is not free);
+- ``drain()`` yields the new per-program duration samples since the last drain
+  (feed them to ``Detector.record_program_samples`` so programs join the scored
+  telemetry matrix as ``prog/...`` signals);
+- ``get_stats()`` returns per-program min/max/med/avg/std/count like the C++
+  ``computeStats`` (``CuptiProfiler.cpp:44-74``); ``reset()`` clears.
+
+Program names are stable across recompiles: the fingerprint hash suffix is stripped
+(``jit_train_step(123...)`` → ``jit_train_step``). On backends without a device plane
+(CPU), the capture falls back to the host trace's ``PjitFunction`` events —
+host-inclusive dispatch durations, clearly a different signal, but it keeps the whole
+pipeline exercisable in simulation.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import shutil
+import tempfile
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+_HASH_SUFFIX = re.compile(r"\(\d+\)$")
+_PJIT = re.compile(r"^PjitFunction\((.+)\)$")
+
+MAX_SAMPLES_PER_PROGRAM = 8192  # reference statsMaxLenPerKernel ring bound
+
+
+def normalize_program_name(name: str) -> str:
+    return _HASH_SUFFIX.sub("", name)
+
+
+def extract_program_times(profile_data) -> dict[str, list[float]]:
+    """Per-program device durations (seconds) from one xplane ProfileData.
+
+    Primary source: device planes' ``XLA Modules`` line (true device time).
+    Fallback when no device plane exists (CPU simulation): the host plane's
+    ``PjitFunction`` events (host-inclusive dispatch time).
+    """
+    out: dict[str, list[float]] = {}
+    saw_device_plane = False
+    for plane in profile_data.planes:
+        if "/device:" not in plane.name or "CUSTOM" in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Modules":
+                continue
+            saw_device_plane = True
+            for ev in line.events:
+                name = normalize_program_name(ev.name)
+                out.setdefault(name, []).append(float(ev.duration_ns) * 1e-9)
+    if saw_device_plane:
+        return out
+    for plane in profile_data.planes:
+        if not plane.name.startswith("/host:"):
+            continue
+        for line in plane.lines:
+            if line.name != "python":
+                continue
+            for ev in line.events:
+                m = _PJIT.match(ev.name)
+                if m:
+                    name = f"pjit_{m.group(1)}"
+                    out.setdefault(name, []).append(float(ev.duration_ns) * 1e-9)
+    return out
+
+
+class DeviceTimeProfiler:
+    """Windowed per-program device-time capture with the CUPTI manager contract."""
+
+    def __init__(self, trace_root: Optional[str] = None):
+        self._root = trace_root
+        self._window_dir: Optional[str] = None
+        self._samples: dict[str, deque] = {}
+        self._fresh: dict[str, list[float]] = {}
+        self.active = False
+
+    # -- capture window ------------------------------------------------------
+
+    def start(self) -> None:
+        if self.active:
+            return
+        import jax
+
+        self._window_dir = tempfile.mkdtemp(prefix="devprof_", dir=self._root)
+        try:
+            jax.profiler.start_trace(self._window_dir)
+        except Exception:
+            # The process-global profiler may already be active (another window's
+            # leak, or user tracing). Profiling is opportunistic observability —
+            # skip the window, never break the step.
+            log.warning("could not start a profiler window; skipping", exc_info=True)
+            shutil.rmtree(self._window_dir, ignore_errors=True)
+            self._window_dir = None
+            return
+        self.active = True
+
+    def stop(self) -> None:
+        """End the window and fold its per-program samples into the stats."""
+        if not self.active:
+            return
+        import jax
+        from jax.profiler import ProfileData
+
+        jax.profiler.stop_trace()
+        self.active = False
+        try:
+            files = glob.glob(
+                os.path.join(self._window_dir, "**", "*.xplane.pb"), recursive=True
+            )
+            for f in files:
+                times = extract_program_times(ProfileData.from_file(f))
+                for name, secs in times.items():
+                    ring = self._samples.setdefault(
+                        name, deque(maxlen=MAX_SAMPLES_PER_PROGRAM)
+                    )
+                    ring.extend(secs)
+                    self._fresh.setdefault(name, []).extend(secs)
+        except Exception:
+            log.exception("device profile parse failed; window dropped")
+        finally:
+            if self._window_dir:
+                shutil.rmtree(self._window_dir, ignore_errors=True)
+                self._window_dir = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- consumption ---------------------------------------------------------
+
+    def drain(self) -> dict[str, list[float]]:
+        """New samples since the last drain (seconds per execution)."""
+        fresh, self._fresh = self._fresh, {}
+        return fresh
+
+    def get_stats(self) -> dict[str, dict[str, float]]:
+        """Per-program stats over retained samples (reference ``computeStats``)."""
+        out = {}
+        for name, ring in self._samples.items():
+            if not ring:
+                continue
+            arr = np.asarray(ring, dtype=np.float64)
+            out[name] = {
+                "min": float(arr.min()),
+                "max": float(arr.max()),
+                "med": float(np.median(arr)),
+                "avg": float(arr.mean()),
+                "std": float(arr.std()),
+                "count": int(arr.size),
+            }
+        return out
+
+    def reset(self) -> None:
+        self._samples.clear()
+        self._fresh.clear()

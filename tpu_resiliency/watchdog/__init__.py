@@ -10,8 +10,11 @@ from tpu_resiliency.watchdog.health import (
     CallbackHealthCheck,
     DeviceLivenessCheck,
     HealthCheck,
+    HostMemoryCheck,
+    IciLinkCheck,
     PeriodicHealthMonitor,
     SysfsCounterCheck,
+    TpuRuntimeCheck,
 )
 from tpu_resiliency.watchdog.monitor_client import RankMonitorClient
 from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
@@ -30,6 +33,9 @@ __all__ = [
     "WorkloadAction",
     "WorkloadControlRequest",
     "CallbackHealthCheck",
+    "HostMemoryCheck",
+    "IciLinkCheck",
+    "TpuRuntimeCheck",
     "DeviceLivenessCheck",
     "HealthCheck",
     "PeriodicHealthMonitor",
