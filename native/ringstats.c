@@ -1,0 +1,329 @@
+/* Pooled fixed-capacity ring buffers with C-side summary statistics.
+ *
+ * The native analogue of the reference's CUPTI stats machinery: BufferPool's
+ * one-allocation buffer management (BufferPool.h:24-38), CircularBuffer<float>'s
+ * bounded rings with linearize() (CircularBuffer.h:22-70), and computeStats'
+ * sort-based min/max/median/avg/std over retained samples
+ * (CuptiProfiler.cpp:44-74). One RingPool holds every signal's window in a single
+ * contiguous block: pushes are two array writes, stats sort at most `capacity`
+ * doubles in preallocated scratch — no per-sample Python objects, no allocation
+ * after construction.
+ *
+ * Exposed as tpu_resiliency._ringstats (plain CPython C API; this repo binds
+ * native code without pybind11). Python-level fallback:
+ * telemetry/ring_buffer.py.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <math.h>
+#include <stdlib.h>
+#include <string.h>
+
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t n_rings;
+    Py_ssize_t capacity;
+    double *data;      /* [n_rings * capacity] */
+    Py_ssize_t *next;  /* [n_rings] write cursor */
+    Py_ssize_t *count; /* [n_rings] valid samples (<= capacity) */
+    double *scratch;   /* [capacity] sort buffer */
+} RingPool;
+
+static void
+RingPool_dealloc(RingPool *self)
+{
+    PyMem_Free(self->data);
+    PyMem_Free(self->next);
+    PyMem_Free(self->count);
+    PyMem_Free(self->scratch);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static int
+RingPool_init(RingPool *self, PyObject *args, PyObject *kwds)
+{
+    static char *kwlist[] = {"n_rings", "capacity", NULL};
+    Py_ssize_t n_rings, capacity;
+    if (!PyArg_ParseTupleAndKeywords(args, kwds, "nn", kwlist, &n_rings, &capacity))
+        return -1;
+    if (n_rings <= 0 || capacity <= 0) {
+        PyErr_SetString(PyExc_ValueError, "n_rings and capacity must be positive");
+        return -1;
+    }
+    if (n_rings > PY_SSIZE_T_MAX / capacity) {
+        PyErr_SetString(PyExc_OverflowError, "n_rings * capacity overflows");
+        return -1;
+    }
+    self->n_rings = n_rings;
+    self->capacity = capacity;
+    self->data = PyMem_Calloc((size_t)(n_rings * capacity), sizeof(double));
+    self->next = PyMem_Calloc((size_t)n_rings, sizeof(Py_ssize_t));
+    self->count = PyMem_Calloc((size_t)n_rings, sizeof(Py_ssize_t));
+    self->scratch = PyMem_Calloc((size_t)capacity, sizeof(double));
+    if (!self->data || !self->next || !self->count || !self->scratch) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    return 0;
+}
+
+static int
+check_ring(RingPool *self, Py_ssize_t ring)
+{
+    if (ring < 0 || ring >= self->n_rings) {
+        PyErr_Format(PyExc_IndexError, "ring %zd out of range [0, %zd)", ring,
+                     self->n_rings);
+        return -1;
+    }
+    return 0;
+}
+
+static PyObject *
+RingPool_push(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    double value;
+    if (!PyArg_ParseTuple(args, "nd", &ring, &value))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    double *buf = self->data + ring * self->capacity;
+    buf[self->next[ring]] = value;
+    self->next[ring] = (self->next[ring] + 1) % self->capacity;
+    if (self->count[ring] < self->capacity)
+        self->count[ring]++;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+RingPool_push_many(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    PyObject *seq;
+    if (!PyArg_ParseTuple(args, "nO", &ring, &seq))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    /* Fast path: any C-contiguous float64 buffer (numpy array, memoryview) is
+       ingested without boxing a PyFloat per sample. */
+    Py_buffer view;
+    if (PyObject_GetBuffer(seq, &view, PyBUF_C_CONTIGUOUS | PyBUF_FORMAT) == 0) {
+        if (view.itemsize == sizeof(double) &&
+            (view.format == NULL || strcmp(view.format, "d") == 0)) {
+            const double *src = (const double *)view.buf;
+            Py_ssize_t n = view.len / (Py_ssize_t)sizeof(double);
+            double *buf = self->data + ring * self->capacity;
+            for (Py_ssize_t i = 0; i < n; i++) {
+                buf[self->next[ring]] = src[i];
+                self->next[ring] = (self->next[ring] + 1) % self->capacity;
+                if (self->count[ring] < self->capacity)
+                    self->count[ring]++;
+            }
+            PyBuffer_Release(&view);
+            Py_RETURN_NONE;
+        }
+        PyBuffer_Release(&view);
+    } else {
+        PyErr_Clear();
+    }
+    PyObject *fast = PySequence_Fast(seq, "push_many expects a sequence");
+    if (!fast)
+        return NULL;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    double *buf = self->data + ring * self->capacity;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        double v = PyFloat_AsDouble(PySequence_Fast_GET_ITEM(fast, i));
+        if (v == -1.0 && PyErr_Occurred()) {
+            Py_DECREF(fast);
+            return NULL;
+        }
+        buf[self->next[ring]] = v;
+        self->next[ring] = (self->next[ring] + 1) % self->capacity;
+        if (self->count[ring] < self->capacity)
+            self->count[ring]++;
+    }
+    Py_DECREF(fast);
+    Py_RETURN_NONE;
+}
+
+static void
+linearize_into(RingPool *self, Py_ssize_t ring, double *out)
+{
+    double *buf = self->data + ring * self->capacity;
+    Py_ssize_t n = self->count[ring];
+    if (n < self->capacity) {
+        memcpy(out, buf, (size_t)n * sizeof(double));
+    } else {
+        Py_ssize_t head = self->next[ring];
+        memcpy(out, buf + head, (size_t)(self->capacity - head) * sizeof(double));
+        memcpy(out + (self->capacity - head), buf, (size_t)head * sizeof(double));
+    }
+}
+
+static PyObject *
+RingPool_linearize(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    if (!PyArg_ParseTuple(args, "n", &ring))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    Py_ssize_t n = self->count[ring];
+    PyObject *bytes = PyBytes_FromStringAndSize(NULL, n * (Py_ssize_t)sizeof(double));
+    if (!bytes)
+        return NULL;
+    linearize_into(self, ring, (double *)PyBytes_AS_STRING(bytes));
+    return bytes; /* oldest -> newest, float64; wrap with np.frombuffer */
+}
+
+static int
+cmp_double(const void *a, const void *b)
+{
+    double da = *(const double *)a, db = *(const double *)b;
+    return (da > db) - (da < db);
+}
+
+static PyObject *
+RingPool_stats(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    if (!PyArg_ParseTuple(args, "n", &ring))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    Py_ssize_t n = self->count[ring];
+    if (n == 0) {
+        PyErr_SetString(PyExc_ValueError, "stats of an empty ring");
+        return NULL;
+    }
+    double *s = self->scratch;
+    linearize_into(self, ring, s);
+    double mn = s[0], mx = s[0], sum = 0.0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        double v = s[i];
+        if (v < mn) mn = v;
+        if (v > mx) mx = v;
+        sum += v;
+    }
+    double avg = sum / (double)n;
+    /* Two-pass variance: the naive sumsq/n - avg^2 form catastrophically cancels
+       for large-mean/small-spread samples (numpy uses the same two-pass shape,
+       keeping native and fallback stats interchangeable). */
+    double ssd = 0.0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        double d = s[i] - avg;
+        ssd += d * d;
+    }
+    double std = sqrt(ssd / (double)n);
+    qsort(s, (size_t)n, sizeof(double), cmp_double);
+    double med = (n % 2) ? s[n / 2] : 0.5 * (s[n / 2 - 1] + s[n / 2]);
+    /* (count, min, max, med, avg, std, total) — computeStats parity + total,
+       which the scoring pipeline uses as the signal weight. */
+    return Py_BuildValue("(ndddddd)", n, mn, mx, med, avg, std, sum);
+}
+
+static PyObject *
+RingPool_count(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    if (!PyArg_ParseTuple(args, "n", &ring))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    return PyLong_FromSsize_t(self->count[ring]);
+}
+
+static PyObject *
+RingPool_reset(RingPool *self, PyObject *args)
+{
+    Py_ssize_t ring;
+    if (!PyArg_ParseTuple(args, "n", &ring))
+        return NULL;
+    if (check_ring(self, ring) < 0)
+        return NULL;
+    self->next[ring] = 0;
+    self->count[ring] = 0;
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+RingPool_reset_all(RingPool *self, PyObject *Py_UNUSED(ignored))
+{
+    memset(self->next, 0, (size_t)self->n_rings * sizeof(Py_ssize_t));
+    memset(self->count, 0, (size_t)self->n_rings * sizeof(Py_ssize_t));
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef RingPool_methods[] = {
+    {"push", (PyCFunction)RingPool_push, METH_VARARGS, "push(ring, value)"},
+    {"push_many", (PyCFunction)RingPool_push_many, METH_VARARGS,
+     "push_many(ring, seq_of_floats)"},
+    {"linearize", (PyCFunction)RingPool_linearize, METH_VARARGS,
+     "linearize(ring) -> bytes of float64, oldest->newest"},
+    {"stats", (PyCFunction)RingPool_stats, METH_VARARGS,
+     "stats(ring) -> (count, min, max, med, avg, std, total)"},
+    {"count", (PyCFunction)RingPool_count, METH_VARARGS, "count(ring) -> int"},
+    {"reset", (PyCFunction)RingPool_reset, METH_VARARGS, "reset(ring)"},
+    {"reset_all", (PyCFunction)RingPool_reset_all, METH_NOARGS, "reset_all()"},
+    {NULL, NULL, 0, NULL},
+};
+
+static PyMemberDef RingPool_members[] = {
+    {NULL},
+};
+
+static PyObject *
+RingPool_get_n_rings(RingPool *self, void *closure)
+{
+    return PyLong_FromSsize_t(self->n_rings);
+}
+
+static PyObject *
+RingPool_get_capacity(RingPool *self, void *closure)
+{
+    return PyLong_FromSsize_t(self->capacity);
+}
+
+static PyGetSetDef RingPool_getset[] = {
+    {"n_rings", (getter)RingPool_get_n_rings, NULL, "ring count", NULL},
+    {"capacity", (getter)RingPool_get_capacity, NULL, "per-ring capacity", NULL},
+    {NULL},
+};
+
+static PyTypeObject RingPoolType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "tpu_resiliency._ringstats.RingPool",
+    .tp_basicsize = sizeof(RingPool),
+    .tp_dealloc = (destructor)RingPool_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "Pooled fixed-capacity ring buffers with C-side stats",
+    .tp_methods = RingPool_methods,
+    .tp_members = RingPool_members,
+    .tp_getset = RingPool_getset,
+    .tp_init = (initproc)RingPool_init,
+    .tp_new = PyType_GenericNew,
+};
+
+static PyModuleDef ringstats_module = {
+    PyModuleDef_HEAD_INIT,
+    .m_name = "tpu_resiliency._ringstats",
+    .m_doc = "Native ring-buffer stats collector (CUPTI CircularBuffer analogue)",
+    .m_size = -1,
+};
+
+PyMODINIT_FUNC
+PyInit__ringstats(void)
+{
+    if (PyType_Ready(&RingPoolType) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&ringstats_module);
+    if (!m)
+        return NULL;
+    Py_INCREF(&RingPoolType);
+    if (PyModule_AddObject(m, "RingPool", (PyObject *)&RingPoolType) < 0) {
+        Py_DECREF(&RingPoolType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

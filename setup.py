@@ -16,5 +16,10 @@ setup(
             sources=["native/probe.c"],
             extra_compile_args=["-O2", "-std=c11"],
         ),
+        Extension(
+            "tpu_resiliency._ringstats",
+            sources=["native/ringstats.c"],
+            extra_compile_args=["-O2", "-std=c11"],
+        ),
     ]
 )

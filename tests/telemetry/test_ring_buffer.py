@@ -76,3 +76,60 @@ def test_name_registry_store_sync(coord_store):
     assert r1.index_map() == {"y": 0, "x": 1}
     # convergence: next round both publish their full sets and agree on membership
     assert set(r0.index_map()) == set(r1.index_map())
+
+
+class TestNativeParity:
+    """The native collector (native/ringstats.c) and the Python fallback must be
+    interchangeable: same linearize, same stats, same wrap semantics."""
+
+    def _pair(self, capacity):
+        import pytest
+
+        from tpu_resiliency.telemetry import ring_buffer as rb
+
+        if rb._ringstats is None:
+            pytest.skip("_ringstats extension not built")
+        return (
+            rb.HostRingBuffer(capacity, native=True),
+            rb.HostRingBuffer(capacity, native=False),
+        )
+
+    def test_stats_and_linearize_parity(self):
+        import numpy as np
+
+        nat, py = self._pair(16)
+        assert nat.native and not py.native
+        rng = np.random.default_rng(0)
+        samples = rng.uniform(0.001, 0.1, 37)  # > 2x capacity: wraps twice
+        for v in samples:
+            nat.push(float(v))
+            py.push(float(v))
+        assert len(nat) == len(py) == 16
+        np.testing.assert_allclose(nat.linearize(), py.linearize())
+        sn, sp = nat.stats(), py.stats()
+        assert set(sn) == set(sp)
+        for k in sn:
+            np.testing.assert_allclose(sn[k], sp[k], rtol=1e-12, err_msg=k)
+
+    def test_extend_reset_parity(self):
+        import numpy as np
+        import pytest
+
+        nat, py = self._pair(8)
+        nat.extend([1.0, 2.0, 3.0])
+        py.extend([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(nat.linearize(), py.linearize())
+        assert nat.stats()["median"] == py.stats()["median"] == 2.0
+        nat.reset()
+        py.reset()
+        assert len(nat) == len(py) == 0
+        for ring in (nat, py):
+            with pytest.raises(ValueError):
+                ring.stats()
+
+    def test_even_count_median(self):
+        nat, py = self._pair(8)
+        for v in (1.0, 2.0, 3.0, 10.0):
+            nat.push(v)
+            py.push(v)
+        assert nat.stats()["median"] == py.stats()["median"] == 2.5

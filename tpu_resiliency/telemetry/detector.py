@@ -32,7 +32,7 @@ from tpu_resiliency.exceptions import ResiliencyError
 from tpu_resiliency.telemetry.interval_tracker import ReportIntervalTracker
 from tpu_resiliency.telemetry.name_registry import NameRegistry
 from tpu_resiliency.telemetry.reporting import Report, ReportGenerator
-from tpu_resiliency.telemetry.ring_buffer import HostRingBuffer
+from tpu_resiliency.telemetry.ring_buffer import RingView, SignalRings
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -83,6 +83,7 @@ class Detector:
     max_signals: int = 64
 
     _registry: Optional[NameRegistry] = None
+    _signal_rings: Optional[SignalRings] = None
     _rings: dict = {}
     _entry_counts: dict = {}
     _interval_tracker: Optional[ReportIntervalTracker] = None
@@ -132,6 +133,10 @@ class Detector:
         cls._use_pallas = use_pallas
         cls._node_name = node_name
         cls._registry = NameRegistry(max_signals)
+        # One pooled collector for every signal (single contiguous native block
+        # when built); ring index == the registry's column id, so names and
+        # storage stay aligned.
+        cls._signal_rings = SignalRings(max_signals, window)
         cls._rings = {}
         cls._entry_counts = {}
         cls._wrapped = []
@@ -148,6 +153,7 @@ class Detector:
             setattr(obj, name, orig)
         cls._wrapped = []
         cls._rings = {}
+        cls._signal_rings = None
         cls._entry_counts = {}
         cls._registry = None
         cls._generator = None
@@ -159,11 +165,11 @@ class Detector:
     # -- recording ---------------------------------------------------------
 
     @classmethod
-    def _ring(cls, signal: str) -> HostRingBuffer:
+    def _ring(cls, signal: str) -> RingView:
         ring = cls._rings.get(signal)
         if ring is None:
-            cls._registry.get(signal)  # reserve the column
-            ring = cls._rings[signal] = HostRingBuffer(cls.window)
+            col = cls._registry.get(signal)  # reserve the column
+            ring = cls._rings[signal] = cls._signal_rings.view(col)
         return ring
 
     @classmethod
@@ -238,15 +244,16 @@ class Detector:
 
     @classmethod
     def local_summary(cls) -> dict[str, dict[str, float | int]]:
-        """Per-signal {median, total, count} from the host rings."""
+        """Per-signal {median, total, count} from the host rings (one C-side pass
+        per ring when the native collector is built)."""
         out = {}
         for name, ring in cls._rings.items():
-            samples = ring.linearize()
-            if samples.size:
+            if len(ring):
+                st = ring.stats()
                 out[name] = {
-                    "median": float(np.median(samples)),
-                    "total": float(samples.sum()),
-                    "count": int(samples.size),
+                    "median": st["median"],
+                    "total": st["total"],
+                    "count": int(st["count"]),
                 }
         return out
 

@@ -11,43 +11,159 @@ letting telemetry accumulate with zero host-side Python until a report boundary
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
+try:  # native pooled rings + C-side stats (build: python setup.py build_ext --inplace)
+    from tpu_resiliency import _ringstats
+except ImportError:  # pure-Python fallback below
+    _ringstats = None
 
-class HostRingBuffer:
-    """Bounded ring of float samples with O(1) append and linearized readout."""
+STAT_KEYS = ("count", "min", "max", "median", "avg", "std", "total")
 
-    def __init__(self, capacity: int):
-        if capacity <= 0:
-            raise ValueError("capacity must be positive")
+
+class SignalRings:
+    """``n_rings`` fixed-capacity rings in one block, with per-ring stats.
+
+    The host collector behind the straggler detector: one native ``RingPool``
+    (``native/ringstats.c`` — the reference's ``CircularBuffer``/``BufferPool``/
+    ``computeStats`` analogue: single contiguous allocation, C-side sort/stats)
+    when the extension is built, one numpy block otherwise. Consumers hold
+    :class:`RingView` handles (``.view(i)``) so per-signal call sites stay simple
+    while storage stays pooled.
+    """
+
+    def __init__(self, n_rings: int, capacity: int, native: Optional[bool] = None):
+        if n_rings <= 0 or capacity <= 0:
+            raise ValueError("n_rings and capacity must be positive")
+        self.n_rings = n_rings
         self.capacity = capacity
-        self._buf = np.zeros(capacity, dtype=np.float64)
-        self._next = 0
-        self._count = 0
+        use_native = (_ringstats is not None) if native is None else native
+        if use_native and _ringstats is None:
+            raise RuntimeError("native rings requested but _ringstats is not built")
+        self._pool = _ringstats.RingPool(n_rings, capacity) if use_native else None
+        if self._pool is None:
+            self._buf = np.zeros((n_rings, capacity), dtype=np.float64)
+            self._next = np.zeros(n_rings, dtype=np.int64)
+            self._count = np.zeros(n_rings, dtype=np.int64)
+
+    @property
+    def native(self) -> bool:
+        return self._pool is not None
+
+    def view(self, index: int) -> "RingView":
+        if not 0 <= index < self.n_rings:
+            raise IndexError(f"ring {index} out of range [0, {self.n_rings})")
+        return RingView(self, index)
+
+    # -- per-ring operations ------------------------------------------------
+
+    def push(self, i: int, value: float) -> None:
+        if self._pool is not None:
+            self._pool.push(i, float(value))
+            return
+        self._buf[i, self._next[i]] = value
+        self._next[i] = (self._next[i] + 1) % self.capacity
+        self._count[i] = min(self._count[i] + 1, self.capacity)
+
+    def extend(self, i: int, values) -> None:
+        values = np.ascontiguousarray(values, dtype=np.float64).ravel()
+        if self._pool is not None:
+            # Buffer-protocol fast path in C: no per-sample boxing.
+            self._pool.push_many(i, values)
+            return
+        for v in values:
+            self.push(i, float(v))
+
+    def count(self, i: int) -> int:
+        if self._pool is not None:
+            return self._pool.count(i)
+        return int(self._count[i])
+
+    def linearize(self, i: int) -> np.ndarray:
+        """Samples oldest→newest (reference ``CircularBuffer.linearize()``)."""
+        if self._pool is not None:
+            return np.frombuffer(self._pool.linearize(i), dtype=np.float64).copy()
+        n, head = int(self._count[i]), int(self._next[i])
+        if n < self.capacity:
+            return self._buf[i, :n].copy()
+        return np.concatenate([self._buf[i, head:], self._buf[i, :head]])
+
+    def stats(self, i: int) -> dict[str, float]:
+        """One-pass summary: count/min/max/median/avg/std/total (reference
+        ``computeStats``, ``CuptiProfiler.cpp:44-74``). Raises on an empty ring."""
+        if self._pool is not None:
+            return dict(zip(STAT_KEYS, self._pool.stats(i)))
+        if self._count[i] == 0:
+            raise ValueError("stats of an empty ring")
+        arr = self.linearize(i)
+        return {
+            "count": int(arr.size),
+            "min": float(arr.min()),
+            "max": float(arr.max()),
+            "median": float(np.median(arr)),
+            "avg": float(arr.mean()),
+            "std": float(arr.std()),
+            "total": float(arr.sum()),
+        }
+
+    def reset(self, i: int) -> None:
+        if self._pool is not None:
+            self._pool.reset(i)
+            return
+        self._next[i] = 0
+        self._count[i] = 0
+
+    def reset_all(self) -> None:
+        if self._pool is not None:
+            self._pool.reset_all()
+            return
+        self._next[:] = 0
+        self._count[:] = 0
+
+
+class RingView:
+    """One signal's handle into a :class:`SignalRings` pool."""
+
+    __slots__ = ("_rings", "_i")
+
+    def __init__(self, rings: SignalRings, index: int):
+        self._rings = rings
+        self._i = index
+
+    @property
+    def capacity(self) -> int:
+        return self._rings.capacity
+
+    @property
+    def native(self) -> bool:
+        return self._rings.native
 
     def push(self, value: float) -> None:
-        self._buf[self._next] = value
-        self._next = (self._next + 1) % self.capacity
-        self._count = min(self._count + 1, self.capacity)
+        self._rings.push(self._i, value)
 
     def extend(self, values) -> None:
-        for v in np.asarray(values, dtype=np.float64).ravel():
-            self.push(float(v))
+        self._rings.extend(self._i, values)
 
     def __len__(self) -> int:
-        return self._count
+        return self._rings.count(self._i)
 
     def linearize(self) -> np.ndarray:
-        """Samples oldest→newest (reference ``CircularBuffer.linearize()``)."""
-        if self._count < self.capacity:
-            return self._buf[: self._count].copy()
-        return np.concatenate([self._buf[self._next :], self._buf[: self._next]])
+        return self._rings.linearize(self._i)
+
+    def stats(self) -> dict[str, float]:
+        return self._rings.stats(self._i)
 
     def reset(self) -> None:
-        self._next = 0
-        self._count = 0
+        self._rings.reset(self._i)
+
+
+class HostRingBuffer(RingView):
+    """A standalone single ring (a pool of one) — the simple-case API."""
+
+    def __init__(self, capacity: int, native: Optional[bool] = None):
+        super().__init__(SignalRings(1, capacity, native=native), 0)
 
 
 @dataclasses.dataclass
