@@ -1,0 +1,140 @@
+"""Multi-host training path: the FULL sharded train step (tensor-parallel params,
+data-parallel gradient reduction, ring attention over the sequence axis) on a
+global mesh spanning 2 real JAX processes — collectives cross a genuine process
+boundary, not just virtual devices in one runtime.
+
+This is the configuration the framework is designed around (SURVEY §7: "scale via
+jax.sharding + collectives over a Mesh"); single-process virtual-device tests
+cannot catch bugs in process-local shard bookkeeping (e.g. addressable-shard
+assembly, per-process data feeding)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+CHILD = textwrap.dedent(
+    """
+    import json, sys
+
+    import os
+    proc_id = int(sys.argv[1]); coord_port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"127.0.0.1:{coord_port}", num_processes=2, process_id=proc_id)
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from tpu_resiliency.models import transformer as tfm
+    from tpu_resiliency.parallel import mesh as pmesh
+    from tpu_resiliency.parallel.ring_attention import make_ring_attn_fn
+
+    # Global mesh over 8 devices across 2 processes: dp spans the process
+    # boundary (gradient all-reduce crosses hosts), sp and tp stay intra-process.
+    devs = np.array(jax.devices()).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "sp", "tp"))
+    assert {d.process_index for d in devs[0].flatten()} == {0}
+    assert {d.process_index for d in devs[1].flatten()} == {1}
+
+    cfg = tfm.TransformerConfig.tiny(n_layers=2, dtype=jnp.float32)
+    attn_fn = make_ring_attn_fn(mesh)
+    train_step, init_opt = tfm.make_train_step(cfg, attn_fn=attn_fn)
+
+    params = jax.device_put(
+        tfm.init_params(jax.random.PRNGKey(0), cfg),
+        pmesh.tree_shardings(mesh, pmesh.param_specs(cfg)),
+    )
+    opt_state = jax.jit(init_opt)(params)
+
+    # Each process feeds ONLY its own dp shard of the global batch
+    # (make_array_from_process_local_data): global [4, 32], local [2, 32].
+    rng = np.random.default_rng(7)
+    global_tokens = rng.integers(0, cfg.vocab_size, (4, 32)).astype(np.int32)
+    tok_sharding = NamedSharding(mesh, P("dp", "sp"))
+    local_rows = global_tokens[proc_id * 2:(proc_id + 1) * 2]
+    tokens = jax.make_array_from_process_local_data(tok_sharding, local_rows)
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    losses = []
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+
+    print("MH-RESULT " + json.dumps({"proc": proc_id, "losses": losses}), flush=True)
+    """
+)
+
+
+def test_train_step_spans_two_processes(tmp_path):
+    script = tmp_path / "child.py"
+    script.write_text(CHILD)
+    coord_port = free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(p), str(coord_port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=env,
+            cwd=str(tmp_path),
+        )
+        for p in range(2)
+    ]
+    results = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"child failed:\n{out}\n{err}"
+            line = [ln for ln in out.splitlines() if ln.startswith("MH-RESULT ")][0]
+            r = json.loads(line[len("MH-RESULT "):])
+            results[r["proc"]] = r["losses"]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # Both processes computed the identical global loss sequence (the gradient
+    # all-reduce over dp crossed the process boundary), and training decreased it.
+    assert results[0] == results[1]
+    assert results[0][-1] < results[0][0]
+
+    # Cross-check against a single-process dense run on the same data: the
+    # distributed sharded step is THE SAME computation.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_resiliency.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig.tiny(n_layers=2, dtype=jnp.float32)
+    train_step, init_opt = tfm.make_train_step(cfg)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    opt_state = init_opt(params)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    ref_losses = []
+    step = jax.jit(train_step)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        ref_losses.append(float(loss))
+    np.testing.assert_allclose(results[0], ref_losses, rtol=1e-4)

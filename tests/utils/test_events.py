@@ -91,3 +91,39 @@ def test_read_events_tolerates_torn_line(tmp_path):
     path = tmp_path / "torn.jsonl"
     path.write_text(json.dumps({"kind": "a"}) + "\n" + '{"kind": "b", "tru')
     assert [r["kind"] for r in events.read_events(str(path))] == ["a"]
+
+
+def test_debug_time_nesting_and_event(tmp_path, caplog):
+    import logging
+
+    from tpu_resiliency.utils import events
+    from tpu_resiliency.utils.timers import debug_time
+
+    path = str(tmp_path / "t.jsonl")
+    events.add_sink(events.JsonlSink(path))
+
+    with caplog.at_level(logging.DEBUG, logger="tpu_resiliency"):
+        with debug_time("outer", source="checkpoint"):
+            with debug_time("inner", source="checkpoint"):
+                pass
+
+    lines = [r.message for r in caplog.records if "ms" in r.message]
+    assert any(m.startswith("  inner:") for m in lines)  # nested → indented
+    assert any(m.startswith("outer:") for m in lines)
+    # Only the root scope reaches the event stream.
+    recs = events.read_events(path)
+    assert [r["name"] for r in recs if r["kind"] == "timing"] == ["outer"]
+
+
+def test_debug_time_as_decorator():
+    from tpu_resiliency.utils.timers import debug_time
+
+    @debug_time("work")
+    def f(x):
+        return x + 1
+
+    @debug_time
+    def g(x):
+        return x * 2
+
+    assert f(1) == 2 and g(3) == 6

@@ -32,6 +32,7 @@ from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
 from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.utils.events import record as record_event
+from tpu_resiliency.utils.timers import debug_time
 from tpu_resiliency.utils.logging import get_logger
 
 import pickle
@@ -129,20 +130,23 @@ class LocalCheckpointManager:
         (host TCP). Asynchronous: file writes. Finalization (all ranks): coverage
         verification + pruning of older iterations (``base_manager.py:236-318``).
         """
-        if not state_dict.is_hollow:
-            state_dict.pop_tensors()
-        state_dict.copy_tensors_to_host()
-        hollow_bytes = pickle.dumps(
-            state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
-        )
-        blob = ckpt_format.serialize_to_bytes(
-            hollow_bytes, state_dict.tensors(), meta={"iteration": iteration, **(meta or {})}
-        )
-        held = (
-            self.replication.replicate(blob)
-            if self.replication is not None and self.replication.enabled
-            else {self.rank: blob}
-        )
+        with debug_time("ckpt.save.d2h", source="checkpoint"):
+            if not state_dict.is_hollow:
+                state_dict.pop_tensors()
+            state_dict.copy_tensors_to_host()
+        with debug_time("ckpt.save.serialize", source="checkpoint"):
+            hollow_bytes = pickle.dumps(
+                state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            blob = ckpt_format.serialize_to_bytes(
+                hollow_bytes, state_dict.tensors(), meta={"iteration": iteration, **(meta or {})}
+            )
+        with debug_time("ckpt.save.replicate", source="checkpoint"):
+            held = (
+                self.replication.replicate(blob)
+                if self.replication is not None and self.replication.enabled
+                else {self.rank: blob}
+            )
         writes = [
             (self._path(CkptID(iteration, owner, self.session)), b)
             for owner, b in held.items()
