@@ -319,3 +319,38 @@ class TestLocalCheckpointManager:
 
         results = run_ranks(world, load_phase, timeout=120.0)
         assert results == [(3, 0.0), (3, 1.0)]
+
+
+class TestForkCallerGuard:
+    def test_refuses_fork_over_live_backend(self):
+        """The suite's conftest initializes JAX, so a fork here duplicates runtime
+        threads into the child — schedule must refuse (the documented hazard)."""
+        import jax
+        import pytest
+
+        from tpu_resiliency.checkpoint.async_core import AsyncRequest, ForkAsyncCaller
+        from tpu_resiliency.exceptions import CheckpointError
+
+        jax.devices()  # ensure the backend client exists
+        caller = ForkAsyncCaller()
+        with pytest.raises(CheckpointError, match="initialized JAX backend"):
+            caller.schedule(AsyncRequest(async_fn=lambda: None))
+
+    def test_explicit_override_forks(self, tmp_path):
+        import warnings
+
+        from tpu_resiliency.checkpoint.async_core import AsyncRequest, ForkAsyncCaller
+
+        marker = tmp_path / "wrote"
+        caller = ForkAsyncCaller(unsafe_allow_fork_with_backend=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", DeprecationWarning)  # multithreaded fork
+            caller.schedule(AsyncRequest(async_fn=_touch_file, async_fn_args=(str(marker),)))
+        assert caller.wait(timeout=30.0)
+        caller.raise_if_failed()
+        assert marker.exists()
+
+
+def _touch_file(path):
+    with open(path, "w") as f:
+        f.write("ok")

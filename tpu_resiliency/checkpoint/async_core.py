@@ -209,21 +209,50 @@ class ProcessAsyncCaller(AsyncCaller):
             pass
 
 
+def _jax_backend_alive() -> bool:
+    """True when this process holds an initialized JAX backend client (without
+    triggering initialization by asking)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
 class ForkAsyncCaller(AsyncCaller):
     """Fork-per-save (reference ``TemporalAsyncCaller``). Zero-copy via COW.
 
     Only safe when the parent holds **no live TPU runtime** (e.g. a CPU-host data
-    orchestrator) — forking a process with an initialized TPU client is undefined
-    behavior. Provided for parity; the thread caller is the default.
+    orchestrator) — forking a process with an initialized accelerator client is
+    undefined behavior (runtime threads and device handles are duplicated into a
+    child that never reaps them). ``schedule`` therefore REFUSES to fork once a
+    JAX backend is initialized in this process, unless constructed with
+    ``unsafe_allow_fork_with_backend=True`` (you own the consequences; CPU-only
+    backends mostly tolerate it). Provided for parity; the thread caller is the
+    default.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, unsafe_allow_fork_with_backend: bool = False) -> None:
         self._proc: Optional[multiprocessing.Process] = None
         self._failed = False
+        self._allow_backend = unsafe_allow_fork_with_backend
 
     def schedule(self, req: AsyncRequest) -> None:
         if self._proc is not None and self._proc.is_alive():
             raise CheckpointError("previous async save still running")
+        if not self._allow_backend and _jax_backend_alive():
+            raise CheckpointError(
+                "refusing to fork a checkpoint writer: this process holds an "
+                "initialized JAX backend (forking duplicates runtime threads and "
+                "device handles — undefined behavior). Use caller='thread' or "
+                "'process' (spawn), or opt in with "
+                "ForkAsyncCaller(unsafe_allow_fork_with_backend=True)."
+            )
         ctx = multiprocessing.get_context("fork")
         self._proc = ctx.Process(
             target=req.async_fn,
