@@ -83,40 +83,56 @@ def f1(pred_mask, truth):
     return 2 * prec * rec / max(prec + rec, 1e-9)
 
 
+def _program_ms(profiler, substring):
+    """Median per-execution time (ms) of the profiled program whose name contains
+    ``substring``; None when the window captured no such program."""
+    for name, st in profiler.get_stats().items():
+        if substring in name:
+            return st["med"] * 1e3
+    return None
+
+
 def device_scoring(data, counts, use_pallas):
+    """Measure one scoring round's TRUE device time via the framework's own
+    XLA-profiler capture (``telemetry/device_profiler.py``).
+
+    Wall-clock loops are not trustworthy here: on remote-dispatch runtimes (the
+    TPU tunnel) ``block_until_ready`` does not reliably flush a dispatch chain,
+    which made earlier rounds report fantasy sub-0.1 ms scores — the device
+    profiler reads the executed program's ``device_duration_ps`` instead."""
     import jax
     import jax.numpy as jnp
 
     from tpu_resiliency.telemetry import scoring
+    from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
 
     if use_pallas:
         from tpu_resiliency.ops.scoring_pallas import fused_median_weights
 
-        def run(d, c, e, h):
+        def score_program(d, c, e, h):
             mw = fused_median_weights(d, c)
             return scoring.score_round(d, c, e, h, medians_and_weights=mw)
 
-        fn = jax.jit(run)
     else:
-        def run(d, c, e, h):
+        def score_program(d, c, e, h):
             return scoring.score_round(d, c, e, h)
 
-        fn = jax.jit(run)
-
+    fn = jax.jit(score_program)
     d = jnp.asarray(data)
     c = jnp.asarray(counts)
     ewma = jnp.ones((R,))
     hist = jnp.full((R, S), jnp.inf)
     out = fn(d, c, ewma, hist)
     jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        # chain each step on the previous round's EWMA so steps are data-dependent
-        # (no overlap artifacts in the timing)
-        out = fn(d, c, out.ewma, hist)
-    jax.block_until_ready(out)
-    per_step = (time.perf_counter() - t0) / ITERS
-    return per_step, out
+    prof = DeviceTimeProfiler()
+    with prof:
+        for _ in range(ITERS):
+            out = fn(d, c, out.ewma, hist)
+        jax.block_until_ready(out)
+    per_step_ms = _program_ms(prof, "score_program")
+    if per_step_ms is None:
+        raise RuntimeError("profiler captured no score_program executions")
+    return per_step_ms / 1e3, out
 
 
 def device_ring_scoring(data, counts, report_interval=100):
@@ -141,6 +157,8 @@ def device_ring_scoring(data, counts, report_interval=100):
         mesh, "rank", n_ranks=R,
         signal_names=tuple(f"sig{s}" for s in range(S)), window=W,
     )
+    from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
+
     state = mt.init_state()
     # Pre-split step rows: indexing a device array with a fresh static index inside
     # the timed loop would compile a new slice program per index.
@@ -151,22 +169,24 @@ def device_ring_scoring(data, counts, report_interval=100):
     state, out = mt.score(state)
     jax.block_until_ready((state, out))
 
-    # -- push-only: what EVERY train step pays ------------------------------
-    push_iters = ITERS * 10
-    t0 = time.perf_counter()
-    for i in range(push_iters):
-        state = mt.push(state, rows[i % W])
-    jax.block_until_ready(state)
-    per_push = (time.perf_counter() - t0) / push_iters
-
-    # -- score: what a report round pays ------------------------------------
-    t0 = time.perf_counter()
-    for i in range(ITERS):
-        state = mt.push(state, rows[i % W])  # keep counts non-zero between scores
-        state, out = mt.score(state)
-    jax.block_until_ready((state, out))
-    per_score = (time.perf_counter() - t0) / ITERS - per_push
-
+    # Device-true per-program times (see device_scoring on why wall clocks lie).
+    prof = DeviceTimeProfiler()
+    with prof:
+        for i in range(ITERS * 4):
+            state = mt.push(state, rows[i % W])
+        jax.block_until_ready(state)
+        for i in range(5):
+            state = mt.push(state, rows[i % W])  # keep counts non-zero between scores
+            state, out = mt.score(state)
+        jax.block_until_ready((state, out))
+    per_push_ms = _program_ms(prof, "_push_impl")
+    per_score_ms = _program_ms(prof, "_score_reset_impl")
+    if per_push_ms is None or per_score_ms is None:
+        raise RuntimeError(
+            f"profiler missed ring programs: {sorted(prof.get_stats())}"
+        )
+    per_push = per_push_ms / 1e3
+    per_score = per_score_ms / 1e3
     per_step = per_push + per_score / report_interval
 
     # Rebuild a full window so the F1 check sees real scores, not a 1-sample round.

@@ -221,7 +221,13 @@ def _jax_backend_alive() -> bool:
 
         return bool(xla_bridge._backends)
     except Exception:
-        return False
+        # Fail CLOSED: jax is imported but the (private) probe broke — assume a
+        # backend may be live rather than silently disabling the guard.
+        log.warning(
+            "could not probe JAX backend state; treating it as initialized",
+            exc_info=True,
+        )
+        return True
 
 
 class ForkAsyncCaller(AsyncCaller):
@@ -250,7 +256,7 @@ class ForkAsyncCaller(AsyncCaller):
                 "refusing to fork a checkpoint writer: this process holds an "
                 "initialized JAX backend (forking duplicates runtime threads and "
                 "device handles — undefined behavior). Use caller='thread' or "
-                "'process' (spawn), or opt in with "
+                "'process' (spawn), or opt in with caller='fork_unsafe' / "
                 "ForkAsyncCaller(unsafe_allow_fork_with_backend=True)."
             )
         ctx = multiprocessing.get_context("fork")
@@ -288,6 +294,9 @@ _CALLERS = {
     "thread": ThreadAsyncCaller,
     "process": ProcessAsyncCaller,
     "fork": ForkAsyncCaller,
+    # The escape hatch, reachable through the string-registry surface too:
+    # AsyncCallsQueue(caller="fork_unsafe") forks even over a live JAX backend.
+    "fork_unsafe": lambda: ForkAsyncCaller(unsafe_allow_fork_with_backend=True),
 }
 
 

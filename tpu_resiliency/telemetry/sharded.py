@@ -100,6 +100,7 @@ class MeshTelemetry:
         z_threshold: float = scoring.DEFAULT_Z_THRESHOLD,
         ewma_alpha: float = scoring.DEFAULT_EWMA_ALPHA,
         rank_to_host: Optional[dict[int, str]] = None,
+        use_pallas: Optional[bool] = None,
     ):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -122,6 +123,18 @@ class MeshTelemetry:
         self.rank_to_host = rank_to_host
         self.iteration = 0
 
+        if use_pallas is None:
+            # The fused Pallas window reduction beats XLA's sort lowering 2x on
+            # TPU (device-true measurement, BASELINE.md); other backends can't
+            # run the kernel, and the kernel tiles the rank axis so incompatible
+            # per-shard rank counts fall back to the shape-generic XLA path.
+            from tpu_resiliency.ops.scoring_pallas import pallas_supported
+
+            use_pallas = (
+                jax.default_backend() == "tpu"
+                and pallas_supported(self.n_ranks // axis_size)
+            )
+        self.use_pallas = use_pallas
         self._row_sharding = NamedSharding(mesh, P(axis))
         self._scorer = scoring.make_sharded_scorer(
             mesh,
@@ -129,6 +142,7 @@ class MeshTelemetry:
             threshold=threshold,
             z_threshold=z_threshold,
             alpha=ewma_alpha,
+            use_pallas=use_pallas,
         )
         self._push = jax.jit(self._push_impl, donate_argnums=(0,))
         self._score_reset = jax.jit(self._score_reset_impl, donate_argnums=(0,))
