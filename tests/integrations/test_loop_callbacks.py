@@ -173,6 +173,37 @@ def test_straggler_callback_reports(monkeypatch):
     assert not Detector.initialized  # shut down on train end
 
 
+def test_straggler_callback_profiles_programs():
+    """profile_programs_every wires the XLA-profiler capture into the loop: jitted
+    programs executed inside profiled steps join the scored matrix as prog/
+    signals (host-PjitFunction fallback on the CPU backend)."""
+    import jax
+    import jax.numpy as jnp
+
+    if Detector.initialized:
+        Detector.shutdown()
+    cb = StragglerDetectionCallback(
+        report_time_interval=0.0, profile_programs_every=2
+    )
+
+    @jax.jit
+    def work(x):
+        return jnp.tanh(x * 2.0).sum()
+
+    def step(state, i):
+        jax.block_until_ready(work(jnp.full((32,), float(i))))
+        return state + 1
+
+    ctx = run_training(step, 0, 24, callbacks=[cb])
+    assert ctx.state == 24
+    assert cb.last_report is not None
+    assert any(n.startswith("prog/") for n in cb.last_report.section_names), (
+        cb.last_report.section_names
+    )
+    # The window closed with training (no leaked process-global trace).
+    assert cb._program_profiler is not None and not cb._program_profiler.active
+
+
 def test_hierarchical_checkpoint_callback(tmp_path):
     from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
 
