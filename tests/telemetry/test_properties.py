@@ -1,0 +1,96 @@
+"""Property-based tests (hypothesis) for the scoring pipeline's invariants and the
+native/python ring parity — randomized inputs catch the edge shapes (empty
+windows, single samples, ties, wraps) that example-based tests miss."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from tpu_resiliency.telemetry import ring_buffer as rb
+from tpu_resiliency.telemetry import scoring
+
+settings.register_profile("ci", max_examples=40, deadline=None)
+settings.load_profile("ci")
+
+
+@st.composite
+def telemetry_case(draw):
+    r = draw(st.integers(2, 12))
+    s = draw(st.integers(1, 5))
+    w = draw(st.integers(1, 10))
+    data = draw(
+        st.lists(
+            st.floats(np.float32(1e-4), np.float32(1e3), allow_nan=False, allow_subnormal=False, width=32),
+            min_size=r * s * w,
+            max_size=r * s * w,
+        )
+    )
+    counts = draw(st.lists(st.integers(0, 10), min_size=r * s, max_size=r * s))
+    counts = np.minimum(np.asarray(counts, np.int32).reshape(r, s), w)
+    return np.asarray(data, np.float32).reshape(r, s, w), counts
+
+
+@given(telemetry_case())
+def test_masked_median_matches_numpy(case):
+    import jax.numpy as jnp
+
+    data, counts = case
+    got = np.asarray(scoring.masked_median(jnp.asarray(data), jnp.asarray(counts)))
+    r, s, _ = data.shape
+    for i in range(r):
+        for j in range(s):
+            n = counts[i, j]
+            if n == 0:
+                assert got[i, j] == np.inf
+            else:
+                np.testing.assert_allclose(
+                    got[i, j], np.median(data[i, j, :n]), rtol=1e-5
+                )
+
+
+@given(telemetry_case())
+def test_score_round_invariants(case):
+    import jax.numpy as jnp
+
+    data, counts = case
+    r, s, _ = data.shape
+    res = scoring.score_round_jit(
+        jnp.asarray(data),
+        jnp.asarray(counts),
+        jnp.ones((r,)),
+        jnp.full((r, s), jnp.inf),
+    )
+    section = np.asarray(res.section_scores)
+    perf = np.asarray(res.perf)
+    valid = counts > 0
+    # Relative scores are min-of-medians / own-median: bounded (0, 1] where valid.
+    assert np.all(section[valid] <= 1.0 + 1e-5)
+    assert np.all(section[valid] > 0.0)
+    # Every signal someone measured has at least one rank at the reference (1.0).
+    for j in range(s):
+        if valid[:, j].any():
+            assert section[valid[:, j], j].max() > 1.0 - 1e-4
+    # Perf scores are weighted means of section scores: same bounds.
+    has_any = valid.any(axis=1)
+    assert np.all(perf[has_any] <= 1.0 + 1e-5)
+    assert np.all(perf[has_any] > 0.0)
+    assert np.all(np.isfinite(perf))
+
+
+@given(
+    st.integers(1, 24),
+    st.lists(st.floats(np.float32(-1e6), np.float32(1e6), allow_nan=False, allow_subnormal=False, width=32), min_size=0, max_size=80),
+)
+def test_ring_backends_agree(capacity, samples):
+    if rb._ringstats is None:
+        return  # extension not built in this environment
+    nat = rb.HostRingBuffer(capacity, native=True)
+    py = rb.HostRingBuffer(capacity, native=False)
+    for v in samples:
+        nat.push(float(v))
+        py.push(float(v))
+    assert len(nat) == len(py)
+    np.testing.assert_allclose(nat.linearize(), py.linearize())
+    if len(py):
+        sn, sp = nat.stats(), py.stats()
+        for k in sp:
+            np.testing.assert_allclose(sn[k], sp[k], rtol=1e-10, atol=1e-9, err_msg=k)
