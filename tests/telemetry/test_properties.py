@@ -82,7 +82,9 @@ def test_score_round_invariants(case):
 )
 def test_ring_backends_agree(capacity, samples):
     if rb._ringstats is None:
-        return  # extension not built in this environment
+        import pytest
+
+        pytest.skip("_ringstats extension not built")
     nat = rb.HostRingBuffer(capacity, native=True)
     py = rb.HostRingBuffer(capacity, native=False)
     for v in samples:

@@ -93,3 +93,45 @@ class TestIciLinkCheck:
         )
         assert check.discover() == {}
         assert check() is True
+
+
+class TestChecksFromConfig:
+    def test_disabled_by_default(self):
+        from tpu_resiliency.watchdog.config import FaultToleranceConfig
+        from tpu_resiliency.watchdog.health import checks_from_config
+
+        assert checks_from_config(FaultToleranceConfig()) == []
+
+    def test_config_enables_builtin_sources(self, tmp_path):
+        from tpu_resiliency.watchdog.config import FaultToleranceConfig
+        from tpu_resiliency.watchdog.health import checks_from_config
+
+        cfg = FaultToleranceConfig(
+            enable_health_checks=True,
+            host_memory_min_fraction=0.05,
+            ici_link_device_glob=str(tmp_path / "accel*"),
+            ici_link_down_path_template=str(tmp_path / "{device}" / "link_downed"),
+        )
+        checks = checks_from_config(cfg)
+        kinds = [type(c).__name__ for c in checks]
+        assert kinds == ["HostMemoryCheck", "IciLinkCheck"]
+
+    def test_monitor_server_builds_from_config(self, tmp_path):
+        from tpu_resiliency.watchdog.config import FaultToleranceConfig
+        from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
+
+        cfg = FaultToleranceConfig(host_memory_min_fraction=0.01)
+        srv = RankMonitorServer(cfg, socket_path=str(tmp_path / "m.sock"))
+        assert [type(c).__name__ for c in srv.health_checks] == ["HostMemoryCheck"]
+        # An explicit empty list disables the config-driven construction.
+        srv2 = RankMonitorServer(cfg, socket_path=str(tmp_path / "m2.sock"), health_checks=[])
+        assert srv2.health_checks == []
+
+    def test_ft_param_cli_roundtrip(self):
+        import argparse
+
+        from tpu_resiliency.watchdog.config import FaultToleranceConfig
+
+        ns = argparse.Namespace(ft_param_host_memory_min_fraction="0.07")
+        cfg = FaultToleranceConfig.from_args(ns)
+        assert cfg.host_memory_min_fraction == 0.07

@@ -35,6 +35,13 @@ class FaultToleranceConfig:
     # pluggable host/device health checks run by the monitor
     enable_health_checks: bool = False
     health_check_interval: float = 5.0
+    # built-in health sources (watchdog/health.py), config-enabled like the
+    # reference's GPU/NIC checks; None disables each. TpuRuntimeCheck is NOT
+    # listed: it must run in the process that owns the TPU (wire it into the
+    # in-process restart health chain instead).
+    host_memory_min_fraction: Optional[float] = None  # e.g. 0.05
+    ici_link_device_glob: Optional[str] = None  # e.g. /sys/class/accel/accel*
+    ici_link_down_path_template: Optional[str] = None  # e.g. .../{device}/link_downed
 
     SECTION_NAME = "fault_tolerance"
     PARAM_PREFIX = "ft_param_"

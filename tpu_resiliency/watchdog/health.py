@@ -277,6 +277,27 @@ class IciLinkCheck(SysfsCounterCheck):
         return "IciLinkCheck"
 
 
+def checks_from_config(cfg) -> list[HealthCheck]:
+    """Build the config-enabled built-in checks (the reference enables its GPU/NIC
+    checks the same way, ``shared_utils/health_check.py`` via FT config)."""
+    checks: list[HealthCheck] = []
+    if getattr(cfg, "host_memory_min_fraction", None):
+        checks.append(HostMemoryCheck(cfg.host_memory_min_fraction))
+    glob_set = bool(getattr(cfg, "ici_link_device_glob", None))
+    tmpl_set = bool(getattr(cfg, "ici_link_down_path_template", None))
+    if glob_set != tmpl_set:
+        # Half-configured monitoring must fail loudly, not silently not-watch.
+        raise ValueError(
+            "ici_link_device_glob and ici_link_down_path_template must be set "
+            "together (got only one)"
+        )
+    if glob_set:
+        checks.append(
+            IciLinkCheck(cfg.ici_link_device_glob, cfg.ici_link_down_path_template)
+        )
+    return checks
+
+
 class PeriodicHealthMonitor:
     """Polls a set of checks on an interval in a daemon thread; fires ``on_failure``
     once per failed check (reference async_check loop)."""

@@ -36,7 +36,11 @@ from tpu_resiliency.watchdog.data import (
     SectionTimeouts,
     UpdateTimeoutsMsg,
 )
-from tpu_resiliency.watchdog.health import HealthCheck, PeriodicHealthMonitor
+from tpu_resiliency.watchdog.health import (
+    HealthCheck,
+    PeriodicHealthMonitor,
+    checks_from_config,
+)
 from tpu_resiliency.watchdog.state_machine import RestarterStateMachine, RestarterState
 
 log = get_logger(__name__)
@@ -71,7 +75,11 @@ class RankMonitorServer:
             section=dict(cfg.rank_section_timeouts),
             out_of_section=cfg.rank_out_of_section_timeout,
         )
-        self.health_checks = health_checks or []
+        if health_checks is None:
+            # Config-enabled built-ins (host memory floor, ICI link counters) —
+            # explicit lists override, an explicit [] disables.
+            health_checks = checks_from_config(cfg)
+        self.health_checks = health_checks
         self._health_monitor: Optional[PeriodicHealthMonitor] = None
         self._health_failure: Optional[str] = None
         self.restarter = RestarterStateMachine("InJob", strict=False)
