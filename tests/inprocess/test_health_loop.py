@@ -19,7 +19,7 @@ def free_port() -> int:
 
 
 STEPS_PER_ROUND = 6
-REPORT_ROUNDS = 3
+MAX_REPORT_ROUNDS = 8  # stop as soon as the demotion is agreed (patience 2)
 
 
 def body(rank, world, port, q):
@@ -70,13 +70,19 @@ def body(rank, world, port, q):
             report_time_interval=3600.0,
         )
         try:
-            for _ in range(REPORT_ROUNDS):
+            for _ in range(MAX_REPORT_ROUNDS):
                 for _ in range(STEPS_PER_ROUND):
                     with Detector.detection_section("step", profile_device=False):
-                        # Rank 1 is genuinely 4x slower, measured for real.
-                        time.sleep(0.040 if rank == 1 else 0.010)
+                        # Rank 1 is genuinely 10x slower, measured for real (wide
+                        # margin: host scheduling noise under CI load must not
+                        # compress the ratio past the 0.75 threshold).
+                        time.sleep(0.080 if rank == 1 else 0.008)
                 report = Detector.generate_report()  # collective (store barrier)
                 decision = policy.observe(report)
+                # Same global report on every rank -> same decision -> all ranks
+                # break on the same round (generate_report stays collective).
+                if 1 in decision.degraded:
+                    break
             assert 1 in decision.degraded, decision
         finally:
             Detector.shutdown()
