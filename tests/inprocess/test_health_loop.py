@@ -101,7 +101,10 @@ def body(rank, world, port, q):
 def test_measured_slowness_demotes_through_the_full_loop():
     world = 3
     port = free_port()
-    ctx = mp.get_context("fork")
+    # Children call into JAX (Detector scoring); the pytest parent has a live,
+    # multithreaded JAX backend, so fork()ed children can inherit a held lock and
+    # deadlock under suite load. Spawn starts them clean.
+    ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=body, args=(r, world, port, q)) for r in range(world)]
     for p in procs:
