@@ -69,5 +69,8 @@ def test_forward_and_grads_match_dense():
 
 def test_default_split_exercises_all_axes():
     split = pmesh.default_split(8)
-    assert split == {"dp": 2, "tp": 2, "sp": 2}
+    assert split == {"dp": 2, "tp": 2, "sp": 2, "pp": 1, "ep": 1}
     assert split["sp"] > 1  # the sequence axis is real, not decorative
+    # pp/ep get their own split: the MoE pipeline config covers both.
+    moe_split = pmesh.moe_pipeline_split(8)
+    assert moe_split["pp"] > 1 and moe_split["ep"] > 1

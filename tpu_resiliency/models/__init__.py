@@ -1,3 +1,5 @@
+from tpu_resiliency.models import moe
+from tpu_resiliency.models.moe import MoEConfig
 from tpu_resiliency.models.transformer import (
     TransformerConfig,
     forward,
@@ -6,4 +8,12 @@ from tpu_resiliency.models.transformer import (
     make_train_step,
 )
 
-__all__ = ["TransformerConfig", "forward", "init_params", "loss_fn", "make_train_step"]
+__all__ = [
+    "MoEConfig",
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_train_step",
+    "moe",
+]

@@ -1,0 +1,187 @@
+"""Mixture-of-experts model family: top-k routed experts, expert-parallel over ``ep``.
+
+The second model family exercising the resiliency framework (the first is the dense
+Llama-style ``models/transformer.py``; the reference itself ships no model code —
+SURVEY.md §2.7 checklist — these exist so the framework is proven against real sharded
+workloads). Built TPU-first:
+
+- **Static shapes everywhere.** Routing uses the GShard/Switch dense-dispatch
+  formulation: top-k gates → capacity-bounded one-hot dispatch/combine tensors →
+  batched einsums over the expert dimension. No sorting networks, no dynamic
+  gather/scatter — everything lowers to MXU-sized batched matmuls.
+- **Expert parallelism is a sharding, not code.** Expert weights carry a leading
+  ``[E]`` axis sharded over the mesh's ``ep`` axis (``parallel/mesh.py``
+  ``moe_param_specs``); the dispatch einsum's contraction over tokens/experts makes
+  XLA insert the token all-to-all over ICI. The model code never names a collective.
+- **Scan-stacked layers** like the dense model: one trace of the layer body, with the
+  router aux (load-balance) loss accumulated through the scan carry.
+
+Every layer is an MoE layer (Mixtral-style); attention is reused verbatim from the
+dense model (``transformer._attn_block``), so ring attention over ``sp`` composes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_resiliency.models import transformer as tfm
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(tfm.TransformerConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    router_aux_weight: float = 1e-2
+
+    @staticmethod
+    def tiny(**kw) -> "MoEConfig":
+        base = dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_ff=128, max_seq_len=128, n_experts=4, top_k=2,
+        )
+        base.update(kw)
+        return MoEConfig(**base)
+
+    def capacity(self, seq_len: int) -> int:
+        """Per-expert token capacity for one batch row (static)."""
+        cap = int(math.ceil(self.top_k * seq_len * self.capacity_factor / self.n_experts))
+        return max(cap, 1)
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> dict:
+    """Dense-model pytree with the per-layer MLP replaced by router + [E]-stacked
+    experts. The dense MLP weights are never materialized (at scale they would
+    transiently double the parameter memory next to the expert stacks)."""
+    base = tfm.init_params(rng, cfg, with_mlp=False)
+    d, f, L, E = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.n_experts
+    kr, kg, ku, kd = jax.random.split(jax.random.fold_in(rng, 7), 4)
+
+    def dense_init(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    layers = dict(base["layers"])
+    layers["w_router"] = dense_init(kr, (L, d, E), d)
+    layers["we_gate"] = dense_init(kg, (L, E, d, f), d)
+    layers["we_up"] = dense_init(ku, (L, E, d, f), d)
+    layers["we_down"] = dense_init(kd, (L, E, f, d), f)
+    base["layers"] = layers
+    return base
+
+
+def _route(cfg: MoEConfig, y: jax.Array, w_router: jax.Array):
+    """Top-k routing with per-batch-row capacity.
+
+    y: [B, T, D] → dispatch [B, T, E, C] (0/1), combine [B, T, E, C] (gates),
+    aux (scalar load-balance loss, Switch-style fraction·probability product).
+    """
+    B, T, _ = y.shape
+    E, K, C = cfg.n_experts, cfg.top_k, cfg.capacity(T)
+
+    logits = (y.astype(jnp.float32) @ w_router.astype(jnp.float32))  # [B, T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, K)  # [B, T, K]
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+    # First-come-first-served capacity: flatten (T, K) token-major so earlier
+    # tokens (and higher-ranked choices) win slots, as in the GShard formulation.
+    mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B, T, K, E]
+    flat = mask.reshape(B, T * K, E)
+    pos = jnp.cumsum(flat, axis=1) - flat  # slot index if admitted
+    flat = flat * (pos < C)
+    pos_in_expert = (pos * flat).sum(-1).astype(jnp.int32)  # [B, T*K]
+    admitted_gates = gates.reshape(B, T * K) * flat.sum(-1)
+
+    dispatch = flat[..., None] * jax.nn.one_hot(pos_in_expert, C, dtype=jnp.float32)[:, :, None, :]
+    combine = admitted_gates[..., None, None] * dispatch  # [B, T*K, E, C]
+    dispatch = dispatch.reshape(B, T, K, E, C).sum(2)
+    combine = combine.reshape(B, T, K, E, C).sum(2)
+
+    # Load-balance aux: E * mean_e(fraction of tokens routed to e * mean router prob of e).
+    frac = mask.reshape(B, T * K, E).mean(axis=(0, 1)) * K  # fraction per expert
+    mean_prob = probs.mean(axis=(0, 1))
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _moe_block(cfg: MoEConfig, x: jax.Array, lp: dict):
+    """Routed SwiGLU experts with residual. Expert weights [E, D, F] shard over ``ep``;
+    the ``ebcd``-shaped dispatch/expert einsums are where XLA places the all-to-all."""
+    y = tfm.rms_norm(x, lp["mlp_norm"])
+    dispatch, combine, aux = _route(cfg, y, lp["w_router"])
+    d, c = dispatch.astype(y.dtype), combine.astype(y.dtype)
+
+    expert_in = jnp.einsum("btec,btd->ebcd", d, y)  # [E, B, C, D]
+    gate = jax.nn.silu(jnp.einsum("ebcd,edf->ebcf", expert_in, lp["we_gate"].astype(y.dtype)))
+    up = jnp.einsum("ebcd,edf->ebcf", expert_in, lp["we_up"].astype(y.dtype))
+    out = jnp.einsum("ebcf,efd->ebcd", gate * up, lp["we_down"].astype(y.dtype))
+    y_out = jnp.einsum("btec,ebcd->btd", c, out)
+    return x + y_out, aux
+
+
+def _moe_layer(cfg: MoEConfig, x: jax.Array, lp: dict, cos, sin, attn_fn):
+    x = tfm._attn_block(cfg, x, lp, cos, sin, attn_fn)
+    return _moe_block(cfg, x, lp)
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: MoEConfig,
+    *,
+    attn_fn=None,
+    position_offset: int = 0,
+):
+    """tokens [B, T] → (logits [B, T, V] float32, aux loss scalar)."""
+    if attn_fn is not None and position_offset:
+        raise ValueError(
+            "position_offset is only applied to the default dense attention; "
+            "a custom attn_fn must handle positions itself"
+        )
+    attn_fn = attn_fn or functools.partial(tfm._attention, causal_offset=position_offset)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    cos, sin = tfm.rope_tables(cfg, tokens.shape[1], position_offset)
+
+    def body(carry, lp):
+        x, aux = carry
+        x, layer_aux = _moe_layer(cfg, x, lp, cos, sin, attn_fn)
+        return (x, aux + layer_aux), None
+
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+    x = tfm.rms_norm(x, params["final_norm"])
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, aux / cfg.n_layers
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: MoEConfig, **kw) -> jax.Array:
+    logits, aux = forward(params, tokens, cfg, **kw)
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.router_aux_weight * aux
+
+
+def make_train_step(cfg: MoEConfig, optimizer=None, attn_fn=None):
+    """(train_step, init_opt_state) — jit-ready, same contract as the dense model's."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn=attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state

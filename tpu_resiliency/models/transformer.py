@@ -56,8 +56,11 @@ class TransformerConfig:
         )
 
 
-def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
-    """Parameter pytree with layer weights stacked on a leading [L] axis."""
+def init_params(rng: jax.Array, cfg: TransformerConfig, *, with_mlp: bool = True) -> dict:
+    """Parameter pytree with layer weights stacked on a leading [L] axis.
+
+    ``with_mlp=False`` skips the dense SwiGLU weights (the MoE family replaces
+    them with expert stacks and must not materialize both)."""
     k_embed, k_layers, k_head = jax.random.split(rng, 3)
     d, h, hkv, dh, f, L = (
         cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
@@ -70,19 +73,21 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
         return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in))
 
     ks = jax.random.split(k_layers, 7)
+    layers = {
+        "attn_norm": norm_init(L, d),
+        "wq": dense_init(ks[0], (L, d, h * dh), d),
+        "wk": dense_init(ks[1], (L, d, hkv * dh), d),
+        "wv": dense_init(ks[2], (L, d, hkv * dh), d),
+        "wo": dense_init(ks[3], (L, h * dh, d), h * dh),
+        "mlp_norm": norm_init(L, d),
+    }
+    if with_mlp:
+        layers["w_gate"] = dense_init(ks[4], (L, d, f), d)
+        layers["w_up"] = dense_init(ks[5], (L, d, f), d)
+        layers["w_down"] = dense_init(ks[6], (L, f, d), f)
     return {
         "embed": dense_init(k_embed, (cfg.vocab_size, d), d),
-        "layers": {
-            "attn_norm": norm_init(L, d),
-            "wq": dense_init(ks[0], (L, d, h * dh), d),
-            "wk": dense_init(ks[1], (L, d, hkv * dh), d),
-            "wv": dense_init(ks[2], (L, d, hkv * dh), d),
-            "wo": dense_init(ks[3], (L, h * dh, d), h * dh),
-            "mlp_norm": norm_init(L, d),
-            "w_gate": dense_init(ks[4], (L, d, f), d),
-            "w_up": dense_init(ks[5], (L, d, f), d),
-            "w_down": dense_init(ks[6], (L, f, d), f),
-        },
+        "layers": layers,
         "final_norm": norm_init(d),
         "lm_head": dense_init(k_head, (d, cfg.vocab_size), d),
     }
@@ -123,11 +128,10 @@ def _attention(q, k, v, causal_offset: int = 0):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _layer(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) -> jax.Array:
+def _attn_block(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) -> jax.Array:
+    """Pre-norm GQA attention with residual; shared by the dense and MoE layers."""
     b, t, d = x.shape
     h, hkv, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
-    # attention block
     y = rms_norm(x, lp["attn_norm"])
     q = (y @ lp["wq"].astype(y.dtype)).reshape(b, t, h, dh)
     k = (y @ lp["wk"].astype(y.dtype)).reshape(b, t, hkv, dh)
@@ -138,7 +142,11 @@ def _layer(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) ->
     k = jnp.repeat(k, reps, axis=2)
     v = jnp.repeat(v, reps, axis=2)
     attn = attn_fn(q, k, v).reshape(b, t, h * dh)
-    x = x + attn @ lp["wo"].astype(attn.dtype)
+    return x + attn @ lp["wo"].astype(attn.dtype)
+
+
+def _layer(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) -> jax.Array:
+    x = _attn_block(cfg, x, lp, cos, sin, attn_fn)
 
     # MLP block (SwiGLU)
     y = rms_norm(x, lp["mlp_norm"])

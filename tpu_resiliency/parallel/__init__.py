@@ -1,3 +1,8 @@
+"""Parallelism package. ``pipeline`` is intentionally NOT imported here: it pulls in
+jax at module import, while ``mesh`` keeps jax imports inside function bodies so
+jax-free host-side processes (launcher, telemetry hosts) can use the mesh math.
+Import it directly: ``from tpu_resiliency.parallel import pipeline``."""
+
 from tpu_resiliency.parallel import mesh
 
 __all__ = ["mesh"]

@@ -6,7 +6,12 @@ Axis names used throughout:
 - ``tp``: tensor parallel (attention heads / MLP hidden sharded; activations
   all-gathered / reduce-scattered by XLA where needed),
 - ``sp``: sequence/context parallel (long-context: sequence axis sharded, attention
-  runs as a ring over ``sp`` — see ``parallel/ring_attention.py``).
+  runs as a ring over ``sp`` — see ``parallel/ring_attention.py``),
+- ``pp``: pipeline parallel (the stacked ``[L]`` layer axis sharded into stages;
+  microbatches flow stage-to-stage as a ``ppermute`` ring — see
+  ``parallel/pipeline.py``),
+- ``ep``: expert parallel (MoE expert axis sharded; the dispatch einsums make XLA
+  route tokens with an all-to-all — see ``models/moe.py``).
 
 The reference implements no parallelism (SURVEY.md §2.7 checklist) — these exist because
 a TPU-native resiliency framework must be *exercised* against real sharded workloads,
@@ -19,7 +24,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-DP, TP, SP = "dp", "tp", "sp"
+DP, TP, SP, PP, EP = "dp", "tp", "sp", "pp", "ep"
 
 
 def build_mesh(
@@ -28,11 +33,16 @@ def build_mesh(
     dp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence] = None,
 ):
-    """Build a ``Mesh`` with the framework's canonical axes (dp, tp, sp).
+    """Build a ``Mesh`` with the framework's canonical axes (dp, tp, sp, pp, ep).
 
     If ``n_devices`` is given without explicit axis sizes, all devices go to ``dp``.
+    Axis order puts ``pp`` outermost (stage hops are the rarest, largest-grained
+    transfers) and ``tp`` innermost (its collectives are per-matmul, so it gets the
+    fastest ICI loops).
     """
     import jax
 
@@ -41,24 +51,36 @@ def build_mesh(
     devs = list(devices) if devices is not None else jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
-    total = dp * tp * sp
+    total = dp * tp * sp * pp * ep
     if total == 1 and n_devices:
         dp, total = len(devs), len(devs)
     if total != len(devs):
-        raise ValueError(f"dp*tp*sp = {total} != {len(devs)} devices")
-    return make_mesh({DP: dp, TP: tp, SP: sp}, devices=devs)
+        raise ValueError(f"dp*tp*sp*pp*ep = {total} != {len(devs)} devices")
+    return make_mesh({PP: pp, DP: dp, EP: ep, SP: sp, TP: tp}, devices=devs)
 
 
 def default_split(n_devices: int) -> dict[str, int]:
-    """A sensible (dp, tp, sp) split for n devices.
+    """A sensible (dp, tp, sp) split for n devices (pp/ep left to dedicated configs —
+    see :func:`moe_pipeline_split`).
 
     All three axes are real: 8 devices → (dp=2, tp=2, sp=2) — the training step
     runs tensor-parallel matmuls, a data-parallel gradient reduction, AND ring
     attention over the sequence axis (``parallel/ring_attention.py``)."""
     if n_devices % 8 == 0:
-        return {"dp": n_devices // 4, "tp": 2, "sp": 2}
+        return {"dp": n_devices // 4, "tp": 2, "sp": 2, "pp": 1, "ep": 1}
     tp = 2 if n_devices % 2 == 0 else 1
-    return {"dp": n_devices // tp, "tp": tp, "sp": 1}
+    return {"dp": n_devices // tp, "tp": tp, "sp": 1, "pp": 1, "ep": 1}
+
+
+def moe_pipeline_split(n_devices: int) -> dict[str, int]:
+    """A (dp, pp, ep) split exercising the pipeline + expert axes: 8 devices →
+    (dp=2, pp=2, ep=2). The MoE training step then runs a data-parallel gradient
+    reduction, a two-stage microbatch pipeline, AND expert-parallel dispatch."""
+    if n_devices % 4 == 0:
+        return {"dp": n_devices // 4, "tp": 1, "sp": 1, "pp": 2, "ep": 2}
+    if n_devices % 2 == 0:
+        return {"dp": n_devices // 2, "tp": 1, "sp": 1, "pp": 1, "ep": 2}
+    return {"dp": n_devices, "tp": 1, "sp": 1, "pp": 1, "ep": 1}
 
 
 def param_specs(cfg) -> dict:
@@ -86,6 +108,36 @@ def param_specs(cfg) -> dict:
         "final_norm": P(None),  # [D]
         "lm_head": P(None, TP),  # [D, V]
     }
+
+
+def moe_param_specs(cfg) -> dict:
+    """PartitionSpecs for the MoE parameter pytree (see models/moe.py).
+
+    The dense per-layer MLP is replaced by a replicated router and experts stacked
+    on an ``[E]`` axis sharded over ``ep``; within each expert the SwiGLU weights
+    keep the megatron column/row split over ``tp``. The stacked ``[L]`` layer axis
+    shards over ``pp`` when the pipeline runs (``layer_axis="pp"``).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    specs = param_specs(cfg)
+    layers = dict(specs["layers"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del layers[k]
+    layers["w_router"] = P(None, None, None)  # [L, D, E]
+    layers["we_gate"] = P(None, EP, None, TP)  # [L, E, D, F]
+    layers["we_up"] = P(None, EP, None, TP)  # [L, E, D, F]
+    layers["we_down"] = P(None, EP, TP, None)  # [L, E, F, D]
+    specs["layers"] = layers
+    return specs
+
+
+def pipeline_layer_specs(layer_specs: dict) -> dict:
+    """Prepend ``pp`` to the leading stacked-``[L]`` dim of every per-layer spec, so
+    each pipeline stage holds only its own layers."""
+    from jax.sharding import PartitionSpec as P
+
+    return {k: P(PP, *spec[1:]) for k, spec in layer_specs.items()}
 
 
 def batch_spec():

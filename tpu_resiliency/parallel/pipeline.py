@@ -1,0 +1,231 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the ``pp`` mesh axis.
+
+The stacked ``[L]`` layer axis of the models' parameter pytrees shards into
+``pp`` contiguous stages (each device holds ``L/pp`` layers and scans over them —
+the same single-trace layer body as the unpipelined models). Microbatches flow
+through the stage ring as a ``lax.ppermute`` of the activation carry: at tick ``t``
+stage 0 ingests microbatch ``t``, every stage applies its layers, and the result
+rotates one hop so stage ``s`` processes microbatch ``t - s``. After
+``n_micro + pp - 1`` ticks every microbatch has crossed every stage; the last
+stage's results are ``psum``-replicated back over ``pp``. Bubble-tick compute is
+masked out of the output (and therefore out of the gradients — ``ppermute`` and the
+masks are linear, so ``jax.grad`` derives the reverse schedule automatically; no
+hand-written backward pass).
+
+TPU-first choices:
+- the schedule is a ``lax.scan`` over ticks — one compiled program, no per-tick
+  dispatch, static shapes throughout;
+- ``shard_map`` is manual over ``pp`` ONLY (``axis_names={'pp'}``): everything
+  inside the stage body stays auto-sharded, so tensor-parallel (``tp``) matmuls
+  and expert-parallel (``ep``) dispatch compose with pipelining without any
+  pipeline-specific code in the models;
+- stage hops are nearest-neighbor ``ppermute`` — the cheapest ICI pattern.
+
+The reference implements no parallelism (SURVEY.md §2.7 checklist); this exists so
+the resiliency framework is exercised against the full (dp, tp, sp, pp, ep) mesh
+its rank-topology components (Tree layers, replication cliques) are built for.
+
+Composition limits: ring attention (``sp > 1``) is itself a ``shard_map`` and does
+not nest inside the pipeline body; pipelined configs run dense attention
+(``sp == 1`` — enforced), while long-context jobs shard ``sp`` without ``pp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpu_resiliency.parallel.mesh import PP, SP
+
+
+def make_stacked_pipeline(mesh, layer_fn: Callable, n_micro: int, axis_name: str = PP):
+    """Build ``apply(layers, carries, consts) -> carries_out``.
+
+    - ``layers``: pytree whose leaves stack the layer axis ``[L, ...]``; ``L`` must
+      divide evenly into ``mesh.shape[axis_name]`` stages.
+    - ``carries``: pytree whose leaves have leading ``[n_micro]`` — one activation
+      carry per microbatch (e.g. ``(x,)`` or ``(x, aux)``).
+    - ``consts``: pytree of per-call constants replicated to every stage (e.g. RoPE
+      tables).
+    - ``layer_fn(carry, lp, consts) -> carry`` applies ONE layer.
+    """
+    n_stages = mesh.shape[axis_name]
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def stage_fn(layers_local, carry, consts):
+        def body(c, lp):
+            return layer_fn(c, lp, consts), None
+
+        c, _ = lax.scan(body, carry, layers_local)
+        return c
+
+    def apply(layers, carries, consts):
+        # Everything crossing the auto/manual boundary travels in f32: the
+        # replicated-over-pp inputs transpose to a psum in the backward pass, and
+        # XLA's CPU AllReducePromotion pass miscompiles the bf16 all-reduce /
+        # reduce-scatter that boundary would otherwise emit ("Invalid binary
+        # instruction opcode copy"). Compute inside the body stays in the carries'
+        # own dtypes.
+        dtypes = jax.tree.map(lambda a: a.dtype, carries)
+
+        def body(layers_local, carries32, consts):
+            carries_local = jax.tree.map(
+                lambda a, dt: a.astype(dt), carries32, dtypes
+            )
+            s = lax.axis_index(axis_name)
+            state = jax.tree.map(lambda a: a[0], carries_local)
+            out = jax.tree.map(jnp.zeros_like, carries_local)
+
+            def tick(carry, t):
+                state, out = carry
+                y = stage_fn(layers_local, state, consts)
+                # The last stage emits microbatch t-(n_stages-1)'s final
+                # activation. Every stage writes its buffer, but only the last
+                # stage's buffer is read back (all_gather + static index below).
+                widx = t - (n_stages - 1)
+                ok = widx >= 0
+
+                def write(o, yl):
+                    upd = lax.dynamic_update_slice_in_dim(
+                        o,
+                        yl[None].astype(o.dtype),
+                        jnp.clip(widx, 0, n_micro - 1),
+                        axis=0,
+                    )
+                    return jnp.where(ok, upd, o)
+
+                out = jax.tree.map(write, out, y)
+                nxt = lax.ppermute(y, axis_name, fwd) if n_stages > 1 else y
+                inj = jax.tree.map(
+                    lambda c: lax.dynamic_index_in_dim(
+                        c, jnp.clip(t + 1, 0, n_micro - 1), axis=0, keepdims=False
+                    ),
+                    carries_local,
+                )
+                state = jax.tree.map(lambda a, b: jnp.where(s == 0, a, b), inj, nxt)
+                return (state, out), None
+
+            (_, out), _ = lax.scan(
+                tick, (state, out), jnp.arange(n_micro + n_stages - 1)
+            )
+            # Replicate the last stage's buffer to every stage, f32 at the
+            # boundary (see above).
+            return jax.tree.map(
+                lambda o: lax.all_gather(o.astype(jnp.float32), axis_name, axis=0)[
+                    n_stages - 1
+                ],
+                out,
+            )
+
+        sharded = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axis_name), P(), P()),
+            out_specs=P(),
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        out32 = sharded(
+            layers, jax.tree.map(lambda a: a.astype(jnp.float32), carries), consts
+        )
+        return jax.tree.map(lambda o, dt: o.astype(dt), out32, dtypes)
+
+    return apply
+
+
+def _check_pipeline_mesh(mesh, cfg, n_micro):
+    n_stages = mesh.shape[PP]
+    if cfg.n_layers % n_stages != 0:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={n_stages}")
+    if mesh.shape.get(SP, 1) != 1:
+        raise ValueError(
+            "pipelined configs run dense attention: ring attention (sp > 1) is a "
+            "shard_map and does not nest inside the pp stage body"
+        )
+    if n_micro < 1:
+        raise ValueError("n_micro must be >= 1")
+
+
+def make_pipelined_loss_fn(cfg, mesh, n_micro: int, family: str = "dense"):
+    """Cross-entropy loss with the layer stack pipelined over ``pp``.
+
+    ``family``: ``"dense"`` (``models.transformer``) or ``"moe"``
+    (``models.moe`` — router aux rides the microbatch carry and is averaged).
+    """
+    from tpu_resiliency.models import moe as moe_mod
+    from tpu_resiliency.models import transformer as tfm
+
+    _check_pipeline_mesh(mesh, cfg, n_micro)
+
+    if family == "dense":
+
+        def layer_fn(carry, lp, consts):
+            (x,) = carry
+            cos, sin = consts
+            return (tfm._layer(cfg, x, lp, cos, sin, tfm._attention),)
+
+    elif family == "moe":
+
+        def layer_fn(carry, lp, consts):
+            x, aux = carry
+            cos, sin = consts
+            x, layer_aux = moe_mod._moe_layer(cfg, x, lp, cos, sin, tfm._attention)
+            return (x, aux + layer_aux)
+
+    else:
+        raise ValueError(f"unknown family: {family!r}")
+
+    pipeline = make_stacked_pipeline(mesh, layer_fn, n_micro)
+
+    def loss_fn(params, tokens):
+        B, T = tokens.shape
+        if B % n_micro != 0:
+            raise ValueError(f"batch {B} not divisible by n_micro={n_micro}")
+        mb = B // n_micro
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        cos, sin = tfm.rope_tables(cfg, T)
+        mbs = x.reshape(n_micro, mb, T, -1)
+        if family == "moe":
+            carries = (mbs, jnp.zeros((n_micro,), jnp.float32))
+        else:
+            carries = (mbs,)
+        out = pipeline(params["layers"], carries, (cos, sin))
+        x = out[0].reshape(B, T, -1)
+        x = tfm.rms_norm(x, params["final_norm"])
+        logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+        logits = logits[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        loss = nll.mean()
+        if family == "moe":
+            loss = loss + cfg.router_aux_weight * out[1].mean() / cfg.n_layers
+        return loss
+
+    return loss_fn
+
+
+def make_pipelined_train_step(cfg, mesh, n_micro: int, family: str = "dense", optimizer=None):
+    """(train_step, init_opt_state) with the layer stack pipelined over ``pp`` —
+    same contract as the models' ``make_train_step``."""
+    import optax
+
+    loss_fn = make_pipelined_loss_fn(cfg, mesh, n_micro, family)
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+
+    def init_opt_state(params):
+        return optimizer.init(params)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step, init_opt_state
