@@ -23,6 +23,13 @@ MAX_REPORT_ROUNDS = 8  # stop as soon as the demotion is agreed (patience 2)
 
 
 def body(rank, world, port, q):
+    # Spawned children do not run conftest: force the CPU platform before any
+    # backend use, or the site-installed TPU plugin routes all three children's
+    # scoring through the single real TPU tunnel (serialized, tens of seconds of
+    # stall — enough to trip the progress watchdog on a healthy rank).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
     os.environ.update(
         RANK=str(rank),
         WORLD_SIZE=str(world),
@@ -39,12 +46,15 @@ def body(rank, world, port, q):
         rank_assignment=DemoteDegraded(max_active_world_size=2),
         monitor_interval=0.05,
         last_call_wait=0.1,
-        soft_timeout=30.0,
-        hard_timeout=60.0,
+        soft_timeout=45.0,
+        hard_timeout=90.0,
         heartbeat_interval=0.2,
-        heartbeat_timeout=15.0,
-        barrier_timeout=60.0,
-        completion_timeout=60.0,
+        # Hang detection is NOT this test's subject (measured slowness → scored
+        # demotion is); a tight heartbeat window false-positives under CI load
+        # and ejects a healthy-but-starved rank mid-completion.
+        heartbeat_timeout=60.0,
+        barrier_timeout=90.0,
+        completion_timeout=90.0,
     )
     def train(call: CallWrapper):
         fs = call.frozen_state

@@ -43,6 +43,44 @@ def test_get_timeout(coord_store):
         coord_store.get("never", timeout=0.1)
 
 
+def test_close_while_clients_block_raises_not_hangs():
+    """A clean server close must fail parked waiters (blocking get AND barrier join)
+    promptly with a store error — never leave them hanging to their full timeout."""
+    from tpu_resiliency.exceptions import StoreError
+
+    server = KVServer(host="127.0.0.1", port=0)
+    c1 = CoordStore("127.0.0.1", server.port)
+    c2 = CoordStore("127.0.0.1", server.port)
+    errors = {}
+
+    def blocked_get():
+        try:
+            c1.get("never", timeout=60.0)
+        except Exception as e:
+            errors["get"] = e
+
+    def blocked_barrier():
+        try:
+            c2.barrier_join("b", 0, 2, timeout=60.0)
+        except Exception as e:
+            errors["barrier"] = e
+
+    threads = [threading.Thread(target=blocked_get), threading.Thread(target=blocked_barrier)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(0.3)  # both parked server-side
+    server.close()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive(), "client still blocked after server close"
+    assert time.monotonic() - t0 < 15.0
+    assert isinstance(errors["get"], StoreError)
+    assert isinstance(errors["barrier"], (StoreError, BarrierTimeout))
+    c1.close()
+    c2.close()
+
+
 def test_add_and_cas(coord_store):
     assert coord_store.add("ctr", 1) == 1
     assert coord_store.add("ctr", 5) == 6
@@ -253,6 +291,40 @@ def test_auth_handshake():
     with pytest.raises(Exception):
         CoordStore("127.0.0.1", server.port, auth_key=None, timeout=5.0, connect_retries=1)
     good.close()
+    server.close()
+
+
+def test_silent_unauthenticated_conn_is_dropped():
+    """A peer that connects but never answers the auth challenge must be evicted at
+    the handshake deadline, not held open forever (fd-exhaustion vector)."""
+    import socket as socket_mod
+
+    from tpu_resiliency.platform.store import KVServer
+
+    server = KVServer(host="127.0.0.1", port=0, auth_key="sekrit", auth_timeout=0.5)
+    silent = socket_mod.create_connection(("127.0.0.1", server.port), timeout=5.0)
+    silent.recv(4096)  # hello arrives; never send the MAC
+    deadline = time.monotonic() + 10.0
+    dropped = False
+    while time.monotonic() < deadline:
+        time.sleep(0.2)
+        try:
+            silent.settimeout(0.2)
+            if silent.recv(4096) == b"":
+                dropped = True
+                break
+        except socket_mod.timeout:
+            continue
+        except OSError:
+            dropped = True
+            break
+    assert dropped, "unauthenticated connection was never dropped"
+    # The server still serves authenticated clients afterwards.
+    good = CoordStore("127.0.0.1", server.port, auth_key="sekrit", timeout=5.0)
+    good.set("x", 1)
+    assert good.get("x") == 1
+    good.close()
+    silent.close()
     server.close()
 
 

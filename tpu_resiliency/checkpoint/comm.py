@@ -245,8 +245,9 @@ class PeerExchange:
                 pass
 
     def _handshake_server(self, conn: socket.socket) -> bool:
-        """Challenge/response before any pickled payload is parsed (mirrors
-        ``KVServer._handshake``). No-op when auth is off (loopback-only bind)."""
+        """Challenge/response before any pickled payload is parsed (same hello
+        protocol as ``KVServer`` — see its ``_accept``/``_parse`` auth path). No-op
+        when auth is off (loopback-only bind)."""
         nonce = secrets.token_bytes(16)
         framing.send_obj(conn, {"v": 1, "auth": self.auth_key is not None, "nonce": nonce})
         if self.auth_key is None:

@@ -85,6 +85,11 @@ class Wrapper:
     barrier_timeout: float = 120.0
     completion_timeout: float = 120.0
     termination_signal: int = int(signal.SIGTERM)
+    #: How long the rank hosting the coordination server keeps it alive after a
+    #: clean completion, so a straggler that was proxy-completed (declared dead
+    #: under load but actually alive) can still read ``job_done`` and stand down
+    #: instead of crashing on a dead socket.
+    server_linger: float = 5.0
 
     enable_monitor_process: bool = True
     store_host: Optional[str] = None
@@ -160,6 +165,12 @@ class CallWrapper:
             )
             if self.server is not None:
                 os.environ.setdefault("TPU_RESILIENCY_STORE_PORT", str(self.server.port))
+        # Resolved coordinator address, for the fresh-connection job_done probe a
+        # rank makes when its persistent client hits a dead server mid-restart.
+        self._store_addr = (
+            ("127.0.0.1", self.server.port) if self.server is not None else (host, port)
+        )
+        self._store_prefix = prefix
         self.coord = RestartCoordinator(self.store, self.state.world_size)
 
         self.monitor_process: Optional[MonitorProcess] = None
@@ -230,24 +241,39 @@ class CallWrapper:
                 kwargs[name] = self
         return kwargs
 
-    def _reserve_wait(self, iteration: int) -> None:
+    def _reserve_wait(self, iteration: int) -> bool:
         """INACTIVE spare: wait until some active rank completes or a fault occurs
-        (reference ``reserve_fn``, ``wrap.py:57-72``)."""
+        (reference ``reserve_fn``, ``wrap.py:57-72``). Returns True if the job
+        completed while the coordinator went away (stand down — the caller must
+        skip the completion coordination). A transient transport hiccup (server
+        still reachable) resumes polling; a genuinely lost coordinator raises
+        :class:`RestartAbort` so an idle spare never masks a failed job with a
+        clean exit."""
         while True:
             try:
                 if self.coord.is_completed(iteration):
-                    return
+                    return False
                 if self.coord.is_interrupted(iteration):
                     raise RankShouldRestart
-            except StoreError:
-                # Coordinator teardown ⇒ the job completed while we idled in reserve.
-                return
+            except StoreError as se:
+                done = self._probe_job_done()
+                if done is True:
+                    return True
+                if done is None:
+                    raise RestartAbort(
+                        f"coordination store lost while in reserve: {se!r}"
+                    ) from se
+                # Reachable but not done: transient hiccup — keep reserving (the
+                # persistent client reconnects on the next call).
             time.sleep(self.w.monitor_interval)
 
     def _leave(self) -> None:
         """This rank permanently exits the job: peers' barriers are proxied by our
         monitor process from now on."""
-        self.coord.record_terminated([self.state.rank])
+        try:
+            self.coord.record_terminated([self.state.rank])
+        except StoreError:
+            pass  # coordinator already gone — nothing left to tell
         self.watchdog.shutdown()
         if self.monitor_process is not None:
             # Dropping the link makes the monitor treat us as dead → barrier proxy.
@@ -293,8 +319,54 @@ class CallWrapper:
             self.monitor_process.shutdown()
         self.store.close()
         if self.server is not None:
-            # All ranks are past the completion barrier; stragglers' remaining store
-            # traffic (job_done polls) tolerates the server going away.
+            # All ranks are past the completion barrier. The server lingers briefly
+            # (daemon timer; dies with the process either way) so a proxy-completed
+            # straggler can still read job_done and stand down cleanly.
+            if self.w.server_linger > 0:
+                t = threading.Timer(self.w.server_linger, self.server.close)
+                t.daemon = True
+                t.start()
+            else:
+                self.server.close()
+
+    def _probe_job_done(self) -> Optional[bool]:
+        """The persistent store client hit a transport error. Probe with a fresh
+        short-lived connection: ``True`` — job completed without us (we were
+        declared dead during a completion round; stand down). ``False`` — server
+        reachable, job not done (transient hiccup). ``None`` — coordinator
+        unreachable (genuinely lost; surface loudly)."""
+        from tpu_resiliency.platform.store import CoordStore
+
+        host, port = self._store_addr
+        try:
+            probe = CoordStore(
+                host, port, prefix=self._store_prefix, timeout=2.0, connect_retries=2
+            )
+            try:
+                return bool(probe.try_get("job_done", False))
+            finally:
+                probe.close()
+        except StoreError:
+            return None
+
+    def _stand_down(self, monitor, iteration: int, reason: str) -> None:
+        """Exit cleanly as the odd rank out of a completed job: the coordinator is
+        gone and ``job_done`` (or reserve-loss semantics) says the job finished
+        without us."""
+        log.warning(f"rank {self.state.rank}: standing down (iter {iteration}): {reason}")
+        record_event(
+            "inprocess", "stood_down", iteration=iteration,
+            initial_rank=self.state.initial_rank, reason=reason,
+        )
+        try:
+            monitor.shutdown()
+        except Exception:
+            pass
+        self.watchdog.shutdown()
+        if self.monitor_process is not None:
+            self.monitor_process.shutdown()
+        self.store.close()
+        if self.server is not None:
             self.server.close()
 
     # -- the restart loop --------------------------------------------------
@@ -346,13 +418,18 @@ class CallWrapper:
                         kwargs = self._maybe_inject_self(self.fn_kwargs)
                         ret = self.fn(*self.fn_args, **kwargs)
                     else:
-                        self._reserve_wait(iteration)
+                        if self._reserve_wait(iteration):
+                            monitor.disarm()
+                            self._stand_down(
+                                monitor, iteration, "coordinator gone while in reserve"
+                            )
+                            return None
                         ret = None
                     monitor.disarm()
                     if self.monitor_process is not None:
                         self.monitor_process.set_phase("coord")
-                    coord.mark_completed(iteration)
                     try:
+                        coord.mark_completed(iteration)
                         coord.join_completion_barrier(
                             iteration, state.rank, w.completion_timeout
                         )
@@ -362,6 +439,21 @@ class CallWrapper:
                         # out the full barrier timeout here would outlast the faulted
                         # rank's iteration-barrier wait and eject a healthy rank.
                         raise RankShouldRestart from None
+                    except StoreError as se:
+                        # Coordinator died while we completed. If the job is done
+                        # (peers completed and tore the store down), our own result
+                        # stands; otherwise the loss is fatal (a retry of the
+                        # completion join after a half-registered arrival would
+                        # overflow, so a reachable-but-unfinished server is fatal
+                        # here too).
+                        if self._probe_job_done() is True:
+                            self._stand_down(
+                                monitor, iteration, "coordinator gone at completion"
+                            )
+                            return ret
+                        raise RestartAbort(
+                            f"coordination store lost at completion: {se!r}"
+                        ) from se
                     self._chain(w.completion, state.freeze())
                     record_event(
                         "inprocess", "completed", iteration=iteration,
@@ -398,9 +490,12 @@ class CallWrapper:
                         restart = True
                     elif isinstance(e, Exception):
                         state.fn_exception = e
-                        coord.record_interruption(
-                            iteration, state.rank, Interruption.EXCEPTION, repr(e)
-                        )
+                        try:
+                            coord.record_interruption(
+                                iteration, state.rank, Interruption.EXCEPTION, repr(e)
+                            )
+                        except StoreError:
+                            pass  # dead coordinator: the restart transition resolves it
                         log.warning(
                             f"rank {state.rank}: wrapped fn raised {e!r} (iter {iteration})"
                         )
@@ -446,24 +541,41 @@ class CallWrapper:
                 # Check the terminated set BEFORE joining: a falsely-declared-dead
                 # rank's barriers were already proxy-joined, so a waiting join here
                 # would overflow rather than surface the real condition.
-                if state.initial_rank in coord.terminated_ranks():
-                    raise RestartAbort(
-                        f"rank {state.initial_rank} was declared terminated by peers"
-                    )
                 try:
-                    coord.join_iteration_barrier(iteration, state.rank, w.barrier_timeout)
-                except BarrierOverflow as e:
-                    # Our slot was proxy-joined between the check and the join.
+                    if state.initial_rank in coord.terminated_ranks():
+                        raise RestartAbort(
+                            f"rank {state.initial_rank} was declared terminated by peers"
+                        )
+                    try:
+                        coord.join_iteration_barrier(
+                            iteration, state.rank, w.barrier_timeout
+                        )
+                    except BarrierOverflow as e:
+                        # Our slot was proxy-joined between the check and the join.
+                        raise RestartAbort(
+                            f"rank {state.initial_rank} was declared terminated by peers"
+                        ) from e
+                    except BarrierTimeout as e:
+                        raise RestartAbort(
+                            f"iteration barrier timed out after {w.barrier_timeout}s: "
+                            f"unproxied dead ranks or store loss"
+                        ) from e
+                    terminated = coord.terminated_ranks()
+                    degraded = coord.degraded_ranks()
+                except StoreError as se:
+                    # The coordinator is gone. A rank that was proxy-completed out
+                    # of a finishing round (declared dead under load but actually
+                    # alive) lands here when rank 0 tears the store down: stand
+                    # down if the job completed, abort loudly otherwise.
+                    if self._probe_job_done() is True:
+                        self._stand_down(
+                            monitor, iteration, "coordinator gone mid-restart; job done"
+                        )
+                        return None
                     raise RestartAbort(
-                        f"rank {state.initial_rank} was declared terminated by peers"
-                    ) from e
-                except BarrierTimeout as e:
-                    raise RestartAbort(
-                        f"iteration barrier timed out after {w.barrier_timeout}s: "
-                        f"unproxied dead ranks or store loss"
-                    ) from e
-                terminated = coord.terminated_ranks()
-                ctx = RankAssignmentCtx(state, terminated, coord.degraded_ranks())
+                        f"coordination store lost mid-restart: {se!r}"
+                    ) from se
+                ctx = RankAssignmentCtx(state, terminated, degraded)
                 state = w.rank_assignment(ctx).state
                 if state.mode == Mode.TERMINATED:
                     raise RestartAbort("excluded by rank assignment")

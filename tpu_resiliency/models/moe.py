@@ -25,7 +25,6 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -171,17 +170,6 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: MoEConfig, **kw) -> jax.Array:
 
 def make_train_step(cfg: MoEConfig, optimizer=None, attn_fn=None):
     """(train_step, init_opt_state) — jit-ready, same contract as the dense model's."""
-    import optax
-
-    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
-
-    def init_opt_state(params):
-        return optimizer.init(params)
-
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn=attn_fn)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    return train_step, init_opt_state
+    return tfm.make_train_step_from_loss(
+        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn=attn_fn), optimizer
+    )

@@ -202,12 +202,11 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig, **kw) -> ja
     return nll.mean()
 
 
-def make_train_step(cfg: TransformerConfig, optimizer=None, attn_fn=None):
-    """Returns ``(train_step, init_opt_state)`` — jit-ready pure functions.
-
-    ``attn_fn`` overrides the dense attention (e.g.
-    :func:`~tpu_resiliency.parallel.ring_attention.make_ring_attn_fn` for a
-    sequence-sharded mesh)."""
+def make_train_step_from_loss(bound_loss_fn, optimizer=None):
+    """Shared factory behind every model family's ``make_train_step``:
+    ``(train_step, init_opt_state)`` from a bound ``loss_fn(params, tokens)``.
+    Changes to the training contract (optimizer default, grad transform) live here
+    once."""
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
@@ -216,9 +215,20 @@ def make_train_step(cfg: TransformerConfig, optimizer=None, attn_fn=None):
         return optimizer.init(params)
 
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, attn_fn=attn_fn)
+        loss, grads = jax.value_and_grad(bound_loss_fn)(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     return train_step, init_opt_state
+
+
+def make_train_step(cfg: TransformerConfig, optimizer=None, attn_fn=None):
+    """Returns ``(train_step, init_opt_state)`` — jit-ready pure functions.
+
+    ``attn_fn`` overrides the dense attention (e.g.
+    :func:`~tpu_resiliency.parallel.ring_attention.make_ring_attn_fn` for a
+    sequence-sharded mesh)."""
+    return make_train_step_from_loss(
+        lambda params, tokens: loss_fn(params, tokens, cfg, attn_fn=attn_fn), optimizer
+    )

@@ -214,18 +214,8 @@ def make_pipelined_loss_fn(cfg, mesh, n_micro: int, family: str = "dense"):
 def make_pipelined_train_step(cfg, mesh, n_micro: int, family: str = "dense", optimizer=None):
     """(train_step, init_opt_state) with the layer stack pipelined over ``pp`` —
     same contract as the models' ``make_train_step``."""
-    import optax
+    from tpu_resiliency.models.transformer import make_train_step_from_loss
 
-    loss_fn = make_pipelined_loss_fn(cfg, mesh, n_micro, family)
-    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
-
-    def init_opt_state(params):
-        return optimizer.init(params)
-
-    def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    return train_step, init_opt_state
+    return make_train_step_from_loss(
+        make_pipelined_loss_fn(cfg, mesh, n_micro, family), optimizer
+    )

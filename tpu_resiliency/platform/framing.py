@@ -18,9 +18,31 @@ LEN = struct.Struct("!Q")
 DEFAULT_MAX_FRAME = 64 * 1024 * 1024
 
 
-def send_obj(sock: socket.socket, obj: Any) -> None:
+def encode_obj(obj: Any) -> bytes:
+    """One frame, ready for the wire."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(LEN.pack(len(payload)) + payload)
+    return LEN.pack(len(payload)) + payload
+
+
+def decode_frame(buf, max_frame: int = DEFAULT_MAX_FRAME):
+    """Incremental counterpart of :func:`recv_obj` for event-loop readers: try to
+    decode one frame from the head of ``buf`` (any bytes-like). Returns
+    ``(obj, bytes_consumed)``, or ``None`` if the frame is still incomplete.
+    Raises ``ValueError`` on an oversized frame (the caller should drop the peer).
+    """
+    if len(buf) < LEN.size:
+        return None
+    (length,) = LEN.unpack(bytes(buf[: LEN.size]))
+    if length > max_frame:
+        raise ValueError(f"frame too large: {length} > {max_frame}")
+    end = LEN.size + length
+    if len(buf) < end:
+        return None
+    return pickle.loads(bytes(buf[LEN.size : end])), end
+
+
+def send_obj(sock: socket.socket, obj: Any) -> None:
+    sock.sendall(encode_obj(obj))
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytes:
