@@ -189,12 +189,25 @@ class PyTreeStateDict:
         shardings: Optional[Sequence[Any]] = None,
         device: Any = None,
     ) -> None:
-        """``jax.device_put`` the payload back (mesh shardings > explicit device > default)."""
+        """``jax.device_put`` the payload back (mesh shardings > explicit device > default).
+
+        ``shardings`` may be a flat sequence aligned with the popped tensor list OR a
+        pytree matching the saved tree's structure (it is flattened in the same leaf
+        order ``pop_tensors`` used)."""
         import jax
 
         if self._tensors is None:
             raise CheckpointError("no tensors to restore")
         target = shardings if shardings is not None else self._shardings
+        if target is not None and not isinstance(target, (list, tuple)):
+            # None is a valid per-leaf value ("default placement"); tree_leaves
+            # would silently drop it and misalign everything after.
+            target = jax.tree_util.tree_leaves(target, is_leaf=lambda x: x is None)
+            if len(target) != len(self._tensors):
+                raise CheckpointError(
+                    f"shardings pytree flattens to {len(target)} leaves, "
+                    f"payload has {len(self._tensors)} tensors — structures differ"
+                )
         out = []
         for i, t in enumerate(self._tensors):
             s = target[i] if target is not None and i < len(target) else None

@@ -511,9 +511,12 @@ class CallWrapper:
                         # (reference restarts only on Exception; its outer handler
                         # re-raises, ``wrap.py:558``).
                         state.fn_exception = e
-                        coord.record_interruption(
-                            iteration, state.rank, Interruption.TERMINATED, repr(e)
-                        )
+                        try:
+                            coord.record_interruption(
+                                iteration, state.rank, Interruption.TERMINATED, repr(e)
+                            )
+                        except StoreError:
+                            pass  # dead coordinator — still run the local exit path
                         log.warning(
                             f"rank {state.rank}: wrapped fn raised {e!r} — terminating rank"
                         )

@@ -168,6 +168,15 @@ class KVServer:
         self._wake_r, self._wake_w = socket.socketpair()
         self._wake_r.setblocking(False)
 
+        # Reserve fd for fd-exhaustion shedding: when accept() hits EMFILE, the
+        # pending connection would re-fire the level-triggered selector forever.
+        # Closing the reserve frees one fd to accept-and-close the peer (it sees a
+        # clean disconnect and can retry), then the reserve is reopened.
+        try:
+            self._reserve_fd = os.open(os.devnull, os.O_RDONLY)
+        except OSError:
+            self._reserve_fd = None
+
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._sock, selectors.EVENT_READ, "accept")
         self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
@@ -245,6 +254,12 @@ class KVServer:
                 s.close()
             except OSError:
                 pass
+        if self._reserve_fd is not None:
+            try:
+                os.close(self._reserve_fd)
+            except OSError:
+                pass
+            self._reserve_fd = None
         self._sel.close()
 
     def _accept(self) -> None:
@@ -253,7 +268,26 @@ class KVServer:
                 sock, _ = self._sock.accept()
             except BlockingIOError:
                 return
-            except OSError:
+            except OSError as e:
+                import errno
+
+                if e.errno in (errno.EMFILE, errno.ENFILE) and self._reserve_fd is not None:
+                    # Shed the pending connection via the reserve fd so the
+                    # selector doesn't busy-spin on the still-readable listener.
+                    os.close(self._reserve_fd)
+                    self._reserve_fd = None
+                    try:
+                        shed, _ = self._sock.accept()
+                        shed.close()
+                        log.warning("store: fd limit reached; shed one connection")
+                    except OSError:
+                        pass
+                    finally:
+                        try:
+                            self._reserve_fd = os.open(os.devnull, os.O_RDONLY)
+                        except OSError:
+                            self._reserve_fd = None
+                    continue
                 return
             sock.setblocking(False)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -357,8 +391,9 @@ class KVServer:
             obj, consumed = decoded
             del conn.rbuf[:consumed]
             if conn.awaiting_mac:
-                ok = isinstance(obj, dict) and hmac.compare_digest(
-                    obj.get("mac", b""), _hmac(self.auth_key, conn.nonce)
+                mac = obj.get("mac", b"") if isinstance(obj, dict) else b""
+                ok = isinstance(mac, (bytes, bytearray)) and hmac.compare_digest(
+                    bytes(mac), _hmac(self.auth_key, conn.nonce)
                 )
                 if not ok:
                     log.warning("store: rejected connection with bad auth")
