@@ -96,16 +96,23 @@ def main() -> None:
             step, init_opt = pl.make_pipelined_train_step(
                 cfg, mesh, n_micro=args.n_micro, family="moe"
             )
-            opt = jax.jit(init_opt)(params)
             step_jit = jax.jit(step)
 
             mgr = LocalCheckpointManager(ckpt_root, rank=0)
             start = 0
             latest = mgr.find_latest()
-            if latest >= 0:
-                tree, meta = mgr.load_tree(latest, shardings={"params": shardings})
-                params = tree["params"]
+            if latest < 0:
                 opt = jax.jit(init_opt)(params)
+            else:
+                # Restore params AND optimizer state — resuming with fresh Adam
+                # moments would silently change the training trajectory. The
+                # shardings pytree mirrors the saved tree; opt leaves use default
+                # placement (None) and jit re-shards them on entry.
+                opt_spec = jax.tree.map(lambda _: None, jax.eval_shape(init_opt, params))
+                tree, meta = mgr.load_tree(
+                    latest, shardings={"params": shardings, "opt": opt_spec}
+                )
+                params, opt = tree["params"], tree["opt"]
                 start = int(meta["iteration"]) + 1
                 print(f"RESUMED step={start}", flush=True)
 
@@ -116,7 +123,9 @@ def main() -> None:
                     raise RuntimeError(f"injected fault at step {i}")
                 params, opt, loss = step_jit(params, opt, tokens)
                 if i % args.ckpt_every == 0:
-                    mgr.save(i, PyTreeStateDict({"params": params}), is_async=False)
+                    mgr.save(
+                        i, PyTreeStateDict({"params": params, "opt": opt}), is_async=False
+                    )
             mgr.maybe_finalize(blocking=True)
             mgr.close()
             return float(loss)

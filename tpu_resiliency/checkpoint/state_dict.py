@@ -184,6 +184,38 @@ class PyTreeStateDict:
         # device_get on the whole list queues all transfers before blocking on any.
         self._tensors = [np.asarray(x) for x in jax.device_get(self._tensors)]
 
+    def _align_shardings_pytree(self, shardings) -> list:
+        """Flatten a shardings pytree that mirrors the saved tree's structure into a
+        flat list aligned with the popped tensor order. Non-array leaves in the saved
+        tree (e.g. a step counter) are allowed: their corresponding shardings-pytree
+        entries are ignored."""
+        import jax
+
+        # None must count as a leaf on BOTH sides (it is jax's empty node by
+        # default): in the saved tree it may be an optional field, in the
+        # shardings pytree it means "default placement".
+        is_ph = lambda x: isinstance(x, TensorPlaceholder) or x is None  # noqa: E731
+        tree_leaves, tree_def = jax.tree_util.tree_flatten(self._tree, is_leaf=is_ph)
+        sh_leaves, sh_def = jax.tree_util.tree_flatten(
+            shardings, is_leaf=lambda x: x is None
+        )
+        if len(sh_leaves) != len(tree_leaves) or sh_def != tree_def:
+            raise CheckpointError(
+                f"shardings pytree does not mirror the saved tree "
+                f"({len(sh_leaves)} vs {len(tree_leaves)} leaves) — pass a pytree "
+                f"with a Sharding/None at each saved-tree leaf, or a flat "
+                f"per-tensor sequence"
+            )
+        out: list = [None] * len(self._tensors)
+        cursor = 0  # full-tree case: arrays appear in tree order == pop order
+        for leaf, s in zip(tree_leaves, sh_leaves):
+            if isinstance(leaf, TensorPlaceholder):
+                out[leaf.index] = s
+            elif _is_array(leaf):
+                out[cursor] = s
+                cursor += 1
+        return out
+
     def restore_tensor_device(
         self,
         shardings: Optional[Sequence[Any]] = None,
@@ -191,23 +223,26 @@ class PyTreeStateDict:
     ) -> None:
         """``jax.device_put`` the payload back (mesh shardings > explicit device > default).
 
-        ``shardings`` may be a flat sequence aligned with the popped tensor list OR a
-        pytree matching the saved tree's structure (it is flattened in the same leaf
-        order ``pop_tensors`` used)."""
+        ``shardings`` may be a flat sequence of shardings (aligned with the popped
+        tensor list) OR a pytree mirroring the saved tree's structure, with a
+        ``Sharding`` or ``None`` (default placement) at each leaf."""
         import jax
 
         if self._tensors is None:
             raise CheckpointError("no tensors to restore")
         target = shardings if shardings is not None else self._shardings
-        if target is not None and not isinstance(target, (list, tuple)):
-            # None is a valid per-leaf value ("default placement"); tree_leaves
-            # would silently drop it and misalign everything after.
-            target = jax.tree_util.tree_leaves(target, is_leaf=lambda x: x is None)
-            if len(target) != len(self._tensors):
-                raise CheckpointError(
-                    f"shardings pytree flattens to {len(target)} leaves, "
-                    f"payload has {len(self._tensors)} tensors — structures differ"
-                )
+        # A list/tuple of only Sharding/None whose length matches the tensor list
+        # is the flat per-tensor form; anything else is treated as a mirrored
+        # pytree. (A top-level-list tree of matching length is inherently
+        # ambiguous — the flat interpretation wins; pass a dict-rooted pytree to
+        # force pytree alignment.)
+        is_flat_seq = (
+            isinstance(target, (list, tuple))
+            and len(target) == len(self._tensors)
+            and all(s is None or isinstance(s, jax.sharding.Sharding) for s in target)
+        )
+        if target is not None and not is_flat_seq:
+            target = self._align_shardings_pytree(target)
         out = []
         for i, t in enumerate(self._tensors):
             s = target[i] if target is not None and i < len(target) else None

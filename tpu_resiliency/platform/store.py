@@ -271,7 +271,14 @@ class KVServer:
             except OSError as e:
                 import errno
 
-                if e.errno in (errno.EMFILE, errno.ENFILE) and self._reserve_fd is not None:
+                if e.errno in (errno.EMFILE, errno.ENFILE):
+                    if self._reserve_fd is None:
+                        # A previous shed lost the race to reopen the reserve;
+                        # keep trying so shedding never stays disabled for life.
+                        try:
+                            self._reserve_fd = os.open(os.devnull, os.O_RDONLY)
+                        except OSError:
+                            return
                     # Shed the pending connection via the reserve fd so the
                     # selector doesn't busy-spin on the still-readable listener.
                     os.close(self._reserve_fd)
