@@ -266,3 +266,75 @@ class TestMultiRank:
         # Survivors 0 and 2 are both active in iteration 1 (spare promoted).
         assert results[0][0] == "ok" and results[2][0] == "ok"
         assert results[0][3] == 2 and results[2][3] == 2
+
+
+class TestStandDown:
+    def test_proxy_completed_straggler_stands_down(self):
+        """A rank that was proxy-completed out of a finishing round (declared dead
+        while starved, but actually alive) must stand down cleanly when it discovers
+        the job finished without it — clean None return and exit 0, not a crash on
+        the dead coordinator (wrap.py job_done pre-check + server_linger)."""
+        from tpu_resiliency.platform.store import CoordStore
+
+        port = free_port()
+        world = 2
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+
+        def child(rank):
+            os.environ.update(
+                RANK=str(rank),
+                WORLD_SIZE=str(world),
+                TPU_RESILIENCY_STORE_PORT=str(port),
+                TPU_RESILIENCY_STORE_HOST="127.0.0.1",
+            )
+
+            @fast_wrapper(server_linger=10.0)
+            def train():
+                if rank == 0:
+                    time.sleep(0.3)
+                    return "ok"
+                # The straggler: sleeps through the whole completion round, then
+                # faults into the restart path.
+                time.sleep(4.0)
+                raise RuntimeError("late fault on the straggler")
+
+            q.put((rank, train()))
+            if rank == 0:
+                # Keep the process (and with it the lingering server) alive for the
+                # straggler's full rescue window: >= server_linger, so the job_done
+                # check cannot race the server's death under CI load.
+                time.sleep(12.0)
+
+        procs = [ctx.Process(target=child, args=(r,)) for r in range(world)]
+        for p in procs:
+            p.start()
+
+        # Simulate the straggler's watcher declaring it dead: proxy rank 1 into the
+        # iteration-0 completion barrier so rank 0 finishes the job without it.
+        time.sleep(1.5)
+        mon = CoordStore("127.0.0.1", port, prefix="inprocess/")
+        mon.barrier_join(
+            "barrier/completion/0", 1, world, timeout=0.0, wait=False, on_behalf=True
+        )
+        mon.close()
+
+        results = {}
+        deadline = time.monotonic() + 90
+        while len(results) < world and time.monotonic() < deadline:
+            try:
+                r, payload = q.get(timeout=1.0)
+                results[r] = payload
+            except Exception:
+                if all(not p.is_alive() for p in procs) and q.empty():
+                    break
+        for p in procs:
+            p.join(30.0)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(5.0)
+
+        assert results.get(0) == "ok", results
+        assert 1 in results and results[1] is None, results  # stood down cleanly
+        assert [p.exitcode for p in procs] == [0, 0]

@@ -201,10 +201,10 @@ class PyTreeStateDict:
         )
         if len(sh_leaves) != len(tree_leaves) or sh_def != tree_def:
             raise CheckpointError(
-                f"shardings pytree does not mirror the saved tree "
-                f"({len(sh_leaves)} vs {len(tree_leaves)} leaves) — pass a pytree "
+                f"shardings pytree does not mirror the saved tree — pass a pytree "
                 f"with a Sharding/None at each saved-tree leaf, or a flat "
-                f"per-tensor sequence"
+                f"per-tensor sequence.\n  shardings: {len(sh_leaves)} leaves, "
+                f"{sh_def}\n  saved tree: {len(tree_leaves)} leaves, {tree_def}"
             )
         out: list = [None] * len(self._tensors)
         cursor = 0  # full-tree case: arrays appear in tree order == pop order
@@ -231,15 +231,16 @@ class PyTreeStateDict:
         if self._tensors is None:
             raise CheckpointError("no tensors to restore")
         target = shardings if shardings is not None else self._shardings
-        # A list/tuple of only Sharding/None whose length matches the tensor list
-        # is the flat per-tensor form; anything else is treated as a mirrored
-        # pytree. (A top-level-list tree of matching length is inherently
-        # ambiguous — the flat interpretation wins; pass a dict-rooted pytree to
-        # force pytree alignment.)
-        is_flat_seq = (
-            isinstance(target, (list, tuple))
-            and len(target) == len(self._tensors)
-            and all(s is None or isinstance(s, jax.sharding.Sharding) for s in target)
+        # A list/tuple containing only placement-like entries (Sharding, Device,
+        # None) is the flat per-tensor form — any length (shorter lists pad with
+        # default placement, the long-standing behavior of the `i < len(target)`
+        # guard below). Anything else is treated as a mirrored pytree. A
+        # top-level-list saved tree whose shardings are all placement-like is
+        # inherently ambiguous — the flat interpretation wins; pass a dict-rooted
+        # pytree to force pytree alignment.
+        is_flat_seq = isinstance(target, (list, tuple)) and all(
+            s is None or isinstance(s, (jax.sharding.Sharding, jax.Device))
+            for s in target
         )
         if target is not None and not is_flat_seq:
             target = self._align_shardings_pytree(target)

@@ -545,6 +545,17 @@ class CallWrapper:
                 # rank's barriers were already proxy-joined, so a waiting join here
                 # would overflow rather than surface the real condition.
                 try:
+                    # Job already completed without us? (We were proxy-completed out
+                    # of a finishing round after being starved.) Checking BEFORE the
+                    # barrier join is what makes the server_linger rescue work: a
+                    # straggler that parks on the next round's barrier would only be
+                    # kicked out at teardown, when the job_done probe can no longer
+                    # answer.
+                    if coord.job_done():
+                        self._stand_down(
+                            monitor, iteration, "job completed while this rank restarted"
+                        )
+                        return None
                     if state.initial_rank in coord.terminated_ranks():
                         raise RestartAbort(
                             f"rank {state.initial_rank} was declared terminated by peers"
