@@ -1,0 +1,217 @@
+"""Recovery-latency benchmark: in-process restart vs in-job respawn, measured.
+
+The reference claims the benefit qualitatively — in-process restart "removes
+scheduler job launch, container start, interpreter init, dependency load, CUDA
+context creation from the recovery path" (``docs/source/inprocess/index.rst:13-22``)
+— but publishes no numbers (BASELINE.md). This harness measures both restart layers
+of THIS framework on the same machine:
+
+- **In-process engine latency** (world 2, forked ranks): a rank's fn raises; the
+  latency is fault → fn re-entry on the SAME process, covering quiesce, abort,
+  finalize, health check, iteration barrier, and rank reassignment — everything the
+  engine adds on top of the user's own re-init. Measured on the faulting rank and
+  on the healthy peer (whose figure adds cross-rank fault propagation).
+- **In-job respawn latency** (tpu-ft-launcher, 2 workers): a worker exits nonzero;
+  the latency is worker exit → re-spawned worker's ``main()`` entry, covering agent
+  detection, the rendezvous round, process spawn, and interpreter+import startup.
+
+Usage::
+
+    python scripts/bench_restart.py [--restarts N] [--out FILE]
+
+Prints one JSON line per layer and writes ``BENCH_restart.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------- in-process --
+
+
+def _inproc_rank(rank: int, port: int, n_restarts: int, q) -> None:
+    os.environ.update(
+        RANK=str(rank),
+        WORLD_SIZE="2",
+        TPU_RESILIENCY_STORE_PORT=str(port),
+        TPU_RESILIENCY_STORE_HOST="127.0.0.1",
+    )
+    from tpu_resiliency.inprocess.wrap import CallWrapper, Wrapper
+
+    fault_times: list[float] = []
+    entry_times: list[float] = []
+
+    @Wrapper(
+        monitor_interval=0.05,
+        last_call_wait=0.1,
+        soft_timeout=30.0,
+        hard_timeout=60.0,
+        heartbeat_interval=0.2,
+        heartbeat_timeout=20.0,
+        barrier_timeout=60.0,
+        completion_timeout=60.0,
+    )
+    def train(call: CallWrapper):
+        entry_times.append(time.monotonic())
+        if call.iteration < n_restarts:
+            if rank == 0:
+                time.sleep(0.05)  # let the peer enter its fn before the fault
+                fault_times.append(time.monotonic())
+                raise RuntimeError(f"bench fault {call.iteration}")
+            # Healthy peer: park until the engine interrupts us.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                time.sleep(0.01)
+            return "peer-timeout"
+        return "done"
+
+    result = train()
+    q.put((rank, result, fault_times, entry_times))
+
+
+def bench_inprocess(n_restarts: int) -> dict:
+    port = free_port()
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_inproc_rank, args=(r, port, n_restarts, q))
+        for r in range(2)
+    ]
+    t0 = time.monotonic()
+    for p in procs:
+        p.start()
+    out = {}
+    deadline = time.monotonic() + 120
+    while len(out) < 2 and time.monotonic() < deadline:
+        try:
+            rank, result, faults, entries = q.get(timeout=1.0)
+            out[rank] = (result, faults, entries)
+        except Exception:
+            pass
+    for p in procs:
+        p.join(20.0)
+        if p.is_alive():
+            p.terminate()
+    assert out[0][0] == "done" and out[1][0] == "done", out
+
+    _, faults, entries0 = out[0]
+    _, _, entries1 = out[1]
+    # Faulting rank: fault i happens in iteration i; re-entry is entries[i+1].
+    own = [entries0[i + 1] - faults[i] for i in range(n_restarts)]
+    # Healthy peer: its re-entry i+1 measured from the same fault instant.
+    peer = [entries1[i + 1] - faults[i] for i in range(n_restarts)]
+    return {
+        "restarts": n_restarts,
+        "faulting_rank_ms": {
+            "median": sorted(own)[len(own) // 2] * 1e3,
+            "min": min(own) * 1e3,
+            "max": max(own) * 1e3,
+        },
+        "healthy_peer_ms": {
+            "median": sorted(peer)[len(peer) // 2] * 1e3,
+            "min": min(peer) * 1e3,
+            "max": max(peer) * 1e3,
+        },
+        "startup_to_first_entry_s": entries0[0] - t0,
+    }
+
+
+# ------------------------------------------------------------------- in-job --
+
+WORKER = """
+import os, sys, time
+stamp_dir = sys.argv[1]
+count = int(os.environ.get("TPU_FT_RESTART_COUNT", "0"))
+with open(os.path.join(stamp_dir, f"entry_{count}_{os.environ['RANK']}"), "w") as f:
+    f.write(repr(time.monotonic()))
+if count == 0 and os.environ["RANK"] == "0":
+    with open(os.path.join(stamp_dir, "exit_0"), "w") as f:
+        f.write(repr(time.monotonic()))
+    sys.exit(1)
+time.sleep(0.5)
+"""
+
+
+def bench_injob() -> dict:
+    with tempfile.TemporaryDirectory() as td:
+        worker = os.path.join(td, "worker.py")
+        with open(worker, "w") as f:
+            f.write(WORKER)
+        stamps = os.path.join(td, "stamps")
+        os.makedirs(stamps)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_resiliency.launcher.launch",
+                "--nproc-per-node", "2", "--max-restarts", "2",
+                "--monitor-interval", "0.1",
+                worker, stamps,
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=180,
+            cwd=td,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
+        def read(name):
+            with open(os.path.join(stamps, name)) as f:
+                return float(f.read())
+
+        t_exit = read("exit_0")
+        t_reentry = read("entry_1_0")
+        return {"respawn_ms": (t_reentry - t_exit) * 1e3}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--restarts", type=int, default=5)
+    ap.add_argument("--out", default=os.path.join(REPO_ROOT, "BENCH_restart.json"))
+    args = ap.parse_args()
+
+    inproc = bench_inprocess(args.restarts)
+    print(json.dumps({"layer": "in-process", **inproc}))
+    injob = bench_injob()
+    print(json.dumps({"layer": "in-job", **injob}))
+
+    speedup = injob["respawn_ms"] / inproc["faulting_rank_ms"]["median"]
+    summary = {
+        "in_process": inproc,
+        "in_job": injob,
+        "speedup_in_process_vs_in_job": speedup,
+    }
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps({
+        "metric": "recovery latency: in-process engine (median, faulting rank) vs in-job respawn",
+        "in_process_ms": round(inproc["faulting_rank_ms"]["median"], 1),
+        "in_job_ms": round(injob["respawn_ms"], 1),
+        "speedup": round(speedup, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

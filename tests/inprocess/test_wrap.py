@@ -44,8 +44,12 @@ def fast_wrapper(**kw):
     return Wrapper(**defaults)
 
 
-def run_world(world, body, timeout=90.0, expect_exit=None):
-    """Fork `world` children; each runs body(rank, result_q). Returns rank→result."""
+def run_world(world, body, timeout=90.0, expect_exit=None, after_start=None):
+    """Fork `world` children; each runs body(rank, result_q). Returns rank→result.
+
+    ``after_start(port)`` runs in the parent once all children are forked — for
+    tests that inject store state mid-run (e.g. simulating a monitor's proxy
+    joins)."""
     port = free_port()
     ctx = mp.get_context("fork")
     q = ctx.Queue()
@@ -61,6 +65,8 @@ def run_world(world, body, timeout=90.0, expect_exit=None):
         p = ctx.Process(target=child, daemon=False)
         p.start()
         procs.append(p)
+    if after_start is not None:
+        after_start(port)
     results = {}
     deadline = time.monotonic() + timeout
     try:
@@ -276,19 +282,7 @@ class TestStandDown:
         the dead coordinator (wrap.py job_done pre-check + server_linger)."""
         from tpu_resiliency.platform.store import CoordStore
 
-        port = free_port()
-        world = 2
-        ctx = mp.get_context("fork")
-        q = ctx.Queue()
-
-        def child(rank):
-            os.environ.update(
-                RANK=str(rank),
-                WORLD_SIZE=str(world),
-                TPU_RESILIENCY_STORE_PORT=str(port),
-                TPU_RESILIENCY_STORE_HOST="127.0.0.1",
-            )
-
+        def body(rank, q):
             @fast_wrapper(server_linger=10.0)
             def train():
                 if rank == 0:
@@ -306,35 +300,17 @@ class TestStandDown:
                 # check cannot race the server's death under CI load.
                 time.sleep(12.0)
 
-        procs = [ctx.Process(target=child, args=(r,)) for r in range(world)]
-        for p in procs:
-            p.start()
+        def proxy_straggler(port):
+            # Simulate the straggler's watcher declaring it dead: proxy rank 1 into
+            # the iteration-0 completion barrier so rank 0 finishes without it.
+            time.sleep(1.5)
+            mon = CoordStore("127.0.0.1", port, prefix="inprocess/")
+            mon.barrier_join(
+                "barrier/completion/0", 1, 2, timeout=0.0, wait=False, on_behalf=True
+            )
+            mon.close()
 
-        # Simulate the straggler's watcher declaring it dead: proxy rank 1 into the
-        # iteration-0 completion barrier so rank 0 finishes the job without it.
-        time.sleep(1.5)
-        mon = CoordStore("127.0.0.1", port, prefix="inprocess/")
-        mon.barrier_join(
-            "barrier/completion/0", 1, world, timeout=0.0, wait=False, on_behalf=True
-        )
-        mon.close()
-
-        results = {}
-        deadline = time.monotonic() + 90
-        while len(results) < world and time.monotonic() < deadline:
-            try:
-                r, payload = q.get(timeout=1.0)
-                results[r] = payload
-            except Exception:
-                if all(not p.is_alive() for p in procs) and q.empty():
-                    break
-        for p in procs:
-            p.join(30.0)
-        for p in procs:
-            if p.is_alive():
-                p.terminate()
-                p.join(5.0)
-
+        results, codes = run_world(2, body, timeout=90.0, after_start=proxy_straggler)
         assert results.get(0) == "ok", results
         assert 1 in results and results[1] is None, results  # stood down cleanly
-        assert [p.exitcode for p in procs] == [0, 0]
+        assert codes == [0, 0]

@@ -1,0 +1,33 @@
+"""Recovery-latency harness sanity (scripts/bench_restart.py): both restart layers
+measure, and the in-process engine beats a full process respawn."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_restart_latency_harness(tmp_path):
+    out = tmp_path / "BENCH_restart.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "bench_restart.py"),
+            "--restarts", "2",
+            "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(out.read_text())
+    inproc = summary["in_process"]["faulting_rank_ms"]["median"]
+    injob = summary["in_job"]["respawn_ms"]
+    assert 0 < inproc, summary
+    assert 0 < injob, summary
+    # The entire point of the in-process layer: recovery without interpreter,
+    # import, and rendezvous startup. Generous margin for loaded CI.
+    assert inproc < injob, summary

@@ -231,19 +231,29 @@ class PyTreeStateDict:
         if self._tensors is None:
             raise CheckpointError("no tensors to restore")
         target = shardings if shardings is not None else self._shardings
-        # A list/tuple containing only placement-like entries (Sharding, Device,
-        # None) is the flat per-tensor form — any length (shorter lists pad with
-        # default placement, the long-standing behavior of the `i < len(target)`
-        # guard below). Anything else is treated as a mirrored pytree. A
-        # top-level-list saved tree whose shardings are all placement-like is
-        # inherently ambiguous — the flat interpretation wins; pass a dict-rooted
-        # pytree to force pytree alignment.
-        is_flat_seq = isinstance(target, (list, tuple)) and all(
-            s is None or isinstance(s, (jax.sharding.Sharding, jax.Device))
-            for s in target
-        )
-        if target is not None and not is_flat_seq:
+        # Interpretation order for a list/tuple of placement-like entries
+        # (Sharding, Device, None):
+        #   1. length == popped-tensor count → the flat per-tensor form (exact);
+        #   2. otherwise, a pytree mirroring a list-rooted saved tree → aligned
+        #      structurally (handles non-array leaves interleaved with tensors);
+        #   3. otherwise, the legacy flat form with prefix semantics (shorter
+        #      lists pad the tail with default placement — the long-standing
+        #      behavior of the `i < len(target)` guard below).
+        # Any container with non-placement entries is always a mirrored pytree.
+        if target is not None and not isinstance(target, (list, tuple)):
             target = self._align_shardings_pytree(target)
+        elif target is not None:
+            all_placement = all(
+                s is None or isinstance(s, (jax.sharding.Sharding, jax.Device))
+                for s in target
+            )
+            if not (all_placement and len(target) == len(self._tensors)):
+                try:
+                    target = self._align_shardings_pytree(target)
+                except CheckpointError:
+                    if not all_placement:
+                        raise
+                    # legacy flat prefix form; the guard below pads the tail
         out = []
         for i, t in enumerate(self._tensors):
             s = target[i] if target is not None and i < len(target) else None
