@@ -92,7 +92,7 @@ def _program_ms(profiler, substring):
     return None
 
 
-def device_scoring(data, counts, use_pallas):
+def device_scoring(data, counts, variant="xla"):
     """Measure one scoring round's TRUE device time via the framework's own
     XLA-profiler capture (``telemetry/device_profiler.py``).
 
@@ -106,11 +106,13 @@ def device_scoring(data, counts, use_pallas):
     from tpu_resiliency.telemetry import scoring
     from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
 
-    if use_pallas:
+    if variant in ("pallas", "pallas-pairwise"):
         from tpu_resiliency.ops.scoring_pallas import fused_median_weights
 
+        mode = "loop" if variant == "pallas" else "pairwise"
+
         def score_program(d, c, e, h):
-            mw = fused_median_weights(d, c)
+            mw = fused_median_weights(d, c, mode=mode)
             return scoring.score_round(d, c, e, h, medians_and_weights=mw)
 
     else:
@@ -266,7 +268,7 @@ def run_variant_inprocess(variant: str) -> dict:
             "per_score": per_score,
             "f1": f1(mask, truth),
         }
-    per_step, out = device_scoring(data, counts, use_pallas=(variant == "pallas"))
+    per_step, out = device_scoring(data, counts, variant=variant)
     mask = np.asarray(out.straggler)
     return {"per_step": per_step, "f1": f1(mask, truth)}
 
@@ -322,7 +324,7 @@ def main():
     backend_tag = "" if on_tpu else f" [backend={jax.default_backend()}]"
 
     results = {}
-    for name in ["xla"] + (["pallas"] if on_tpu else []):
+    for name in ["xla"] + (["pallas", "pallas-pairwise"] if on_tpu else []):
         res = run_variant_subprocess(name)
         if res is not None:
             results[name] = (res["per_step"], res["f1"])

@@ -152,3 +152,26 @@ def test_pallas_kernel_with_duplicates():
     )
     np.testing.assert_allclose(np.asarray(med), 3.0)
     np.testing.assert_allclose(np.asarray(wt), 24.0)
+
+
+def test_pallas_pairwise_mode_matches_loop_mode():
+    """The all-pairs formulation is the same function as the rank-counting loop —
+    including empty windows, single samples, and ties."""
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(9)
+    r, s, w = 16, 8, 16
+    data, counts = _mk_windows(rng, r, s, w)
+    counts[0, 0] = 5
+    counts[2, 3] = 0
+    counts[5, 1] = 1
+    data[7, 2, :] = 1.5  # ties across the whole window
+
+    loop = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="loop"
+    )
+    pair = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="pairwise"
+    )
+    np.testing.assert_allclose(np.asarray(loop[0]), np.asarray(pair[0]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(loop[1]), np.asarray(pair[1]), rtol=1e-6)

@@ -62,7 +62,12 @@ def _median_weights_kernel(data_ref, counts_ref, med_ref, weight_ref):
         return rank + less + eq_before
 
     rank = jax.lax.fori_loop(0, w, body, rank)
+    _write_median_and_weight(data, counts, valid, rank, med_ref, weight_ref)
 
+
+def _write_median_and_weight(data, counts, valid, rank, med_ref, weight_ref):
+    """Shared selection tail: median = mean of the (n-1)//2-th and n//2-th order
+    statistics picked by rank equality; weight = masked total."""
     n = jnp.maximum(counts, 1)
     lo_idx = ((n - 1) // 2)[:, :, None]
     hi_idx = (n // 2)[:, :, None]
@@ -74,27 +79,64 @@ def _median_weights_kernel(data_ref, counts_ref, med_ref, weight_ref):
     weight_ref[:] = jnp.sum(x_finite, axis=2)
 
 
-def pallas_supported(n_ranks: int, rank_tile: int = 32) -> bool:
+def _median_weights_pairwise_kernel(data_ref, counts_ref, med_ref, weight_ref):
+    """All-pairs variant: one [RT, S, W, W] comparison block instead of W
+    sequential VPU passes — more VMEM (quadratic temporaries, so it runs at a
+    smaller rank tile) but no serial loop. Which formulation wins is measured, not
+    assumed: bench.py times both as separate variants on the real chip."""
+    data = data_ref[:]  # [RT, S, W] f32
+    counts = counts_ref[:]  # [RT, S] i32
+    rt, s, w = data.shape
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (rt, s, w), dimension=2)
+    valid = pos < counts[:, :, None]
+    x = jnp.where(valid, data, jnp.inf)
+
+    xi = x[:, :, :, None]  # the element whose rank we compute
+    xj = x[:, :, None, :]  # everything it is compared against
+    pi = pos[:, :, :, None]
+    pj = pos[:, :, None, :]
+    rank = jnp.sum(
+        (xj < xi).astype(jnp.int32) + ((xj == xi) & (pj < pi)).astype(jnp.int32),
+        axis=3,
+    )
+    _write_median_and_weight(data, counts, valid, rank, med_ref, weight_ref)
+
+
+def pallas_supported(n_ranks: int, rank_tile: int | None = None, mode: str = "loop") -> bool:
     """Shape gate for auto-selection: the kernel tiles the rank axis, so the
-    per-shard rank count must be a whole number of tiles (or fit in one)."""
+    per-shard rank count must be a whole number of tiles (or fit in one). Pass the
+    same ``mode`` (and ``rank_tile``, if overridden) that will be given to
+    :func:`fused_median_weights` — the modes default to different tiles."""
+    if rank_tile is None:
+        rank_tile = 32 if mode == "loop" else 8
     tile = min(rank_tile, n_ranks)
     return tile > 0 and n_ranks % tile == 0
 
 
-@functools.partial(jax.jit, static_argnames=("rank_tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("rank_tile", "interpret", "mode"))
 def fused_median_weights(
     data: jax.Array,
     counts: jax.Array,
     *,
-    rank_tile: int = 32,
+    rank_tile: int | None = None,
     interpret: bool | None = None,
+    mode: str = "loop",
 ) -> tuple[jax.Array, jax.Array]:
     """``(medians [R,S], weights [R,S])`` from windows ``data [R,S,W]``, ``counts [R,S]``.
 
     Tiled over the rank axis; each grid step holds a ``[rank_tile, S, W]`` block in
-    VMEM. ``interpret`` defaults to True off-TPU so tests run on CPU.
+    VMEM. ``interpret`` defaults to True off-TPU so tests run on CPU. ``mode``:
+    ``"loop"`` (W sequential rank-counting passes, rank_tile 32) or ``"pairwise"``
+    (one [RT, S, W, W] comparison block, rank_tile 8 for the quadratic VMEM
+    temporaries).
     """
     r, s, w = data.shape
+    if mode not in ("loop", "pairwise"):
+        raise ValueError(f"unknown mode {mode!r}")
+    kernel = _median_weights_kernel if mode == "loop" else _median_weights_pairwise_kernel
+    if rank_tile is None:
+        rank_tile = 32 if mode == "loop" else 8
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     rank_tile = min(rank_tile, r)
@@ -103,7 +145,7 @@ def fused_median_weights(
 
     grid = (r // rank_tile,)
     return pl.pallas_call(
-        _median_weights_kernel,
+        kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((rank_tile, s, w), lambda i: (i, 0, 0)),
