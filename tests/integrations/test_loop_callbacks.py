@@ -101,7 +101,7 @@ def monitor(tmp_path):
         rank_heartbeat_timeout=30.0,
         workload_check_interval=0.5,
     )
-    proc = RankMonitorServer.run_in_subprocess(cfg, sock)
+    proc = RankMonitorServer.run_in_subprocess(cfg, sock, start_method="spawn")
     old = os.environ.get(ipc.MONITOR_SOCKET_ENV)
     os.environ[ipc.MONITOR_SOCKET_ENV] = sock
     yield sock

@@ -25,7 +25,7 @@ def monitor(tmp_uds_path):
         rank_heartbeat_timeout=None,
         workload_check_interval=0.2,
     )
-    proc = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+    proc = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path, start_method="spawn")
     yield tmp_uds_path, cfg
     proc.terminate()
     proc.join(5.0)
@@ -104,7 +104,7 @@ def test_hang_detection_kills_rank(tmp_uds_path):
     """The reference heartbeat-path contract (SURVEY §3.2): monitor detects the missed
     heartbeat and terminates the rank with the configured signal."""
     cfg = FaultToleranceConfig(workload_check_interval=0.2, rank_termination_signal=signal.SIGTERM)
-    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path, start_method="spawn")
     ctx = mp.get_context("fork")
     ready_q = ctx.Queue()
     victim = ctx.Process(target=_hang_victim, args=(tmp_uds_path, ready_q))
@@ -126,7 +126,7 @@ def test_section_timeout_detection(tmp_uds_path):
         workload_check_interval=0.1,
         rank_termination_signal=signal.SIGTERM,
     )
-    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path)
+    mon = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path, start_method="spawn")
 
     def victim_main(path):
         c = RankMonitorClient()

@@ -257,8 +257,19 @@ class StoreRendezvous:
                     if self._cas(cur, nxt):
                         log.info(f"[{me}] actives all dead; reopened round {cur['round'] + 1}")
                     continue
-                time.sleep(self.s.poll_interval)
-                continue
+                # Registered and the job is healthy: we are standby redundancy for
+                # this closed round — report as a spare now rather than blocking
+                # until some future round (the reference's redundancy nodes join
+                # a completed rendezvous without re-triggering it,
+                # ``_ft_rendezvous.py:827-831``). The agent's spare loop handles
+                # promotion, job completion, and dead-active detection from here.
+                return RendezvousOutcome(
+                    round=cur["round"],
+                    node_rank=None,
+                    active=list(cur["active"]),
+                    spares=list(cur["spares"]),
+                    epoch=cur.get("epoch", 0),
+                )
             # Case 3: an open round.
             parts = cur["participants"]
             if me not in parts:
@@ -275,11 +286,17 @@ class StoreRendezvous:
                     min_reached_at = time.monotonic()
                 order = sorted(live_parts, key=live_parts.get)
                 i_am_leader = order[0] == me
-                # Always hold the last-call window once min is reached — even at
-                # full strength — so surplus joiners land as spares instead of
-                # missing the round (the reference's redundancy nodes join in the
-                # same completion window, ``_ft_rendezvous.py:302-338``).
-                last_call_over = time.monotonic() - min_reached_at >= self.s.last_call_timeout
+                # Close immediately at full strength — exactly the reference's
+                # behavior (``_ft_rendezvous.py:830-831`` completes the round the
+                # moment ``max_nodes`` is reached; its last-call deadline applies
+                # only between min and max). Surplus nodes that registered before
+                # the close still land as spares (``order[max_nodes:]``); later
+                # ones advertise for the next round. This takes the last-call hold
+                # off the restart critical path for fixed-size jobs.
+                full = len(live_parts) >= self.s.max_nodes
+                last_call_over = full or (
+                    time.monotonic() - min_reached_at >= self.s.last_call_timeout
+                )
                 if i_am_leader and last_call_over:
                     active = order[: self.s.max_nodes]
                     spares = order[self.s.max_nodes :]

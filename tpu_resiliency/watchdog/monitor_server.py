@@ -143,7 +143,10 @@ class RankMonitorServer:
             target=_monitor_main, args=(cfg, socket_path, health_checks), daemon=True
         )
         proc.start()
-        deadline = time.monotonic() + 30.0
+        # Generous: spawn-started monitors (used by tests to avoid forking a
+        # JAX-threaded parent) pay full interpreter startup, which on TPU images
+        # can be several seconds even unloaded.
+        deadline = time.monotonic() + 60.0
         while not os.path.exists(socket_path):
             if time.monotonic() > deadline or not proc.is_alive():
                 raise RuntimeError(f"rank monitor failed to start on {socket_path}")
