@@ -65,13 +65,18 @@ def make_stacked_pipeline(mesh, layer_fn: Callable, n_micro: int, axis_name: str
         return c
 
     def apply(layers, carries, consts):
-        # Everything crossing the auto/manual boundary travels in f32: the
-        # replicated-over-pp inputs transpose to a psum in the backward pass, and
-        # XLA's CPU AllReducePromotion pass miscompiles the bf16 all-reduce /
-        # reduce-scatter that boundary would otherwise emit ("Invalid binary
-        # instruction opcode copy"). Compute inside the body stays in the carries'
-        # own dtypes.
+        # On CPU only, everything crossing the auto/manual boundary travels in
+        # f32: the replicated-over-pp inputs transpose to a psum in the backward
+        # pass, and XLA's CPU AllReducePromotion pass miscompiles the bf16
+        # all-reduce / reduce-scatter that boundary would otherwise emit
+        # ("Invalid binary instruction opcode copy"). On TPU the bug does not
+        # apply and the cast would double boundary transfer and memory for bf16
+        # activations, so the carries keep their own dtypes there.
+        f32_boundary = jax.default_backend() != "tpu"
         dtypes = jax.tree.map(lambda a: a.dtype, carries)
+
+        def _to_boundary(a):
+            return a.astype(jnp.float32) if f32_boundary else a
 
         def body(layers_local, carries32, consts):
             carries_local = jax.tree.map(
@@ -113,10 +118,10 @@ def make_stacked_pipeline(mesh, layer_fn: Callable, n_micro: int, axis_name: str
             (_, out), _ = lax.scan(
                 tick, (state, out), jnp.arange(n_micro + n_stages - 1)
             )
-            # Replicate the last stage's buffer to every stage, f32 at the
-            # boundary (see above).
+            # Replicate the last stage's buffer to every stage (boundary dtype
+            # per _to_boundary above).
             return jax.tree.map(
-                lambda o: lax.all_gather(o.astype(jnp.float32), axis_name, axis=0)[
+                lambda o: lax.all_gather(_to_boundary(o), axis_name, axis=0)[
                     n_stages - 1
                 ],
                 out,
@@ -130,10 +135,8 @@ def make_stacked_pipeline(mesh, layer_fn: Callable, n_micro: int, axis_name: str
             axis_names={axis_name},
             check_vma=False,
         )
-        out32 = sharded(
-            layers, jax.tree.map(lambda a: a.astype(jnp.float32), carries), consts
-        )
-        return jax.tree.map(lambda o, dt: o.astype(dt), out32, dtypes)
+        out_b = sharded(layers, jax.tree.map(_to_boundary, carries), consts)
+        return jax.tree.map(lambda o, dt: o.astype(dt), out_b, dtypes)
 
     return apply
 

@@ -35,6 +35,7 @@ collectives instead (see ``telemetry``).
 from __future__ import annotations
 
 import dataclasses
+import errno
 import hashlib
 import hmac
 import os
@@ -158,7 +159,20 @@ class KVServer:
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        # A fixed port may still be held by a previous job's lingering server
+        # (wrap.py server_linger keeps it listening briefly after completion) or
+        # by a close() whose loop thread has not yet released the fd —
+        # SO_REUSEADDR does not allow a second live listener. Retry briefly so
+        # back-to-back jobs on one host don't die on EADDRINUSE.
+        deadline = time.monotonic() + (8.0 if port != 0 else 0.0)
+        while True:
+            try:
+                self._sock.bind((host, port))
+                break
+            except OSError as e:
+                if e.errno != errno.EADDRINUSE or time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
         self._sock.listen(1024)
         self._sock.setblocking(False)
         self.port = self._sock.getsockname()[1]
@@ -194,12 +208,17 @@ class KVServer:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def close(self) -> None:
+    def close(self, join: bool = True, timeout: float = 5.0) -> None:
+        """Signal the loop thread to tear down. With ``join`` (default) block
+        until the listening socket is actually released, so a successor server
+        can bind the same fixed port immediately."""
         self._shutdown.set()
         try:
             self._wake_w.send(b"x")
         except OSError:
             pass
+        if join and threading.current_thread() is not self._loop_thread:
+            self._loop_thread.join(timeout)
 
     # -- event loop --------------------------------------------------------
 
@@ -245,6 +264,12 @@ class KVServer:
                 try:  # best-effort: tell blocked clients rather than hang them
                     conn.sock.setblocking(True)
                     conn.sock.settimeout(1.0)
+                    # Drain any buffered response bytes first: writing the
+                    # shutdown frame past an undrained wbuf would interleave
+                    # frames and corrupt the client's stream.
+                    if conn.wbuf:
+                        conn.sock.sendall(conn.wbuf)
+                        conn.wbuf.clear()
                     framing.send_obj(conn.sock, shutdown_resp)
                 except OSError:
                     pass
