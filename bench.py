@@ -223,18 +223,29 @@ def device_ring_scoring(data, counts, report_interval=100):
 REPORT_INTERVAL = 100
 
 
-def probe_backend_alive(timeout: float = 180.0) -> bool:
+def probe_backend_alive(timeout: float | None = None, attempts: int | None = None) -> bool:
     """Can this environment's default JAX backend actually run an op? Probed in a
     THROWAWAY subprocess with a hard timeout: a wedged remote-dispatch tunnel
     hangs `import jax`-adjacent calls forever, and the parent must stay usable to
-    fall back to CPU and still emit a result line."""
-    for attempt in range(2):
+    fall back to CPU and still emit a result line.
+
+    Retries with growing backoff before giving up: single-tenant tunnels release
+    their slot with a lag after the previous client exits, and a transiently
+    wedged proxy often recovers within a minute. Round 3 fell back to CPU after
+    one 15 s retry and the official bench artifact became a CPU number — the
+    fallback must be a last resort, not the first response."""
+    if timeout is None:
+        timeout = float(os.environ.get("TPU_BENCH_PROBE_TIMEOUT", "240"))
+    if attempts is None:
+        attempts = int(os.environ.get("TPU_BENCH_PROBE_ATTEMPTS", "3"))
+    for attempt in range(attempts):
         try:
             r = subprocess.run(
                 [
                     sys.executable,
                     "-c",
-                    "import jax; jax.numpy.ones((2,)).block_until_ready(); print('ok')",
+                    "import jax; jax.numpy.ones((2,)).block_until_ready(); "
+                    "print('ok', jax.default_backend())",
                 ],
                 capture_output=True,
                 text=True,
@@ -242,12 +253,20 @@ def probe_backend_alive(timeout: float = 180.0) -> bool:
             )
             if r.returncode == 0 and "ok" in r.stdout:
                 return True
-        except Exception:
-            pass
-        if attempt == 0:
-            # Single-tenant tunnels release their slot with a lag after the
-            # previous client exits — give the second attempt a fresh chance.
-            time.sleep(15.0)
+            print(
+                f"backend probe attempt {attempt + 1}/{attempts} failed "
+                f"(rc={r.returncode}): {r.stderr[-500:]}",
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(
+                f"backend probe attempt {attempt + 1}/{attempts} failed: {e!r}",
+                file=sys.stderr,
+            )
+        if attempt < attempts - 1:
+            delay = 20.0 * (attempt + 1)
+            print(f"retrying backend probe in {delay:.0f} s", file=sys.stderr)
+            time.sleep(delay)
     return False
 
 
@@ -256,6 +275,8 @@ def run_variant_inprocess(variant: str) -> dict:
     variants can't contaminate each other's dispatch latency (observed: measuring
     the ring path after host-baseline + another compiled variant in one process
     inflates push dispatch ~30×; isolated processes reproduce 0.02-0.03 ms)."""
+    import jax
+
     data, counts, truth = make_telemetry()
     if variant == "rings":
         per_step, per_push, per_score, out = device_ring_scoring(
@@ -267,10 +288,14 @@ def run_variant_inprocess(variant: str) -> dict:
             "per_push": per_push,
             "per_score": per_score,
             "f1": f1(mask, truth),
+            # The backend the measurement ACTUALLY ran on: a child whose
+            # tunnel wedged mid-round can silently fall back to CPU while the
+            # parent still believes it probed a live TPU.
+            "backend": jax.default_backend(),
         }
     per_step, out = device_scoring(data, counts, variant=variant)
     mask = np.asarray(out.straggler)
-    return {"per_step": per_step, "f1": f1(mask, truth)}
+    return {"per_step": per_step, "f1": f1(mask, truth), "backend": jax.default_backend()}
 
 
 def run_variant_subprocess(variant: str) -> dict | None:
@@ -321,15 +346,24 @@ def main():
 
     print(f"jax backend: {jax.default_backend()}, devices: {jax.devices()}", file=sys.stderr)
     on_tpu = jax.default_backend() == "tpu"
-    backend_tag = "" if on_tpu else f" [backend={jax.default_backend()}]"
+    backend = jax.default_backend()
+    try:
+        device_kind = jax.devices()[0].device_kind
+    except Exception:
+        device_kind = "unknown"
+    backend_tag = "" if on_tpu else f" [backend={backend}]"
+
+    meas_backends: set = set()
 
     results = {}
     for name in ["xla"] + (["pallas", "pallas-pairwise"] if on_tpu else []):
         res = run_variant_subprocess(name)
         if res is not None:
             results[name] = (res["per_step"], res["f1"])
+            meas_backends.add(res.get("backend", backend))
             print(
-                f"device[{name}]: {res['per_step'] * 1e3:.4f} ms/step, F1={res['f1']:.3f}",
+                f"device[{name}]: {res['per_step'] * 1e3:.4f} ms/step, F1={res['f1']:.3f} "
+                f"[{res.get('backend', '?')}]",
                 file=sys.stderr,
             )
 
@@ -350,6 +384,7 @@ def main():
     if res is not None:
         per_step, per_push, per_score = res["per_step"], res["per_push"], res["per_score"]
         rings = (per_step, per_push, per_score, res["f1"])
+        meas_backends.add(res.get("backend", backend))
         print(
             f"device[rings, honest hot loop]: push {per_push * 1e3:.4f} ms/step + "
             f"score {per_score * 1e3:.3f} ms/report / {report_interval} steps "
@@ -360,16 +395,17 @@ def main():
     for name, (s, f) in results.items():
         print(f"score-only[{name}]: {s * 1e3:.4f} ms/report", file=sys.stderr)
     if rings is None and not results:
-        print(
-            json.dumps(
-                {
-                    "metric": "telemetry hot-loop cost (ALL VARIANTS FAILED; see stderr)",
-                    "value": None,
-                    "unit": "ms/step",
-                    "vs_baseline": 0,
-                }
-            )
-        )
+        line = {
+            "metric": "telemetry hot-loop cost (ALL VARIANTS FAILED; see stderr)",
+            "value": None,
+            "unit": "ms/step",
+            "vs_baseline": None,
+            "backend": backend,
+            "device_kind": device_kind,
+        }
+        if backend != "tpu":
+            line["backend_fallback"] = True
+        print(json.dumps(line))
         return
     if rings is None:
         # Fall back to the score-only fused number if the ring path broke. This is
@@ -403,16 +439,34 @@ def main():
         # off-device); compare amortized report cost against amortized honest cost.
         vs = (base_s / report_interval) / per_step
         unit = "ms/step"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value_s * 1e3, 4),
-                "unit": unit,
-                "vs_baseline": round(vs, 2),
-            }
+    # The backend that PRODUCED the reported numbers: a variant subprocess can
+    # silently fall back to CPU (wedged tunnel mid-round) while the parent's
+    # probe saw a live TPU — trust the measurements' own report over the
+    # parent's view.
+    if meas_backends:
+        effective_backend = (
+            meas_backends.pop() if len(meas_backends) == 1
+            else "mixed:" + ",".join(sorted(meas_backends))
         )
-    )
+    else:
+        effective_backend = backend
+    if effective_backend != backend:
+        device_kind = effective_backend  # parent's device_kind describes the wrong backend
+    line = {
+        "metric": metric,
+        "value": round(value_s * 1e3, 4),
+        "unit": unit,
+        "vs_baseline": round(vs, 2),
+        "backend": effective_backend,
+        "device_kind": device_kind,
+    }
+    if effective_backend != "tpu":
+        # The BASELINE.md baseline is a host-Python number measured to be beaten
+        # by a DEVICE program; a CPU-simulated device path "beating" it is not
+        # the product claim. Never let a fallback run masquerade as one.
+        line["backend_fallback"] = True
+        line["vs_baseline"] = None
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -65,14 +65,16 @@ def make_stacked_pipeline(mesh, layer_fn: Callable, n_micro: int, axis_name: str
         return c
 
     def apply(layers, carries, consts):
-        # On CPU only, everything crossing the auto/manual boundary travels in
-        # f32: the replicated-over-pp inputs transpose to a psum in the backward
-        # pass, and XLA's CPU AllReducePromotion pass miscompiles the bf16
-        # all-reduce / reduce-scatter that boundary would otherwise emit
-        # ("Invalid binary instruction opcode copy"). On TPU the bug does not
-        # apply and the cast would double boundary transfer and memory for bf16
-        # activations, so the carries keep their own dtypes there.
-        f32_boundary = jax.default_backend() != "tpu"
+        # On CPU meshes only, everything crossing the auto/manual boundary
+        # travels in f32: the replicated-over-pp inputs transpose to a psum in
+        # the backward pass, and XLA's CPU AllReducePromotion pass miscompiles
+        # the bf16 all-reduce / reduce-scatter that boundary would otherwise
+        # emit ("Invalid binary instruction opcode copy"). On TPU the bug does
+        # not apply and the cast would double boundary transfer and memory for
+        # bf16 activations, so the carries keep their own dtypes there. Gated
+        # on the platform of the mesh that executes this shard_map, not the
+        # process default backend — they differ in mixed-backend debugging.
+        f32_boundary = mesh.devices.flat[0].platform == "cpu"
         dtypes = jax.tree.map(lambda a: a.dtype, carries)
 
         def _to_boundary(a):

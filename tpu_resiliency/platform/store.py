@@ -294,8 +294,6 @@ class KVServer:
             except BlockingIOError:
                 return
             except OSError as e:
-                import errno
-
                 if e.errno in (errno.EMFILE, errno.ENFILE):
                     if self._reserve_fd is None:
                         # A previous shed lost the race to reopen the reserve;
