@@ -1,0 +1,212 @@
+"""The jax.distributed abort -> re-initialize path, driven end-to-end across real
+processes (SURVEY §7's named hard part; reference analogue: NCCL communicator abort
++ process-group destroy in ``inprocess/abort.py:58-105``, which is THE load-bearing
+abort there).
+
+Two scenarios, both through the full Wrapper restart loop with
+``AbortJaxDistributed`` in the abort chain:
+
+- **exception fault**: rank 1 raises after finishing its collective steps; both
+  ranks restart, shut down the world-2 distributed runtime, and re-initialize a
+  fresh coordinator (new port) at iteration 1 — world size unchanged, runtime
+  instance provably new.
+- **rank death**: rank 1 dies; the survivor restarts alone, re-initializes with
+  ``num_processes=1``, and completes — the world SHRANK across the re-init.
+
+Faults land between steps (each rank finishes its per-round collectives before
+faulting/parking): a collective already in flight against a dead peer blocks in
+Gloo indefinitely, and that case belongs to the monitor process's hard-timeout
+kill ladder, not the in-process layer (see ``platform/distributed.py`` docstring).
+
+Children are fresh interpreters (subprocess, not fork): jax.distributed owns
+process-global runtime state that must not leak in from a parent.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+CHILD = textwrap.dedent(
+    """
+    import json, os, sys, time
+
+    rank = int(sys.argv[1])
+    world = int(sys.argv[2])
+    store_port = sys.argv[3]
+    fault = sys.argv[4]                      # "raise" | "die"
+    jd_ports = [int(p) for p in sys.argv[5].split(",")]  # coordinator port per iteration
+
+    os.environ.update(
+        RANK=str(rank),
+        WORLD_SIZE=str(world),
+        TPU_RESILIENCY_STORE_HOST="127.0.0.1",
+        TPU_RESILIENCY_STORE_PORT=store_port,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from tpu_resiliency.inprocess import (
+        AbortCompilationCache,
+        AbortJaxDistributed,
+        CallWrapper,
+        Compose,
+        RetryController,
+        Wrapper,
+    )
+    from tpu_resiliency.platform import distributed as jdist
+
+    @Wrapper(
+        initialize=RetryController(max_iterations=4),
+        abort=Compose(AbortJaxDistributed(), AbortCompilationCache()),
+        monitor_interval=0.05,
+        last_call_wait=0.1,
+        soft_timeout=10.0,
+        hard_timeout=30.0,
+        heartbeat_interval=0.2,
+        heartbeat_timeout=10.0,
+        barrier_timeout=60.0,
+        completion_timeout=60.0,
+    )
+    def train(call: CallWrapper):
+        fs = call.frozen_state
+        w, r = fs.active_world_size, fs.active_rank
+        assert not jdist.client_active(), "abort left a stale distributed client"
+        jdist.initialize(
+            f"127.0.0.1:{jd_ports[call.iteration]}",
+            num_processes=w,
+            process_id=r,
+            heartbeat_timeout=10.0,
+        )
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices())
+        n_local = len(jax.local_devices())
+        mesh = Mesh(devs, ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        # Each process contributes rows valued initial_rank+1: the global sum
+        # proves the collective crossed every live process.
+        x = jax.make_array_from_process_local_data(
+            sh, np.full((n_local,), fs.initial_rank + 1, np.float32)
+        )
+        total = None
+        for _ in range(3):
+            total = float(jax.jit(lambda a: a.sum())(x))
+            call.ping()
+        if call.iteration == 0 and fs.initial_rank == 1:
+            if fault == "die":
+                os._exit(9)
+            raise RuntimeError("injected fault after round")
+        if call.iteration == 0:
+            # Park in Python until the restart exception lands (no collectives
+            # with a possibly-dead peer).
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                time.sleep(0.05)
+            raise TimeoutError("restart never delivered")
+        # Orderly end-of-job teardown (coordinator last) so no rank's atexit
+        # client disconnect races the coordinator service's death.
+        jdist.shutdown_ordered(call.coord.store, r, w)
+        return {
+            "iteration": call.iteration,
+            "world": w,
+            "rank": r,
+            "initial_rank": fs.initial_rank,
+            "sum": total,
+            "n_devices": len(devs),
+        }
+
+    result = train()
+    print("ABORT-REINIT " + json.dumps({"rank": rank, "result": result}), flush=True)
+    """
+)
+
+
+def _run(fault: str, timeout: float = 240.0):
+    store_port = free_port()
+    # One coordinator port per possible iteration (max_iterations=4 in the child).
+    jd_ports = ",".join(str(free_port()) for _ in range(4))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="abort-reinit-") as tmp:
+        script = os.path.join(tmp, "child.py")
+        with open(script, "w") as f:
+            f.write(CHILD)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(r), "2", str(store_port), fault, jd_ports],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+                cwd=tmp,
+            )
+            for r in range(2)
+        ]
+        outs = {}
+        try:
+            for r, p in enumerate(procs):
+                out, err = p.communicate(timeout=timeout)
+                outs[r] = (p.returncode, out, err)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    results = {}
+    for r, (rc, out, err) in outs.items():
+        for ln in out.splitlines():
+            if ln.startswith("ABORT-REINIT "):
+                payload = json.loads(ln[len("ABORT-REINIT "):])
+                results[payload["rank"]] = payload["result"]
+    return outs, results
+
+
+def test_exception_fault_reinitializes_new_coordinator():
+    outs, results = _run("raise")
+    rc0, out0, err0 = outs[0]
+    rc1, out1, err1 = outs[1]
+    assert rc0 == 0, f"rank0 failed:\n{out0}\n{err0[-3000:]}"
+    assert rc1 == 0, f"rank1 failed:\n{out1}\n{err1[-3000:]}"
+    # Both ranks re-entered at iteration 1, rebuilt a WORLD-2 runtime on the new
+    # coordinator port, and the cross-process collective produced the same global
+    # sum as before the fault: 2 procs x 2 devices x (1, 1, 2, 2) = 6.
+    for r in (0, 1):
+        assert results[r]["iteration"] == 1, results
+        assert results[r]["world"] == 2, results
+        assert results[r]["n_devices"] == 4, results
+        assert results[r]["sum"] == 6.0, results
+
+
+def test_rank_death_shrinks_world_across_reinit():
+    outs, results = _run("die")
+    rc0, out0, err0 = outs[0]
+    assert rc0 == 0, f"rank0 failed:\n{out0}\n{err0[-3000:]}"
+    assert outs[1][0] == 9  # the injected death
+    # The survivor re-initialized alone: num_processes=1, only its own 2 local
+    # devices, collective sum = its own contribution (1+1).
+    assert set(results) == {0}, results
+    assert results[0]["iteration"] == 1, results
+    assert results[0]["world"] == 1, results
+    assert results[0]["rank"] == 0, results
+    assert results[0]["n_devices"] == 2, results
+    assert results[0]["sum"] == 2.0, results

@@ -34,17 +34,24 @@ class Abort:
 
 @dataclasses.dataclass
 class AbortJaxDistributed(Abort):
-    """Shut down the JAX distributed client (multi-host coordination connection)."""
+    """Shut down the JAX distributed client/service and clear the XLA backends so
+    the restarted iteration can ``jax.distributed.initialize`` a NEW world.
+
+    Clearing backends is not optional: the public ``initialize`` refuses while
+    backends are live, and executables/buffers of the old world pin the dead
+    runtime. Requires the job to have initialized via
+    :func:`tpu_resiliency.platform.distributed.initialize` (recoverable client) —
+    otherwise peer death terminates this process before any abort can run.
+    Backends are only torn down when a distributed client was actually active, so
+    single-process jobs don't pay a pointless recompile. Proven end-to-end by
+    ``tests/inprocess/test_abort_reinit.py``.
+    """
 
     def __call__(self, state: FrozenState) -> FrozenState:
-        import jax
+        from tpu_resiliency.platform import distributed
 
-        try:
-            if jax._src.distributed.global_state.client is not None:  # noqa: SLF001
-                jax.distributed.shutdown()
-                log.info("abort: jax.distributed shut down")
-        except Exception as e:  # abort must never fail the restart loop
-            log.warning(f"abort: jax.distributed.shutdown failed: {e!r}")
+        # Never raises: the restart loop must proceed regardless.
+        distributed.shutdown_for_restart()
         return state
 
 

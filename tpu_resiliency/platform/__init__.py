@@ -18,9 +18,10 @@ from tpu_resiliency.platform.device import (
     process_count,
     process_index,
 )
-from tpu_resiliency.platform import ipc
+from tpu_resiliency.platform import distributed, ipc
 
 __all__ = [
+    "distributed",
     "CoordStore",
     "KVClient",
     "KVServer",
