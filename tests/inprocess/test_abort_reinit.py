@@ -124,7 +124,7 @@ CHILD = textwrap.dedent(
             raise TimeoutError("restart never delivered")
         # Orderly end-of-job teardown (coordinator last) so no rank's atexit
         # client disconnect races the coordinator service's death.
-        jdist.shutdown_ordered(call.coord.store, r, w)
+        jdist.shutdown_ordered(call.coord.store, r, w, iteration=call.iteration)
         return {
             "iteration": call.iteration,
             "world": w,

@@ -232,6 +232,70 @@ def test_spare_promotion_after_failure(tmp_path):
     assert p1.returncode == 0, err1
 
 
+def test_upscale_promotes_late_joiner(tmp_path):
+    """`--nnodes 1:2` with upscaling: agent A starts alone (world of 1 node); agent
+    B joins mid-run; the leader detects the waiting node, triggers an upscale
+    restart round, and the re-formed world runs with WORLD_SIZE=2 (reference
+    behavior: restart on num_nodes_waiting>0, ``launcher.py:333-346`` +
+    ``_ft_rendezvous.py:302-338``)."""
+    port = free_port()
+    script = tmp_path / "upscale.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import os, time
+            ws = os.environ["WORLD_SIZE"]
+            rank = os.environ["RANK"]
+            rnd = os.environ["TPU_FT_RESTART_COUNT"]
+            with open(r"{tmp_path}/world_" + rnd + "_" + rank + ".txt", "w") as f:
+                f.write(ws)
+            if ws == "1":
+                time.sleep(600)  # park until the upscale round kills + re-ranks us
+            print("done at world", ws)
+            """
+        )
+    )
+    args = ["--nproc-per-node", "1", "--nnodes", "1:2", "--upscaling-enabled",
+            "--rdzv-endpoint", f"127.0.0.1:{port}", "--no-ft-monitors",
+            "--rdzv-last-call", "0.3", "--max-restarts", "3",
+            "--monitor-interval", "0.1"]
+    # conftest pins TPU_RESILIENCY_LOG_LEVEL=WARNING; the upscale assertion below
+    # reads the leader's INFO log line.
+    info = {"TPU_RESILIENCY_LOG_LEVEL": "INFO"}
+    p0 = launch_async(args + ["--node-id", "nodeA"], script, tmp_path,
+                      extra_env=info, name="a")
+    # Wait until nodeA's solo round 0 actually RAN (its parked worker wrote the
+    # marker) before nodeB exists — otherwise nodeB could join round 0 directly
+    # and the first round would legitimately form at world size 2.
+    deadline = time.monotonic() + 60.0
+    while not (tmp_path / "world_0_0.txt").exists():
+        assert time.monotonic() < deadline, "nodeA never formed its solo round"
+        assert p0.poll() is None, "nodeA exited before forming a round"
+        time.sleep(0.1)
+    p1 = launch_async(args + ["--node-id", "nodeB"], script, tmp_path,
+                      extra_env=info, name="b")
+    out0, err0 = p0.communicate(timeout=120)
+    out1, err1 = p1.communicate(timeout=120)
+    assert p0.returncode == 0, err0
+    assert p1.returncode == 0, err1
+    assert "upscale" in err0  # the leader logged the upscale restart request
+    # Some round ran at world size 1 before the upscale...
+    world1_rounds = [
+        f for f in os.listdir(tmp_path)
+        if f.startswith("world_") and (tmp_path / f).read_text() == "1"
+    ]
+    assert world1_rounds, "no round ever ran at world size 1"
+    # ...and the final round ran with BOTH ranks at world size 2.
+    final_round = max(
+        int(f.split("_")[1]) for f in os.listdir(tmp_path) if f.startswith("world_")
+    )
+    finals = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith(f"world_{final_round}_")
+    )
+    assert finals == [f"world_{final_round}_0.txt", f"world_{final_round}_1.txt"]
+    assert all((tmp_path / f).read_text() == "2" for f in finals)
+
+
 def test_dead_agent_detected_and_spare_promoted(tmp_path):
     """SIGKILL the active agent mid-run: the spare must detect the stale keep-alive,
     trigger a restart round, get promoted, and finish the job alone."""

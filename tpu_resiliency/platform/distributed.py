@@ -99,6 +99,7 @@ def shutdown_ordered(
     active_rank: int,
     active_world_size: int,
     *,
+    iteration: int = 0,
     timeout: float = 30.0,
     key: str = "jd_shutdown_done",
 ) -> None:
@@ -111,8 +112,11 @@ def shutdown_ordered(
     interpreter exit. Here non-coordinator ranks shut down their clients first
     and announce on the job ``store``; the coordinator waits for every
     announcement (bounded by ``timeout``, best-effort beyond it) before tearing
-    the service down. Call once per rank after the last collective; backends are
-    left alive (nothing restarts after completion).
+    the service down. Call once per rank after the last collective, passing the
+    restart ``iteration`` (stale announcements from an earlier, fault-aborted
+    completion attempt must not satisfy this round's wait). Backends are left
+    alive (nothing restarts after completion). Never raises: a completed job
+    must not be re-classified as faulted because its teardown hiccuped.
     """
     import time as _time
 
@@ -120,28 +124,32 @@ def shutdown_ordered(
 
     if not client_active():
         return
+    skey = f"{key}/{iteration}"
     if active_rank != 0:
         try:
             jax.distributed.shutdown()
         except Exception as e:
-            # A completed job must never be re-classified as faulted because its
-            # teardown hiccuped (same never-raise contract as
-            # shutdown_for_restart).
             log.warning(f"shutdown_ordered: client shutdown failed: {e!r}")
-        finally:
-            store.set_add(key, [int(active_rank)])
+        try:
+            store.set_add(skey, [int(active_rank)])
+        except Exception as e:
+            log.warning(f"shutdown_ordered: announcement failed: {e!r}")
         return
-    deadline = _time.monotonic() + timeout
     expected = set(range(1, active_world_size))
-    while _time.monotonic() < deadline:
-        if set(store.set_get(key)) >= expected:
-            break
-        _time.sleep(0.05)
-    else:
-        log.warning(
-            f"shutdown_ordered: peers {expected - set(store.set_get(key))} never "
-            f"announced client shutdown within {timeout}s; tearing down anyway"
-        )
+    deadline = _time.monotonic() + timeout
+    try:
+        while _time.monotonic() < deadline:
+            if set(store.set_get(skey)) >= expected:
+                break
+            _time.sleep(0.05)
+        else:
+            log.warning(
+                f"shutdown_ordered: peers {expected - set(store.set_get(skey))} "
+                f"never announced client shutdown within {timeout}s; tearing down "
+                f"anyway"
+            )
+    except Exception as e:
+        log.warning(f"shutdown_ordered: announcement wait failed: {e!r}")
     try:
         jax.distributed.shutdown()
     except Exception as e:
