@@ -360,3 +360,140 @@ class TestForkCallerGuard:
 def _touch_file(path):
     with open(path, "w") as f:
         f.write("ok")
+
+
+class TestGroupSequenceFor:
+    def test_divisible_matches_parse(self):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        assert group_sequence_for(range(8), 2, 2) == parse_group_sequence(2, 2, 8)
+
+    def test_gapped_rank_ids_group_by_position(self):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        # Survivors [0,2,5,7] with jump 2: spacing follows placement ORDER.
+        assert group_sequence_for([7, 0, 5, 2], 2, 2) == [[0, 5], [2, 7]]
+
+    def test_remainder_merges_into_last_clique(self):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        assert group_sequence_for(range(3), 1, 2) == [[0, 1, 2]]
+        assert group_sequence_for(range(5), 1, 2) == [[0, 1], [2, 3, 4]]
+
+    def test_no_full_block_consecutive_cliques(self):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        # jump 4 x factor 2 needs 8 ranks; with 5 the spacing degrades rather
+        # than leaving anyone unmirrored.
+        assert group_sequence_for(range(5), 4, 2) == [[0, 1], [2, 3], [4]]
+
+    def test_single_rank(self):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        assert group_sequence_for([3], 1, 2) == [[3]]
+
+
+class TestRebuildAfterReassignment:
+    def test_rebuild_remirrors_and_next_save_covers(self, tmp_path, make_store):
+        """VERDICT r3 item 7: world 4 saves with cliques [0,1],[2,3]; rank 3 dies;
+        survivors rebuild over [0,1,2], the orphaned rank-2 shard gets re-mirrored,
+        a wiped rank still recovers, and the next save is coverage-complete."""
+        world = 4
+
+        def save_phase(rank):
+            comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    str(tmp_path), rank=rank, comm=comm, replication=strat
+                )
+                mgr.save(2, PyTreeStateDict(_tree(rank)), is_async=False)
+                mgr.close()
+            finally:
+                ex.close()
+
+        run_ranks(world, save_phase, timeout=120.0)
+
+        # Rank 3 is dead. Survivors' managers (still configured for the old
+        # world) adopt the new group.
+        survivors = [0, 1, 2]
+
+        def rebuild_phase(rank):
+            import os
+
+            stale_comm = StoreComm(make_store(), rank, list(range(world)), timeout=30.0)
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    stale_comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    str(tmp_path), rank=rank, comm=stale_comm, replication=strat
+                )
+                assert strat.my_group in ([0, 1], [2, 3])
+                new_comm = StoreComm(make_store(), rank, survivors, timeout=30.0)
+                mgr.rebuild_group(new_comm)
+                # Remainder merged: one clique of all three survivors.
+                assert strat.my_group == [0, 1, 2]
+                # Rank 2's shard (old mirror lived only on dead rank 3) is now
+                # mirrored on every new clique peer (rank 2 additionally still
+                # holds the dead rank's stale mirror — harmless, pruned at the
+                # next save's retention pass).
+                held = {i.owner for i in mgr.local_ids() if i.iteration == 2}
+                assert held >= {0, 1, 2}, held
+                new_comm.barrier("post-remirror")
+                if rank == 2:  # rank 2 lands on fresh storage
+                    for name in os.listdir(mgr._dir):
+                        os.unlink(os.path.join(mgr._dir, name))
+                new_comm.barrier("post-wipe")
+                latest = mgr.find_latest()
+                assert latest == 2, latest
+                hollow, tensors, meta = mgr.load(latest)
+                val = float(tensors[0][0])
+                # The next save must be coverage-complete over the NEW group
+                # (finalize raises otherwise).
+                mgr.save(5, PyTreeStateDict(_tree(rank + 10)), is_async=False)
+                latest2 = mgr.find_latest()
+                mgr.close()
+                return val, latest2
+            finally:
+                ex.close()
+
+        results = run_ranks(3, lambda r: rebuild_phase(survivors[r]), timeout=120.0)
+        assert [v for v, _ in results] == [0.0, 1.0, 2.0]
+        assert all(l == 5 for _, l in results)
+
+
+class TestLazyCliqueReplication:
+    def test_groups_bind_at_first_use(self, make_store):
+        from tpu_resiliency.checkpoint.replication import LazyCliqueReplicationStrategy
+
+        world = 2
+
+        def body(rank):
+            # The comm is only KNOWABLE after "rank assignment settles": the
+            # factory defers its construction to first replicate().
+            ex = PeerExchange(make_store(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = LazyCliqueReplicationStrategy(
+                    lambda: StoreComm(make_store(), rank, [0, 1], timeout=30.0),
+                    ex,
+                    replication_jump=1,
+                    replication_factor=2,
+                )
+                assert strat.comm is None and strat.groups is None
+                held = strat.replicate(f"blob-{rank}".encode())
+                assert strat.my_group == [0, 1]
+                return {o: b.decode() for o, b in held.items()}
+            finally:
+                ex.close()
+
+        results = run_ranks(world, body, timeout=60.0)
+        assert results[0] == {0: "blob-0", 1: "blob-1"}
+        assert results[1] == {0: "blob-0", 1: "blob-1"}

@@ -28,6 +28,8 @@ from tpu_resiliency.checkpoint.local_manager import CkptID, LocalCheckpointManag
 from tpu_resiliency.checkpoint.replication import (
     CliqueReplicationStrategy,
     ExchangePlan,
+    LazyCliqueReplicationStrategy,
+    group_sequence_for,
     parse_group_sequence,
 )
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict, TensorPlaceholder
@@ -44,7 +46,9 @@ __all__ = [
     "CkptID",
     "LocalCheckpointManager",
     "CliqueReplicationStrategy",
+    "LazyCliqueReplicationStrategy",
     "ExchangePlan",
+    "group_sequence_for",
     "parse_group_sequence",
     "PyTreeStateDict",
     "TensorPlaceholder",

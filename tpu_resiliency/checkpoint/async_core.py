@@ -390,6 +390,45 @@ class AsyncCallsQueue:
     def finalize_all(self) -> list[int]:
         return self.maybe_finalize_async_calls(blocking=True)
 
+    def set_sync_fn(self, sync_fn: Optional[Callable[[bool], bool]]) -> None:
+        """Swap the cross-rank agreement function (after the rank group changed).
+
+        Only legal with no in-flight saves: a pending save's agreement was
+        entered against the OLD group and must not finalize against the new one
+        — :meth:`abandon` first.
+        """
+        if self._active:
+            raise CheckpointError(
+                f"{len(self._active)} in-flight saves were scheduled against the "
+                "previous rank group; abandon() or finalize them before swapping "
+                "sync_fn"
+            )
+        self._sync_fn = sync_fn
+
+    def abandon(self) -> list[int]:
+        """Drop queued saves WITHOUT the collective finalization — for restart
+        paths where the group the saves were scheduled against no longer exists
+        (dead peers would hang the agreement; a new-world agreement would judge
+        the old iteration uncovered). Local async work (file writes) is waited
+        out so shards land on disk; coverage verification and pruning are
+        skipped — the next successful save re-establishes both. Returns the
+        abandoned indices."""
+        abandoned: list[int] = []
+        while self._active:
+            call = self._active.pop(0)
+            try:
+                call.caller.wait(None)
+                call.caller.raise_if_failed()
+            except Exception as e:
+                log.warning(f"abandoned save {call.idx} had failed locally: {e!r}")
+            finally:
+                if call.caller is not self._persistent_caller:
+                    call.caller.close()
+            abandoned.append(call.idx)
+        if abandoned:
+            log.info(f"abandoned {len(abandoned)} in-flight saves (group change)")
+        return abandoned
+
     def close(self) -> None:
         self.finalize_all()
         if self._persistent_caller is not None:

@@ -204,6 +204,48 @@ class LocalCheckpointManager:
         world = set(self.comm.ranks)  # the group's actual rank ids, not range(world)
         return {it for it, owners in by_iter.items() if world <= owners}
 
+    def rebuild_group(self, comm: StoreComm, remirror: bool = True) -> None:
+        """Adopt a new rank group after reassignment; re-mirror within new cliques.
+
+        Collective over the NEW group (every surviving/joining rank calls this with
+        the same comm). After a restart round changes the active world — a rank
+        died, a degraded rank was demoted, a spare was promoted — the old cliques
+        are stale: coverage agreement would all-gather over a group containing dead
+        peers, and a shard whose only mirror died is one failure away from loss.
+        This rebuilds the clique math over the new membership and (by default)
+        re-mirrors each rank's newest own shard so the NEXT failure is covered.
+        The reference fixes groups for the job's lifetime and so never faces this
+        (``strategies.py:76-140``); health-driven replication owns it.
+        """
+        # Saves in flight were scheduled against the OLD group: their collective
+        # finalization would hang on dead peers (or wrongly judge coverage in the
+        # new world). Keep their local writes, drop their finalization.
+        self.queue.abandon()
+        self.comm = comm
+        self.queue.set_sync_fn(comm.make_sync_fn() if comm is not None else None)
+        if self.replication is None:
+            return
+        self.replication.rebuild(comm)
+        if not (remirror and self.replication.enabled):
+            return
+        own = [i.iteration for i in self.local_ids() if i.owner == self.rank]
+        newest = max(own) if own else None
+        received = self.replication.remirror(
+            newest,
+            lambda: self._read_blob(newest, self.rank),
+            held={(i.owner, i.iteration) for i in self.local_ids()},
+        )
+        writes = [
+            (self._path(CkptID(it, owner, self.session)), blob)
+            for owner, (it, blob) in received.items()
+        ]
+        if writes:
+            _write_blobs(writes)
+        record_event(
+            "checkpoint", "ckpt_group_rebuilt", rank=self.rank,
+            group=self.replication.my_group, remirrored=sorted(received),
+        )
+
     def find_latest(self) -> int:
         """Newest iteration fully covered by the group's holdings, or -1.
 

@@ -43,12 +43,46 @@ def parse_group_sequence(
             f"world_size {world_size} not divisible by "
             f"replication_jump*replication_factor = {block}"
         )
-    groups = []
-    for base in range(0, world_size, block):
+    return group_sequence_for(range(world_size), replication_jump, replication_factor)
+
+
+def group_sequence_for(
+    active_ranks: Sequence[int], replication_jump: int, replication_factor: int
+) -> list[list[int]]:
+    """Cliques over an ARBITRARY active rank set — the post-reassignment worlds
+    this framework produces are rarely ``range(n)`` and rarely divisible.
+
+    Full blocks follow :func:`parse_group_sequence`'s jump spacing over *positions*
+    in the sorted active list (positions, not rank ids: after a shrink the
+    survivors' ids have gaps, but failure domains follow physical placement order).
+    Remainder ranks merge into the last full-spacing clique when one exists
+    (slightly larger clique beats an unmirrored shard); with no full block they
+    form consecutive cliques of up to ``replication_factor``.
+    """
+    if replication_factor < 1:
+        raise ValueError("replication_factor must be >= 1")
+    if replication_jump < 1:
+        raise ValueError("replication_jump must be >= 1")
+    ranks = sorted(active_ranks)
+    n = len(ranks)
+    block = replication_jump * replication_factor
+    full_end = (n // block) * block
+    groups: list[list[int]] = []
+    for base in range(0, full_end, block):
         for offset in range(replication_jump):
             groups.append(
-                [base + offset + k * replication_jump for k in range(replication_factor)]
+                [
+                    ranks[base + offset + k * replication_jump]
+                    for k in range(replication_factor)
+                ]
             )
+    rem = ranks[full_end:]
+    if rem:
+        if groups:
+            groups[-1].extend(rem)
+        else:
+            for i in range(0, len(rem), replication_factor):
+                groups.append(rem[i : i + replication_factor])
     return groups
 
 
@@ -119,7 +153,7 @@ class CliqueReplicationStrategy:
 
     def __init__(
         self,
-        comm: StoreComm,
+        comm: Optional[StoreComm],
         exchange: PeerExchange,
         replication_jump: int = 1,
         replication_factor: int = 2,
@@ -128,11 +162,86 @@ class CliqueReplicationStrategy:
         self.exchange = exchange
         self.jump = replication_jump
         self.factor = replication_factor
-        self.groups = parse_group_sequence(
-            replication_jump, replication_factor, comm.world_size
-        )
-        self.my_group = group_of(comm.rank, self.groups)
+        #: Exchange tags embed this counter; every member of a group must agree
+        #: on it (same number of replicate/retrieve/remirror calls), or peers
+        #: wait on tags that are never sent. ``rebuild`` resets it so survivors
+        #: and freshly constructed joiners re-align at 0.
         self._round = 0
+        if comm is not None:
+            self._set_groups(comm.ranks)
+        else:
+            self.groups = None
+            self.my_group = None
+
+    def _set_groups(self, active_ranks: Sequence[int]) -> None:
+        self.groups = group_sequence_for(active_ranks, self.jump, self.factor)
+        self.my_group = group_of(self.comm.rank, self.groups)
+
+    def rebuild(self, comm: StoreComm) -> None:
+        """Recompute cliques after rank reassignment.
+
+        Call collectively from every surviving rank with the NEW group's comm
+        (the old group includes dead ranks, whose barriers would hang). The
+        reference sidesteps this by fixing groups for the job's lifetime
+        (``strategies.py:76-140``); a framework whose health policy *changes* the
+        active set owns the rebuild. Follow with :meth:`remirror` so shards whose
+        old mirrors died are covered again before the next failure.
+        """
+        self.comm = comm
+        self._set_groups(comm.ranks)
+        # Survivors carry arbitrary _round values; joiners constructed fresh sit
+        # at 0. Tags must agree across the new group, and rebuild is the one
+        # moment every member is provably at the same point — re-align here.
+        self._round = 0
+        log.info(
+            f"replication cliques rebuilt over {comm.ranks}: my_group={self.my_group}"
+        )
+
+    def remirror(
+        self,
+        my_iteration: Optional[int],
+        get_blob,
+        held: frozenset[tuple[int, int]] | set[tuple[int, int]] = frozenset(),
+    ) -> dict[int, tuple[int, bytes]]:
+        """Re-mirror shards within the (rebuilt) cliques. Collective over the comm.
+
+        ``my_iteration``: newest iteration of this rank's OWN shard on local disk
+        (``None`` when it has none — a fresh joiner participates as receiver
+        only). ``get_blob()`` loads that shard's bytes. ``held``: the
+        ``(owner, iteration)`` pairs already on this rank's disk — a peer that
+        already holds a mirror is skipped (after a shrink, surviving clique pairs
+        keep their existing multi-GB mirrors; only orphaned shards move). Returns
+        ``{owner_rank: (iteration, blob)}`` of mirrors received — the caller
+        persists them. Unlike :meth:`replicate`, participation is asymmetric by
+        design: after an upscale some clique members have nothing to send yet.
+        """
+        self._ensure_groups()
+        rank = self.comm.rank
+        gathered = self.comm.all_gather(
+            (rank, my_iteration, sorted(held)), tag="remirror-meta"
+        )
+        have = {r: it for r, it, _ in gathered if it is not None}
+        peer_held = {r: {tuple(p) for p in h} for r, _, h in gathered}
+        if not self.enabled:
+            return {}
+        tag = f"remir/{self._round}"
+        self._round += 1
+        if rank in have:
+            blob = None
+            for peer in self.my_group:
+                if peer != rank and (rank, have[rank]) not in peer_held[peer]:
+                    if blob is None:
+                        blob = get_blob()
+                    self.exchange.send(peer, f"{tag}/{rank}", blob)
+        received: dict[int, tuple[int, bytes]] = {}
+        for peer in self.my_group:
+            if (
+                peer != rank
+                and peer in have
+                and (peer, have[peer]) not in peer_held[rank]
+            ):
+                received[peer] = (have[peer], self.exchange.recv(peer, f"{tag}/{peer}"))
+        return received
 
     @property
     def enabled(self) -> bool:
@@ -140,6 +249,7 @@ class CliqueReplicationStrategy:
 
     def replicate(self, blob: bytes) -> dict[int, bytes]:
         """Exchange shard blobs within the clique. Returns {owner_rank: blob}."""
+        self._ensure_groups()
         rank = self.comm.rank
         held = {rank: blob}
         if not self.enabled:
@@ -153,6 +263,9 @@ class CliqueReplicationStrategy:
             if peer != rank:
                 held[peer] = self.exchange.recv(peer, tag)
         return held
+
+    def _ensure_groups(self) -> None:
+        """Hook for the lazy subclass; the eager strategy's groups always exist."""
 
     def retrieve(
         self,
@@ -169,6 +282,7 @@ class CliqueReplicationStrategy:
         must call this collectively with the same ``avoid`` set (degraded ranks are
         deprioritized as senders). Returns the received blob, or ``None``.
         """
+        self._ensure_groups()
         gathered = self.comm.all_gather(
             (self.comm.rank, my_needed_owner, sorted(my_held_owners)), tag="retrieve-meta"
         )
@@ -185,3 +299,34 @@ class CliqueReplicationStrategy:
         for src, owner in plan.recvs.get(self.comm.rank, []):
             blob = self.exchange.recv(src, f"{tag}/{owner}")
         return blob
+
+
+class LazyCliqueReplicationStrategy(CliqueReplicationStrategy):
+    """Clique construction deferred to first use (reference parity:
+    ``checkpointing/local/replication/strategies.py:190-``).
+
+    Matters when world membership is not final at strategy-construction time —
+    spares still promoting, rank assignment still settling after a restart round.
+    ``comm_fn()`` is invoked once, at the first ``replicate``/``retrieve``/
+    ``remirror``, and must return the group comm for the world that exists THEN.
+    ``rebuild`` still works afterwards, exactly as on the eager strategy.
+    """
+
+    def __init__(
+        self,
+        comm_fn,
+        exchange: PeerExchange,
+        replication_jump: int = 1,
+        replication_factor: int = 2,
+    ):
+        super().__init__(None, exchange, replication_jump, replication_factor)
+        self._comm_fn = comm_fn
+
+    def _ensure_groups(self) -> None:
+        if self.comm is None:
+            self.comm = self._comm_fn()
+            self._set_groups(self.comm.ranks)
+            log.info(
+                f"lazy replication bound to world {self.comm.ranks}: "
+                f"my_group={self.my_group}"
+            )

@@ -59,6 +59,14 @@ class HierarchicalCheckpointCallback(Callback):
         self.rank = rank
         self.driven_by_loop = driven_by_loop
 
+    def rebuild_group(self, comm, remirror: bool = True) -> None:
+        """After a restart round changed the active world: adopt the new rank
+        group on the local tier (clique rebuild + re-mirror; collective — every
+        surviving rank's callback calls this with the new group's comm). See
+        :meth:`LocalCheckpointManager.rebuild_group`."""
+        if self.local_manager is not None:
+            self.local_manager.rebuild_group(comm, remirror=remirror)
+
     # -- save path ---------------------------------------------------------
 
     @property
