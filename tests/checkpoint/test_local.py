@@ -384,8 +384,9 @@ class TestGroupSequenceFor:
         from tpu_resiliency.checkpoint.replication import group_sequence_for
 
         # jump 4 x factor 2 needs 8 ranks; with 5 the spacing degrades rather
-        # than leaving anyone unmirrored.
-        assert group_sequence_for(range(5), 4, 2) == [[0, 1], [2, 3], [4]]
+        # than leaving anyone unmirrored — a singleton tail folds into its
+        # neighbor (a 1-clique would hold zero mirrors).
+        assert group_sequence_for(range(5), 4, 2) == [[0, 1], [2, 3, 4]]
 
     def test_single_rank(self):
         from tpu_resiliency.checkpoint.replication import group_sequence_for
@@ -436,7 +437,9 @@ class TestRebuildAfterReassignment:
                     str(tmp_path), rank=rank, comm=stale_comm, replication=strat
                 )
                 assert strat.my_group in ([0, 1], [2, 3])
-                new_comm = StoreComm(make_store(), rank, survivors, timeout=30.0)
+                new_comm = StoreComm(
+                    make_store(), rank, survivors, timeout=30.0, generation=1
+                )
                 mgr.rebuild_group(new_comm)
                 # Remainder merged: one clique of all three survivors.
                 assert strat.my_group == [0, 1, 2]
@@ -446,6 +449,11 @@ class TestRebuildAfterReassignment:
                 # next save's retention pass).
                 held = {i.owner for i in mgr.local_ids() if i.iteration == 2}
                 assert held >= {0, 1, 2}, held
+                # The DEAD rank's shard (sole copy was rank 2's mirror) was
+                # re-spread: every survivor can now serve the reshard path.
+                assert 3 in held, held
+                hollow3, t3, _ = mgr.load_shard(3, 2)
+                assert float(t3[0][0]) == 3.0
                 new_comm.barrier("post-remirror")
                 if rank == 2:  # rank 2 lands on fresh storage
                     for name in os.listdir(mgr._dir):

@@ -70,10 +70,18 @@ class StoreComm:
         rank: int,
         ranks: list[int],
         timeout: float = 300.0,
+        generation: int = 0,
     ):
         if rank not in ranks:
             raise ValueError(f"rank {rank} not in group {ranks}")
-        self.store = store.scoped(f"comm/{'-'.join(map(str, sorted(ranks)))}")
+        # ``generation`` isolates server-side barrier/round state across restart
+        # rounds: a gather that timed out against a dead peer leaves its barrier
+        # arrivals in place, and a later comm over the SAME membership (the peer
+        # rejoined) would collide with them. Pass the restart iteration when
+        # rebuilding groups after reassignment.
+        self.store = store.scoped(
+            f"comm/g{generation}/{'-'.join(map(str, sorted(ranks)))}"
+        )
         self.rank = rank
         self.ranks = sorted(ranks)
         self.timeout = timeout

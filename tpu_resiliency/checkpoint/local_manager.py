@@ -208,10 +208,13 @@ class LocalCheckpointManager:
         """Adopt a new rank group after reassignment; re-mirror within new cliques.
 
         Collective over the NEW group (every surviving/joining rank calls this with
-        the same comm). After a restart round changes the active world — a rank
-        died, a degraded rank was demoted, a spare was promoted — the old cliques
-        are stale: coverage agreement would all-gather over a group containing dead
-        peers, and a shard whose only mirror died is one failure away from loss.
+        the same comm — construct it with ``generation=<restart iteration>`` so
+        server-side barrier state from a gather that timed out against the dead
+        world can never collide with the new group's). After a restart round
+        changes the active world — a rank died, a degraded rank was demoted, a
+        spare was promoted — the old cliques are stale: coverage agreement would
+        all-gather over a group containing dead peers, and a shard whose only
+        mirror died is one failure away from loss.
         This rebuilds the clique math over the new membership and (by default)
         re-mirrors each rank's newest own shard so the NEXT failure is covered.
         The reference fixes groups for the job's lifetime and so never faces this
@@ -232,7 +235,7 @@ class LocalCheckpointManager:
         newest = max(own) if own else None
         received = self.replication.remirror(
             newest,
-            lambda: self._read_blob(newest, self.rank),
+            lambda owner, it: self._read_blob(it, owner),
             held={(i.owner, i.iteration) for i in self.local_ids()},
         )
         writes = [

@@ -83,6 +83,10 @@ def group_sequence_for(
         else:
             for i in range(0, len(rem), replication_factor):
                 groups.append(rem[i : i + replication_factor])
+            # A singleton tail clique would hold ZERO mirrors — the data loss
+            # replication exists to prevent. Fold it into its neighbor.
+            if len(groups) >= 2 and len(groups[-1]) == 1:
+                groups[-2].extend(groups.pop())
     return groups
 
 
@@ -207,13 +211,21 @@ class CliqueReplicationStrategy:
 
         ``my_iteration``: newest iteration of this rank's OWN shard on local disk
         (``None`` when it has none — a fresh joiner participates as receiver
-        only). ``get_blob()`` loads that shard's bytes. ``held``: the
-        ``(owner, iteration)`` pairs already on this rank's disk — a peer that
-        already holds a mirror is skipped (after a shrink, surviving clique pairs
-        keep their existing multi-GB mirrors; only orphaned shards move). Returns
-        ``{owner_rank: (iteration, blob)}`` of mirrors received — the caller
-        persists them. Unlike :meth:`replicate`, participation is asymmetric by
-        design: after an upscale some clique members have nothing to send yet.
+        only). ``get_blob(owner, iteration)`` loads a locally-held shard's bytes.
+        ``held``: the ``(owner, iteration)`` pairs already on this rank's disk —
+        a peer that already holds a mirror is skipped (after a shrink, surviving
+        clique pairs keep their existing multi-GB mirrors; only shards that lost
+        redundancy move). Two passes:
+
+        1. every active rank's OWN shard is mirrored to clique peers lacking it;
+        2. mirrors whose OWNER left the active set (the departed rank's state —
+           the copy the ``load_shard`` reshard path consumes) are re-spread from
+           a deterministic primary holder to its clique, so the next failure
+           can't destroy the sole surviving copy.
+
+        Returns ``{owner_rank: (iteration, blob)}`` of mirrors received — the
+        caller persists them. Unlike :meth:`replicate`, participation is
+        asymmetric by design: after an upscale some members have nothing to send.
         """
         self._ensure_groups()
         rank = self.comm.rank
@@ -226,14 +238,15 @@ class CliqueReplicationStrategy:
             return {}
         tag = f"remir/{self._round}"
         self._round += 1
+        received: dict[int, tuple[int, bytes]] = {}
+        # Pass 1: own shards.
         if rank in have:
             blob = None
             for peer in self.my_group:
                 if peer != rank and (rank, have[rank]) not in peer_held[peer]:
                     if blob is None:
-                        blob = get_blob()
+                        blob = get_blob(rank, have[rank])
                     self.exchange.send(peer, f"{tag}/{rank}", blob)
-        received: dict[int, tuple[int, bytes]] = {}
         for peer in self.my_group:
             if (
                 peer != rank
@@ -241,6 +254,32 @@ class CliqueReplicationStrategy:
                 and (peer, have[peer]) not in peer_held[rank]
             ):
                 received[peer] = (have[peer], self.exchange.recv(peer, f"{tag}/{peer}"))
+        # Pass 2: orphaned mirrors (owner no longer active). Every rank computes
+        # the same plan from the gathered holdings; the lowest-ranked holder of
+        # the newest copy re-spreads it within its own clique.
+        active = set(self.comm.ranks)
+        orphans: dict[int, int] = {}
+        for _, _, h in gathered:
+            for o, it in (tuple(p) for p in h):
+                if o not in active:
+                    orphans[o] = max(orphans.get(o, it), it)
+        for owner in sorted(orphans):
+            it = orphans[owner]
+            holders = sorted(r for r in active if (owner, it) in peer_held[r])
+            if not holders:
+                continue
+            primary = holders[0]
+            grp = group_of(primary, self.groups)
+            for dst in grp:
+                if dst == primary or (owner, it) in peer_held[dst]:
+                    continue
+                if rank == primary:
+                    self.exchange.send(dst, f"{tag}/orph/{owner}", get_blob(owner, it))
+                elif rank == dst:
+                    received[owner] = (
+                        it,
+                        self.exchange.recv(primary, f"{tag}/orph/{owner}"),
+                    )
         return received
 
     @property

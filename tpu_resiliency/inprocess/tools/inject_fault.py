@@ -34,6 +34,8 @@ class Fault(enum.Enum):
     LOCK_GIL = enum.auto()  # hold the GIL forever in a helper thread
     SLEEP = enum.auto()  # block the calling thread (soft timeout)
     EXIT = enum.auto()  # os._exit without cleanup
+    DEVICE_HANG = enum.auto()  # dispatch a never-terminating compiled program
+    DEVICE_ERROR = enum.auto()  # kill the XLA runtime: every later dispatch raises
 
 
 class InjectedFault(Exception):
@@ -53,6 +55,63 @@ def _lock_gil() -> None:
     pythonapi.PyGILState_Ensure.restype = ctypes.c_void_p
     pythonapi.PyGILState_Ensure()
     libc.sleep(3600)  # blocks holding the GIL: no other thread can run Python
+
+
+def _device_hang() -> None:
+    """Block the calling thread in a device wait that never completes — the
+    reference's GPU_SLEEP analogue (``tools/inject_fault.py:34-47``): a genuinely
+    executing program (compiled ``while_loop`` whose carry never changes), not a
+    host sleep, so the thread is parked in C++ ``block_until_ready`` where async
+    exceptions cannot reach it — exactly a wedged collective/runtime. Only the
+    monitor process's hard-timeout ladder gets a rank out of this state."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # The exit condition is analytically never true (sin <= 1 < 2) but not
+    # provable by XLA, so the loop can't be constant-folded away (a carry the
+    # optimizer CAN reason about — e.g. ``c * 0`` — gets folded and returns).
+    f = jax.jit(
+        lambda: lax.while_loop(
+            lambda c: jnp.sin(c) < 2.0, lambda c: c + 1.0, jnp.float32(0)
+        )
+    )
+    jax.block_until_ready(f())  # never returns
+
+
+_DEAD_PLATFORM = "__injected_dead_device__"
+_saved_platforms: list = []
+
+
+def _device_error() -> None:
+    """Kill the device runtime: tear down live XLA backends and point jax at a
+    platform that does not exist, so every subsequent dispatch raises — the
+    closest a simulation gets to the reference's injected CUDA errors
+    (GPU_ERROR). Persistent (unlike a one-shot exception): the liveness probe
+    and :class:`JaxHealthCheck` both observe the dead runtime until
+    :func:`heal_device_error` or a backend re-initialize."""
+    import jax
+
+    from tpu_resiliency.platform.distributed import clear_backends
+
+    _saved_platforms.append(jax.config.jax_platforms)
+    jax.config.update("jax_platforms", _DEAD_PLATFORM)
+    # Compiled executables pin the old runtime's client and would keep
+    # dispatching happily past the dead backend — drop them too.
+    jax.clear_caches()
+    clear_backends()
+
+
+def heal_device_error() -> None:
+    """Undo :data:`Fault.DEVICE_ERROR` (for tests and abort-chain recovery)."""
+    import jax
+
+    from tpu_resiliency.platform.distributed import clear_backends
+
+    if _saved_platforms:
+        jax.config.update("jax_platforms", _saved_platforms.pop())
+        jax.clear_caches()
+        clear_backends()
 
 
 def inject_fault(
@@ -95,6 +154,12 @@ def inject_fault(
             return
         if fault == Fault.EXIT:
             os._exit(3)
+        if fault == Fault.DEVICE_HANG:
+            _device_hang()
+            return
+        if fault == Fault.DEVICE_ERROR:
+            _device_error()
+            return
         raise ValueError(f"unknown fault {fault}")
 
     needs_thread = in_thread or fault in (Fault.ASYNC_EXC, Fault.LOCK_GIL)

@@ -153,16 +153,19 @@ def device_liveness_probe(timeout: float = 30.0, device=None) -> bool:
     ``torch.cuda.synchronize`` under a timeout thread (``inprocess/health_check.py:70-110``):
     submit a tiny computation twice and ``block_until_ready`` with a watchdog thread, so a
     wedged device (hung ICI collective, dead runtime) turns into a ``False`` rather than a
-    forever-block.
+    forever-block. Device RESOLUTION happens inside the guarded worker too: when the
+    runtime is dead enough that backend init itself raises (or blocks), the probe's
+    answer is still ``False``, never an exception — health paths must keep running
+    on a broken host.
     """
     import jax
     import jax.numpy as jnp
 
-    dev = device if device is not None else default_device()
     result: dict[str, bool] = {}
 
     def _work():
         try:
+            dev = device if device is not None else default_device()
             for _ in range(2):
                 x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
                 jax.block_until_ready(x + 1.0)
