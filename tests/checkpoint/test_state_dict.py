@@ -222,3 +222,70 @@ class TestAsyncCheckpointer:
         tree, _ = AsyncCheckpointer.load(str(tmp_path / "s.ckpt"), rank=3)
         np.testing.assert_array_equal(np.asarray(tree["x"]), np.ones(2, np.float32))
         ckpt.close()
+
+
+class TestStripedWrites:
+    def test_striped_container_byte_identical(self, tmp_path):
+        """A 4-way striped write produces the SAME file as the sequential one
+        (pwrite-at-offset into one container), so readers never change."""
+        rng = np.random.default_rng(0)
+        arrays = [
+            np.asarray(rng.standard_normal(s), np.float32)
+            for s in [(64, 64), (7,), (128, 3), (1,), (33, 5), (256,)]
+        ]
+        p1 = str(tmp_path / "seq.ckpt")
+        p4 = str(tmp_path / "striped.ckpt")
+        ckpt_format.write_payload(p1, b"hollow", arrays, meta={"it": 1}, stripes=1)
+        ckpt_format.write_payload(p4, b"hollow", arrays, meta={"it": 1}, stripes=4)
+        with open(p1, "rb") as f1, open(p4, "rb") as f4:
+            assert f1.read() == f4.read()
+        hollow, tensors, meta = ckpt_format.read_payload(p4)
+        assert hollow == b"hollow" and meta == {"it": 1}
+        for got, want in zip(tensors, arrays):
+            np.testing.assert_array_equal(got, want)
+
+    def test_stripes_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ckpt_format.STRIPES_ENV, "3")
+        assert ckpt_format._effective_stripes(None) == 3
+        monkeypatch.setenv(ckpt_format.STRIPES_ENV, "bogus")
+        assert ckpt_format._effective_stripes(None) == 1
+        assert ckpt_format._effective_stripes(4) == 4
+
+    def test_striped_blob_roundtrip(self, tmp_path):
+        blob = np.random.default_rng(1).integers(0, 255, 3 << 20, np.uint8).tobytes()
+        path = str(tmp_path / "blob.bin")
+        ckpt_format.write_blob(path, blob, stripes=4)
+        with open(path, "rb") as f:
+            assert f.read() == blob
+
+
+class TestSeparationHint:
+    def test_routed_file_and_merged_load(self, tmp_path):
+        tree = {
+            "params": {"w": np.ones((4, 4), np.float32)},
+            "opt_state": {"m": np.full((4, 4), 2.0, np.float32)},
+            "step": 11,
+        }
+        path = str(tmp_path / "model.ckpt")
+        ckpt = AsyncCheckpointer()
+        ckpt.async_save(tree, path, meta={"it": 11}, separation_hint="opt_state")
+        ckpt.finalize_all()
+        # Two container files: main (params+step) and the routed optimizer file.
+        assert (tmp_path / "model.ckpt").exists()
+        assert (tmp_path / "model.opt_state.ckpt").exists()
+        main_tree, _ = AsyncCheckpointer.load(path)
+        assert "opt_state" not in main_tree
+        merged, meta = AsyncCheckpointer.load(path, separation_hint="opt_state")
+        assert meta == {"it": 11}
+        assert merged["step"] == 11
+        np.testing.assert_array_equal(merged["opt_state"]["m"], tree["opt_state"]["m"])
+        np.testing.assert_array_equal(merged["params"]["w"], tree["params"]["w"])
+
+    def test_hint_requires_mapping_key(self, tmp_path):
+        import pytest
+
+        from tpu_resiliency.exceptions import CheckpointError
+
+        ckpt = AsyncCheckpointer()
+        with pytest.raises(CheckpointError):
+            ckpt.async_save({"a": 1}, str(tmp_path / "x.ckpt"), separation_hint="b")

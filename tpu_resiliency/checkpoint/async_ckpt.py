@@ -32,8 +32,11 @@ from tpu_resiliency.utils.logging import get_logger
 log = get_logger(__name__)
 
 
-def _write_container(path: str, hollow_bytes: bytes, tensors, meta: dict) -> None:
-    ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+def _write_containers(writes) -> None:
+    """Async-part worker (module-level: picklable). Order matters for
+    separation_hint pairs: the LAST write's rename is the commit point."""
+    for path, hollow_bytes, tensors, meta in writes:
+        ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
 
 
 class AsyncCheckpointer:
@@ -54,25 +57,67 @@ class AsyncCheckpointer:
         return pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL)
 
     def async_save(
-        self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None
+        self,
+        tree: Any,
+        path: str,
+        meta: Optional[dict] = None,
+        rank: Optional[int] = None,
+        separation_hint: Optional[str] = None,
     ) -> AsyncRequest:
         """``tree`` may be a raw pytree or an already-hollowed ``PyTreeStateDict``
-        (lets a caller saving to several tiers pay the D2H copy once)."""
-        if isinstance(tree, PyTreeStateDict):
-            sd = tree
-            if not sd.is_hollow:
-                sd.pop_tensors()
-            sd.copy_tensors_to_host()
+        (lets a caller saving to several tiers pay the D2H copy once).
+
+        ``separation_hint``: name of a top-level mapping key (e.g.
+        ``"opt_state"``) routed to its OWN container file ``<base>.<hint><ext>``
+        — the reference's ``separation_hint`` (``filesystem_async.py:558``),
+        letting storage policy differ per content class (keep every model file,
+        prune optimizer files early; put optimizer state on cheaper storage).
+        Requires a raw mapping tree; pass the same hint to :meth:`load`.
+        """
+        if separation_hint is not None:
+            if isinstance(tree, PyTreeStateDict) or not isinstance(tree, dict):
+                raise CheckpointError(
+                    "separation_hint requires a raw mapping tree (got "
+                    f"{type(tree).__name__})"
+                )
+            if separation_hint not in tree:
+                raise CheckpointError(
+                    f"separation_hint {separation_hint!r} not a top-level key "
+                    f"of {sorted(tree)}"
+                )
+            # Hinted file FIRST: the main file's rename is the commit point, so
+            # a crash between the two leaves old-main + new-hinted (stale hinted
+            # is detected at load by the meta cross-check; a NEW main merged
+            # with an OLD optimizer file would be silent corruption).
+            parts = [
+                (
+                    {separation_hint: tree[separation_hint]},
+                    self._hint_path(path, separation_hint),
+                ),
+                ({k: v for k, v in tree.items() if k != separation_hint}, path),
+            ]
         else:
-            sd = PyTreeStateDict(tree)
-            sd.pop_tensors()
-            sd.copy_tensors_to_host()
-        hollow_bytes = self._hollow_bytes(sd)
-        target = self._rank_path(path, rank)
-        req = AsyncRequest(
-            async_fn=_write_container,
-            async_fn_args=(target, hollow_bytes, sd.tensors(), meta or {}),
-        )
+            parts = [(tree, path)]
+        writes = []
+        for part_tree, part_path in parts:
+            if isinstance(part_tree, PyTreeStateDict):
+                sd = part_tree
+                if not sd.is_hollow:
+                    sd.pop_tensors()
+                sd.copy_tensors_to_host()
+            else:
+                sd = PyTreeStateDict(part_tree)
+                sd.pop_tensors()
+                sd.copy_tensors_to_host()
+            writes.append(
+                (
+                    self._rank_path(part_path, rank),
+                    self._hollow_bytes(sd),
+                    sd.tensors(),
+                    meta or {},
+                )
+            )
+        req = AsyncRequest(async_fn=_write_containers, async_fn_args=(writes,))
         self.queue.schedule_async_request(req)
         return req
 
@@ -80,11 +125,15 @@ class AsyncCheckpointer:
         sd = PyTreeStateDict(tree)
         sd.pop_tensors()
         sd.copy_tensors_to_host()
-        _write_container(
-            self._rank_path(path, rank),
-            pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL),
-            sd.tensors(),
-            meta or {},
+        _write_containers(
+            [
+                (
+                    self._rank_path(path, rank),
+                    pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL),
+                    sd.tensors(),
+                    meta or {},
+                )
+            ]
         )
 
     @staticmethod
@@ -95,8 +144,55 @@ class AsyncCheckpointer:
         return f"{base}.r{rank}{ext}"
 
     @staticmethod
-    def load(path: str, rank: Optional[int] = None, shardings=None, device=None) -> tuple[Any, dict]:
-        """Returns (tree, meta); arrays placed per ``shardings``/``device`` if given."""
+    def _hint_path(path: str, hint: str) -> str:
+        base, ext = os.path.splitext(path)
+        return f"{base}.{hint}{ext}"
+
+    @staticmethod
+    def load(
+        path: str,
+        rank: Optional[int] = None,
+        shardings=None,
+        device=None,
+        separation_hint: Optional[str] = None,
+    ) -> tuple[Any, dict]:
+        """Returns (tree, meta); arrays placed per ``shardings``/``device`` if given.
+
+        Pass the ``separation_hint`` the save used to also read the routed file
+        and merge it back under its key (with ``shardings`` as a mapping — keys
+        missing from it, including the hint, get default placement; the flat
+        per-tensor-sequence form cannot be split across two files)."""
+        if separation_hint is not None:
+            shard_rest = shard_hint = None
+            if shardings is not None:
+                if not isinstance(shardings, dict):
+                    raise CheckpointError(
+                        "separation_hint load needs shardings as a mapping "
+                        "(flat per-tensor sequences cannot be split across the "
+                        f"routed files); got {type(shardings).__name__}"
+                    )
+                shard_rest = {
+                    k: v for k, v in shardings.items() if k != separation_hint
+                } or None
+                if separation_hint in shardings:
+                    shard_hint = {separation_hint: shardings[separation_hint]}
+            rest, meta = AsyncCheckpointer.load(
+                path, rank=rank, shardings=shard_rest, device=device
+            )
+            hinted, hint_meta = AsyncCheckpointer.load(
+                AsyncCheckpointer._hint_path(path, separation_hint),
+                rank=rank,
+                shardings=shard_hint,
+                device=device,
+            )
+            if hint_meta != meta:
+                # The pair is written hinted-first / main-last, so unequal metas
+                # mean a torn save (crash between the two renames).
+                raise CheckpointError(
+                    f"separated checkpoint pair is torn: main meta {meta!r} != "
+                    f"{separation_hint} meta {hint_meta!r}"
+                )
+            return {**rest, **hinted}, meta
         target = AsyncCheckpointer._rank_path(path, rank)
         if not os.path.exists(target):
             raise CheckpointError(f"no checkpoint at {target}")

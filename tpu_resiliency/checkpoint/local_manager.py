@@ -53,15 +53,13 @@ class CkptID:
 
 
 def _write_blobs(paths_and_blobs: list[tuple[str, bytes]]) -> None:
-    """Async-part worker: write each blob atomically (module-level: picklable)."""
+    """Async-part worker: write each blob atomically (module-level: picklable).
+
+    Writer parallelism follows the ``$TPU_RESILIENCY_CKPT_STRIPES`` storage-class
+    knob (``format.write_blob``); default is single-stream, the measured winner
+    on plain host storage."""
     for path, blob in paths_and_blobs:
-        tmp = path + ckpt_format.DIRTY_SUFFIX
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        ckpt_format.write_blob(path, blob)
 
 
 class LocalCheckpointManager:
