@@ -39,6 +39,32 @@ def _write_containers(writes) -> None:
         ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
 
 
+def _split_hollow(full: dict, tensors: list, hint: str):
+    """Split a hollowed mapping tree into ``(hinted, rest)`` parts with
+    re-indexed placeholders — ONE batched D2H serves both container files."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpu_resiliency.checkpoint.state_dict import TensorPlaceholder
+
+    parts = []
+    for subtree in ({hint: full[hint]}, {k: v for k, v in full.items() if k != hint}):
+        leaves, treedef = jax.tree_util.tree_flatten(subtree)
+        part_tensors: list = []
+        new_leaves = []
+        for leaf in leaves:
+            if isinstance(leaf, TensorPlaceholder):
+                new_leaves.append(_dc.replace(leaf, index=len(part_tensors)))
+                part_tensors.append(tensors[leaf.index])
+            else:
+                new_leaves.append(leaf)
+        parts.append(
+            (jax.tree_util.tree_unflatten(treedef, new_leaves), part_tensors)
+        )
+    return parts
+
+
 class AsyncCheckpointer:
     """Asynchronous whole-tree save/load with structure caching.
 
@@ -72,51 +98,62 @@ class AsyncCheckpointer:
         — the reference's ``separation_hint`` (``filesystem_async.py:558``),
         letting storage policy differ per content class (keep every model file,
         prune optimizer files early; put optimizer state on cheaper storage).
-        Requires a raw mapping tree; pass the same hint to :meth:`load`.
+        The tree's top level must be a mapping containing the key; pass the same
+        hint to :meth:`load`. The hollow/payload split happens once (one batched
+        D2H) and the parts share a save token, so a crash between the two file
+        renames is detected at load instead of silently merging generations.
         """
-        if separation_hint is not None:
-            if isinstance(tree, PyTreeStateDict) or not isinstance(tree, dict):
-                raise CheckpointError(
-                    "separation_hint requires a raw mapping tree (got "
-                    f"{type(tree).__name__})"
-                )
-            if separation_hint not in tree:
-                raise CheckpointError(
-                    f"separation_hint {separation_hint!r} not a top-level key "
-                    f"of {sorted(tree)}"
-                )
-            # Hinted file FIRST: the main file's rename is the commit point, so
-            # a crash between the two leaves old-main + new-hinted (stale hinted
-            # is detected at load by the meta cross-check; a NEW main merged
-            # with an OLD optimizer file would be silent corruption).
-            parts = [
-                (
-                    {separation_hint: tree[separation_hint]},
-                    self._hint_path(path, separation_hint),
-                ),
-                ({k: v for k, v in tree.items() if k != separation_hint}, path),
-            ]
-        else:
-            parts = [(tree, path)]
-        writes = []
-        for part_tree, part_path in parts:
-            if isinstance(part_tree, PyTreeStateDict):
-                sd = part_tree
-                if not sd.is_hollow:
-                    sd.pop_tensors()
-                sd.copy_tensors_to_host()
-            else:
-                sd = PyTreeStateDict(part_tree)
+        if isinstance(tree, PyTreeStateDict):
+            sd = tree
+            if not sd.is_hollow:
                 sd.pop_tensors()
-                sd.copy_tensors_to_host()
-            writes.append(
+            sd.copy_tensors_to_host()
+        else:
+            sd = PyTreeStateDict(tree)
+            sd.pop_tensors()
+            sd.copy_tensors_to_host()
+        if separation_hint is None:
+            writes = [
                 (
-                    self._rank_path(part_path, rank),
+                    self._rank_path(path, rank),
                     self._hollow_bytes(sd),
                     sd.tensors(),
                     meta or {},
                 )
+            ]
+        else:
+            full = sd.hollow_tree
+            if not isinstance(full, dict) or separation_hint not in full:
+                raise CheckpointError(
+                    f"separation_hint {separation_hint!r} is not a top-level "
+                    f"mapping key of the tree "
+                    f"({sorted(full) if isinstance(full, dict) else type(full).__name__})"
+                )
+            import secrets
+
+            # Identical unique token in both files: a torn pair (crash between
+            # the two renames) has MISMATCHED tokens and load refuses the merge
+            # — user-supplied meta alone can't carry this (meta=None is the
+            # common case, and {} == {} would wave a torn pair through).
+            meta_w = {**(meta or {}), "_pair_token": secrets.token_hex(8)}
+            # Hinted file FIRST: the main file's rename is the commit point.
+            (hint_tree, hint_tensors), (rest_tree, rest_tensors) = _split_hollow(
+                full, sd.tensors(), separation_hint
             )
+            writes = [
+                (
+                    self._rank_path(self._hint_path(path, separation_hint), rank),
+                    pickle.dumps(hint_tree, protocol=pickle.HIGHEST_PROTOCOL),
+                    hint_tensors,
+                    meta_w,
+                ),
+                (
+                    self._rank_path(path, rank),
+                    pickle.dumps(rest_tree, protocol=pickle.HIGHEST_PROTOCOL),
+                    rest_tensors,
+                    meta_w,
+                ),
+            ]
         req = AsyncRequest(async_fn=_write_containers, async_fn_args=(writes,))
         self.queue.schedule_async_request(req)
         return req
@@ -159,9 +196,11 @@ class AsyncCheckpointer:
         """Returns (tree, meta); arrays placed per ``shardings``/``device`` if given.
 
         Pass the ``separation_hint`` the save used to also read the routed file
-        and merge it back under its key (with ``shardings`` as a mapping — keys
-        missing from it, including the hint, get default placement; the flat
-        per-tensor-sequence form cannot be split across two files)."""
+        and merge it back under its key. ``shardings`` must then be a mapping
+        that mirrors the saved tree minus-or-plus the hint key: the hint entry
+        may be omitted (its file gets default placement), every other key must
+        match the main file's tree exactly (the flat per-tensor-sequence form
+        cannot be split across two files)."""
         if separation_hint is not None:
             shard_rest = shard_hint = None
             if shardings is not None:
@@ -186,12 +225,14 @@ class AsyncCheckpointer:
                 device=device,
             )
             if hint_meta != meta:
-                # The pair is written hinted-first / main-last, so unequal metas
-                # mean a torn save (crash between the two renames).
+                # The pair is written hinted-first / main-last with a shared
+                # unique save token, so a mismatch means a torn save (crash
+                # between the two renames).
                 raise CheckpointError(
                     f"separated checkpoint pair is torn: main meta {meta!r} != "
                     f"{separation_hint} meta {hint_meta!r}"
                 )
+            meta = {k: v for k, v in meta.items() if k != "_pair_token"}
             return {**rest, **hinted}, meta
         target = AsyncCheckpointer._rank_path(path, rank)
         if not os.path.exists(target):

@@ -88,22 +88,26 @@ def _pwrite_full(fd: int, view: memoryview, offset: int) -> None:
 
 
 def _partition_by_bytes(arrays, stripes: int):
-    """Contiguous leaf groups balanced by byte count: ``[(offset, array), ...]``
-    per stripe. Contiguity preserves the reader's sequential layout; balance
-    keeps every writer busy to the end."""
+    """Equal BYTE ranges of the concatenated payload: ``[(offset, view), ...]``
+    per stripe. Ranges ignore leaf boundaries (pwrite only sees bytes), so the
+    knob works even when one huge fused-parameter leaf dominates the payload —
+    whole-leaf grouping would leave every other writer idle."""
     total = sum(a.nbytes for a in arrays)
-    target = max(1, total // stripes)
-    groups: list[list[tuple[int, Any]]] = [[]]
-    acc = 0
+    bounds = [total * k // stripes for k in range(stripes + 1)]
+    groups: list[list[tuple[int, memoryview]]] = [[] for _ in range(stripes)]
     off = 0
+    k = 0
     for a in arrays:
-        if acc >= target and len(groups) < stripes:
-            groups.append([])
-            acc = 0
-        groups[-1].append((off, a))
-        acc += a.nbytes
-        off += a.nbytes
-    return groups
+        view = _raw_view(a)
+        start, end = off, off + a.nbytes
+        while start < end:
+            while bounds[k + 1] <= start:
+                k += 1
+            take = min(end, bounds[k + 1]) - start
+            groups[k].append((start, view[start - off : start - off + take]))
+            start += take
+        off = end
+    return [g for g in groups if g]
 
 
 def _leaf_to_numpy(leaf: Any) -> np.ndarray:
@@ -180,8 +184,8 @@ def write_payload(
             groups = _partition_by_bytes(arrays, stripes)
 
             def run(group):
-                for off, a in group:
-                    _pwrite_full(fd, _raw_view(a), base + off)
+                for off, view in group:
+                    _pwrite_full(fd, view, base + off)
 
             with cf.ThreadPoolExecutor(len(groups)) as pool:
                 list(pool.map(run, groups))

@@ -19,7 +19,11 @@ from tpu_resiliency.integrations.loop import (
 )
 from tpu_resiliency.integrations.straggler_callback import StragglerDetectionCallback
 
+# orbax itself loads lazily, at OrbaxCheckpointCallback construction
+from tpu_resiliency.integrations.orbax_adapter import OrbaxCheckpointCallback
+
 __all__ = [
+    "OrbaxCheckpointCallback",
     "Callback",
     "CallbackRunner",
     "LoopContext",

@@ -1,0 +1,99 @@
+"""Orbax-backed checkpoint callback: the loop protocol driving a checkpointer
+this framework did NOT write.
+
+Proof that the callback seam is a real integration surface rather than
+self-referential plumbing (the reference's L5 hooks into a third-party trainer
+the same way: ``ptl_resiliency/local_checkpoint_callback.py:101-203`` plugs its
+checkpointing into PyTorch Lightning's callback protocol). Here the roles
+flip — our :class:`~tpu_resiliency.integrations.loop.Callback` hooks drive
+`orbax.checkpoint.CheckpointManager`, the ecosystem-standard global-tier
+checkpointer for JAX — and the two tiers compose: Orbax as the durable global
+tier, :class:`HierarchicalCheckpointCallback`'s LocalCheckpointManager as the
+fast local tier, both on the same loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+from tpu_resiliency.integrations.loop import Callback, LoopContext
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class OrbaxCheckpointCallback(Callback):
+    """Save the loop's train state through an ``orbax`` ``CheckpointManager``.
+
+    ``to_state_dict`` / ``from_state_dict`` adapt between the loop's train state
+    and the saved pytree (identity by default — same adapter contract as
+    :class:`HierarchicalCheckpointCallback`). Saves are asynchronous (orbax's
+    own async machinery); ``on_train_end`` waits them out.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        every: int,
+        max_to_keep: int = 2,
+        to_state_dict: Callable[[Any], Any] = lambda s: s,
+        from_state_dict: Callable[[Any, Any], Any] = lambda s, loaded: loaded,
+        manager: Optional[Any] = None,
+    ):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.every = every
+        self.to_state_dict = to_state_dict
+        self.from_state_dict = from_state_dict
+        # With an injected manager, directory/max_to_keep are ignored and the
+        # caller keeps ownership (close() won't close what it didn't create).
+        self._owns_manager = manager is None
+        self.manager = manager or ocp.CheckpointManager(
+            os.path.abspath(directory),  # orbax requires absolute paths
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    # -- loop hooks --------------------------------------------------------
+
+    def on_step_end(self, ctx: LoopContext) -> None:
+        if self.every and (ctx.step + 1) % self.every == 0:
+            self.manager.save(
+                ctx.step,
+                args=self._ocp.args.StandardSave(self.to_state_dict(ctx.state)),
+            )
+            log.info(f"orbax save scheduled @ step {ctx.step}")
+
+    def on_train_end(self, ctx: LoopContext) -> None:
+        self.manager.wait_until_finished()
+
+    # -- restore -----------------------------------------------------------
+
+    def latest_step(self) -> int:
+        """Newest saved step, or -1."""
+        step = self.manager.latest_step()
+        return -1 if step is None else int(step)
+
+    def restore_latest(self, ctx: LoopContext) -> bool:
+        """Restore the newest checkpoint into ``ctx.state`` (current state used
+        as the abstract target, so shardings/dtypes are preserved) and advance
+        ``ctx.start_step``. Returns False when nothing is saved yet."""
+        step = self.manager.latest_step()
+        if step is None:
+            return False
+        target = self.to_state_dict(ctx.state)
+        restored = self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(target)
+        )
+        ctx.state = self.from_state_dict(ctx.state, restored)
+        ctx.start_step = int(step) + 1
+        log.info(f"orbax restored step {step}; resuming at {ctx.start_step}")
+        return True
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        if self._owns_manager:
+            self.manager.close()
