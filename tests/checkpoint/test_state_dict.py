@@ -342,3 +342,34 @@ class TestTornPairDetection:
         assert merged["n"] == 7
         np.testing.assert_array_equal(merged["b"]["y"], tree["b"]["y"])
         np.testing.assert_array_equal(merged["a"]["x"], tree["a"]["x"])
+
+
+class TestStripedEdgeCases:
+    def test_single_leaf_payload_stripes(self, tmp_path):
+        """Byte-range striping splits WITHIN one fused-parameter leaf."""
+        arr = [np.arange(1 << 20, dtype=np.float32)]
+        p1, p4 = str(tmp_path / "s1.ckpt"), str(tmp_path / "s4.ckpt")
+        ckpt_format.write_payload(p1, b"h", arr, stripes=1)
+        ckpt_format.write_payload(p4, b"h", arr, stripes=4)
+        with open(p1, "rb") as f1, open(p4, "rb") as f4:
+            assert f1.read() == f4.read()
+
+    def test_all_empty_leaves_striped(self, tmp_path):
+        path = str(tmp_path / "e.ckpt")
+        ckpt_format.write_payload(
+            path, b"h", [np.zeros((0,), np.float32), np.zeros((0,), np.int32)],
+            stripes=4,
+        )
+        hollow, tensors, _ = ckpt_format.read_payload(path)
+        assert [t.size for t in tensors] == [0, 0]
+
+    def test_direct_load_strips_pair_token(self, tmp_path):
+        path = str(tmp_path / "d.ckpt")
+        tree = {"a": {"x": np.ones((2,), np.float32)}, "b": {"y": np.ones((2,), np.float32)}}
+        ckpt = AsyncCheckpointer()
+        ckpt.async_save(tree, path, meta={"it": 4}, separation_hint="b")
+        ckpt.finalize_all()
+        # Loading either file of the pair directly keeps user meta clean.
+        _, meta_main = AsyncCheckpointer.load(path)
+        _, meta_hint = AsyncCheckpointer.load(str(tmp_path / "d.b.ckpt"))
+        assert meta_main == {"it": 4} and meta_hint == {"it": 4}

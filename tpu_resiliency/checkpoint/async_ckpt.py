@@ -215,24 +215,29 @@ class AsyncCheckpointer:
                 } or None
                 if separation_hint in shardings:
                     shard_hint = {separation_hint: shardings[separation_hint]}
+            hint_file = AsyncCheckpointer._hint_path(path, separation_hint)
+            # Tokens come off the raw headers (load() strips them from user
+            # meta): the pair is written hinted-first / main-last with a shared
+            # unique save token, so a mismatch means a torn save (crash between
+            # the two renames).
+            tokens = {}
+            for part in (path, hint_file):
+                target = AsyncCheckpointer._rank_path(part, rank)
+                if os.path.exists(target):
+                    tokens[part] = ckpt_format.read_header(target)["meta"].get(
+                        "_pair_token"
+                    )
+            if len(tokens) == 2 and tokens[path] != tokens[hint_file]:
+                raise CheckpointError(
+                    f"separated checkpoint pair is torn: save tokens differ "
+                    f"({tokens[path]!r} != {tokens[hint_file]!r})"
+                )
             rest, meta = AsyncCheckpointer.load(
                 path, rank=rank, shardings=shard_rest, device=device
             )
-            hinted, hint_meta = AsyncCheckpointer.load(
-                AsyncCheckpointer._hint_path(path, separation_hint),
-                rank=rank,
-                shardings=shard_hint,
-                device=device,
+            hinted, _ = AsyncCheckpointer.load(
+                hint_file, rank=rank, shardings=shard_hint, device=device
             )
-            if hint_meta != meta:
-                # The pair is written hinted-first / main-last with a shared
-                # unique save token, so a mismatch means a torn save (crash
-                # between the two renames).
-                raise CheckpointError(
-                    f"separated checkpoint pair is torn: main meta {meta!r} != "
-                    f"{separation_hint} meta {hint_meta!r}"
-                )
-            meta = {k: v for k, v in meta.items() if k != "_pair_token"}
             return {**rest, **hinted}, meta
         target = AsyncCheckpointer._rank_path(path, rank)
         if not os.path.exists(target):
@@ -241,6 +246,10 @@ class AsyncCheckpointer:
         sd = PyTreeStateDict.from_hollow(
             pickle.loads(hollow_b), tensors, shardings=shardings, device=device
         )
+        # The pair token is save-internal plumbing; user meta stays clean even
+        # when one file of a separated pair is loaded directly. (The hint path
+        # above compares metas BEFORE this strip, tokens included.)
+        meta = {k: v for k, v in meta.items() if k != "_pair_token"}
         return sd.tree, meta
 
     def maybe_finalize(self, blocking: bool = False) -> list[int]:

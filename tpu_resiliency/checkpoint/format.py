@@ -172,7 +172,10 @@ def write_payload(
         f.write(MAGIC)
         f.write(_LEN.pack(len(header_bytes)))
         f.write(header_bytes)
-        if stripes == 1 or len(arrays) < 2:
+        # Byte-range striping splits within leaves, so even a single fused-
+        # parameter leaf stripes; an all-empty payload yields no groups.
+        groups = _partition_by_bytes(arrays, stripes) if stripes > 1 else []
+        if not groups:
             for a in arrays:
                 f.write(_raw_view(a))
         else:
@@ -181,7 +184,6 @@ def write_payload(
             import concurrent.futures as cf
 
             fd = f.fileno()
-            groups = _partition_by_bytes(arrays, stripes)
 
             def run(group):
                 for off, view in group:
