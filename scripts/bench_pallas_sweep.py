@@ -1,0 +1,142 @@
+"""Sweep the Pallas fused-median kernel vs XLA's sort lowering over (W, R) —
+the measured crossover behind ``scoring_pallas.pallas_supported``'s window gate
+(VERDICT r3 item 5).
+
+Run on a real TPU (device-true per-program times via the framework's own
+DeviceTimeProfiler; wall clocks lie on remote-dispatch runtimes):
+
+    python scripts/bench_pallas_sweep.py [--ws 32,64,128,256] [--rs 256,1024,4096]
+
+Prints one table row per (R, W) with loop-mode Pallas, pairwise Pallas (W<=64;
+its [RT,S,W,W] temporaries exceed VMEM beyond that), and XLA times, plus a final
+JSON line with the measured max winning window to export as
+``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
+"""
+
+import argparse
+import json
+import sys
+
+S = 64
+ITERS = 20
+
+
+def measure(r, w, variant):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_resiliency.telemetry import scoring
+    from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.uniform(0.8, 1.2, (r, S, w)).astype(np.float32))
+    counts = jnp.full((r, S), w, jnp.int32)
+    ewma = jnp.ones((r,))
+    hist = jnp.full((r, S), jnp.inf)
+
+    if variant == "xla":
+        def program(d, c, e, h):
+            return scoring.score_round(d, c, e, h)
+    else:
+        from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+        mode = "loop" if variant == "pallas" else "pairwise"
+
+        def program(d, c, e, h):
+            mw = fused_median_weights(d, c, mode=mode)
+            return scoring.score_round(d, c, e, h, medians_and_weights=mw)
+
+    fn = jax.jit(program)
+    out = fn(data, counts, ewma, hist)
+    jax.block_until_ready(out)
+    if jax.default_backend() == "tpu":
+        prof = DeviceTimeProfiler()
+        with prof:
+            for _ in range(ITERS):
+                out = fn(data, counts, out.ewma, hist)
+            jax.block_until_ready(out)
+        for name, st in prof.get_stats().items():
+            if "program" in name:
+                return st["med"] * 1e3
+        raise RuntimeError(f"profiler missed program: {sorted(prof.get_stats())}")
+    import time
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(data, counts, out.ewma, hist)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ITERS * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ws", default="32,64,128,256")
+    ap.add_argument("--rs", default="256,1024,4096")
+    args = ap.parse_args()
+    ws = [int(x) for x in args.ws.split(",")]
+    rs = [int(x) for x in args.rs.split(",")]
+
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # The site-installed TPU plugin force-selects its platform at boot; the
+        # env var alone does not override an already-selected config.
+        jax.config.update("jax_platforms", "cpu")
+
+    backend = jax.default_backend()
+    print(f"backend: {backend} {jax.devices()}", file=sys.stderr)
+    results = {}
+    win_by_w = {w: True for w in ws}
+    for r in rs:
+        for w in ws:
+            row = {}
+            for variant in ("pallas", "pallas-pairwise", "xla"):
+                if variant == "pallas-pairwise" and w > 64:
+                    continue  # quadratic VMEM temporaries exceed budget
+                try:
+                    row[variant] = measure(r, w, variant)
+                except Exception as e:
+                    row[variant] = None
+                    print(f"R={r} W={w} {variant}: FAILED {e!r}"[:200], file=sys.stderr)
+            results[f"{r}x{w}"] = row
+            best_pallas = min(
+                (v for k, v in row.items() if k != "xla" and v is not None),
+                default=None,
+            )
+            verdict = (
+                "pallas" if best_pallas is not None and row.get("xla") is not None
+                and best_pallas < row["xla"] else "xla"
+            )
+            if verdict != "pallas":
+                win_by_w[w] = False
+            cells = "  ".join(
+                f"{k}={v:.3f}ms" if v is not None else f"{k}=FAIL"
+                for k, v in row.items()
+            )
+            print(f"R={r:5d} W={w:4d}: {cells}  -> {verdict}")
+    # The cap must be safe for EVERY rank count: a window qualifies only if
+    # Pallas won at every tested R, and only while all smaller tested windows
+    # also qualified (one noise win past a loss must not raise the cap).
+    max_winning_w = 0
+    for w in sorted(ws):
+        if not win_by_w[w]:
+            break
+        max_winning_w = w
+    print(
+        json.dumps(
+            {
+                "backend": backend,
+                "signals": S,
+                "results_ms": results,
+                "max_winning_window": max_winning_w,
+                "export": f"TPU_RESILIENCY_PALLAS_MAX_WINDOW={max_winning_w}",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

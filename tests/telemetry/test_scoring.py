@@ -175,3 +175,45 @@ def test_pallas_pairwise_mode_matches_loop_mode():
     )
     np.testing.assert_allclose(np.asarray(loop[0]), np.asarray(pair[0]), rtol=1e-6)
     np.testing.assert_allclose(np.asarray(loop[1]), np.asarray(pair[1]), rtol=1e-6)
+
+
+def test_pallas_window_gate(monkeypatch):
+    """Auto-selection must not hand a large-window user the O(W^2) kernel:
+    the gate caps at the measured/modeled crossover, env-overridable once the
+    per-device sweep (scripts/bench_pallas_sweep.py) has run."""
+    from tpu_resiliency.ops import scoring_pallas as sp
+
+    # Shape gating alone (no window): unchanged behavior.
+    assert sp.pallas_supported(32)
+    assert not sp.pallas_supported(33)
+    # Window gating: default crossover cap is 64.
+    assert sp.pallas_supported(32, window=32)
+    assert sp.pallas_supported(32, window=64)
+    assert not sp.pallas_supported(32, window=128)
+    assert not sp.pallas_supported(32, window=256)
+    # Operator encoded a measured crossover.
+    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "128")
+    assert sp.pallas_supported(32, window=128)
+    assert not sp.pallas_supported(32, window=256)
+    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "junk")
+    assert sp.max_auto_window() == sp.DEFAULT_MAX_WINDOW
+
+
+def test_mesh_telemetry_autoselect_respects_window(monkeypatch):
+    """MeshTelemetry(use_pallas=None) on a large window stays on XLA even when
+    the backend claims to be TPU."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_resiliency.telemetry.sharded import MeshTelemetry
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("rank",))
+    try:
+        mt_small = MeshTelemetry(mesh, "rank", n_ranks=32, window=32)
+        mt_large = MeshTelemetry(mesh, "rank", n_ranks=32, window=128)
+    finally:
+        monkeypatch.undo()
+    assert mt_small.use_pallas is True
+    assert mt_large.use_pallas is False

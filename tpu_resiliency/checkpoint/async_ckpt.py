@@ -216,40 +216,41 @@ class AsyncCheckpointer:
                 if separation_hint in shardings:
                     shard_hint = {separation_hint: shardings[separation_hint]}
             hint_file = AsyncCheckpointer._hint_path(path, separation_hint)
-            # Tokens come off the raw headers (load() strips them from user
-            # meta): the pair is written hinted-first / main-last with a shared
-            # unique save token, so a mismatch means a torn save (crash between
-            # the two renames).
-            tokens = {}
-            for part in (path, hint_file):
-                target = AsyncCheckpointer._rank_path(part, rank)
-                if os.path.exists(target):
-                    tokens[part] = ckpt_format.read_header(target)["meta"].get(
-                        "_pair_token"
-                    )
-            if len(tokens) == 2 and tokens[path] != tokens[hint_file]:
+            # Compare the RAW metas of the very files being merged (tokens
+            # included): the pair is written hinted-first / main-last with a
+            # shared unique save token, so any mismatch — token or user meta —
+            # means the two files are not from the same save (a crash between
+            # the renames, or a concurrent save finalizing mid-load).
+            rest, meta_raw = AsyncCheckpointer._load_file(
+                AsyncCheckpointer._rank_path(path, rank), shard_rest, device
+            )
+            hinted, hint_raw = AsyncCheckpointer._load_file(
+                AsyncCheckpointer._rank_path(hint_file, rank), shard_hint, device
+            )
+            if hint_raw != meta_raw:
                 raise CheckpointError(
-                    f"separated checkpoint pair is torn: save tokens differ "
-                    f"({tokens[path]!r} != {tokens[hint_file]!r})"
+                    f"separated checkpoint pair is torn: main meta {meta_raw!r} "
+                    f"!= {separation_hint} meta {hint_raw!r}"
                 )
-            rest, meta = AsyncCheckpointer.load(
-                path, rank=rank, shardings=shard_rest, device=device
-            )
-            hinted, _ = AsyncCheckpointer.load(
-                hint_file, rank=rank, shardings=shard_hint, device=device
-            )
+            meta = {k: v for k, v in meta_raw.items() if k != "_pair_token"}
             return {**rest, **hinted}, meta
-        target = AsyncCheckpointer._rank_path(path, rank)
+        tree, meta_raw = AsyncCheckpointer._load_file(
+            AsyncCheckpointer._rank_path(path, rank), shardings, device
+        )
+        # The pair token is save-internal plumbing; user meta stays clean even
+        # when one file of a separated pair is loaded directly.
+        return tree, {k: v for k, v in meta_raw.items() if k != "_pair_token"}
+
+    @staticmethod
+    def _load_file(target: str, shardings, device) -> tuple[Any, dict]:
+        """One container read; returns the RAW meta (token intact — the hint
+        path's torn-pair comparison needs it)."""
         if not os.path.exists(target):
             raise CheckpointError(f"no checkpoint at {target}")
         hollow_b, tensors, meta = ckpt_format.read_payload(target)
         sd = PyTreeStateDict.from_hollow(
             pickle.loads(hollow_b), tensors, shardings=shardings, device=device
         )
-        # The pair token is save-internal plumbing; user meta stays clean even
-        # when one file of a separated pair is loaded directly. (The hint path
-        # above compares metas BEFORE this strip, tokens included.)
-        meta = {k: v for k, v in meta.items() if k != "_pair_token"}
         return sd.tree, meta
 
     def maybe_finalize(self, blocking: bool = False) -> list[int]:

@@ -103,11 +103,43 @@ def _median_weights_pairwise_kernel(data_ref, counts_ref, med_ref, weight_ref):
     _write_median_and_weight(data, counts, valid, rank, med_ref, weight_ref)
 
 
-def pallas_supported(n_ranks: int, rank_tile: int | None = None, mode: str = "loop") -> bool:
+#: Largest window the Pallas kernel auto-selects for. Rank-counting is O(W²)
+#: against XLA's O(W log W) sort: from the measured W=32 point (4.31 ms Pallas
+#: vs 8.43 ms XLA, device-true), the scaling model T_pallas∝W², T_xla∝W·logW
+#: puts the crossover between 64 and 128 — so the default cap is 64, the
+#: largest predicted-winning size. ``scripts/bench_pallas_sweep.py`` measures
+#: the real crossover per device; operators encode its result via
+#: ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
+DEFAULT_MAX_WINDOW = 64
+MAX_WINDOW_ENV = "TPU_RESILIENCY_PALLAS_MAX_WINDOW"
+
+
+def max_auto_window() -> int:
+    import os
+
+    try:
+        return int(os.environ.get(MAX_WINDOW_ENV, DEFAULT_MAX_WINDOW))
+    except ValueError:
+        return DEFAULT_MAX_WINDOW
+
+
+def pallas_supported(
+    n_ranks: int,
+    rank_tile: int | None = None,
+    mode: str = "loop",
+    window: int | None = None,
+) -> bool:
     """Shape gate for auto-selection: the kernel tiles the rank axis, so the
     per-shard rank count must be a whole number of tiles (or fit in one). Pass the
     same ``mode`` (and ``rank_tile``, if overridden) that will be given to
-    :func:`fused_median_weights` — the modes default to different tiles."""
+    :func:`fused_median_weights` — the modes default to different tiles.
+
+    ``window``: when given, also gate on the measured/modeled O(W²) crossover
+    (:data:`DEFAULT_MAX_WINDOW`, env-overridable) — beyond it the XLA sort
+    lowering wins and auto-selection must not hand a W=128 user a silent
+    quadratic blowup."""
+    if window is not None and window > max_auto_window():
+        return False
     if rank_tile is None:
         rank_tile = 32 if mode == "loop" else 8
     tile = min(rank_tile, n_ranks)

@@ -125,14 +125,16 @@ class MeshTelemetry:
 
         if use_pallas is None:
             # The fused Pallas window reduction beats XLA's sort lowering 2x on
-            # TPU (device-true measurement, BASELINE.md); other backends can't
-            # run the kernel, and the kernel tiles the rank axis so incompatible
-            # per-shard rank counts fall back to the shape-generic XLA path.
+            # TPU at the default window (device-true measurement, BASELINE.md);
+            # other backends can't run the kernel, the kernel tiles the rank
+            # axis so incompatible per-shard rank counts fall back to the
+            # shape-generic XLA path, and windows past the O(W²) crossover stay
+            # on XLA (scoring_pallas.DEFAULT_MAX_WINDOW).
             from tpu_resiliency.ops.scoring_pallas import pallas_supported
 
             use_pallas = (
                 jax.default_backend() == "tpu"
-                and pallas_supported(self.n_ranks // axis_size)
+                and pallas_supported(self.n_ranks // axis_size, window=self.window)
             )
         self.use_pallas = use_pallas
         self._row_sharding = NamedSharding(mesh, P(axis))
