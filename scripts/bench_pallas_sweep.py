@@ -77,14 +77,11 @@ def main():
     ws = [int(x) for x in args.ws.split(",")]
     rs = [int(x) for x in args.rs.split(",")]
 
-    import os
-
     import jax
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # The site-installed TPU plugin force-selects its platform at boot; the
-        # env var alone does not override an already-selected config.
-        jax.config.update("jax_platforms", "cpu")
+    from tpu_resiliency.platform.device import apply_platform_env
+
+    apply_platform_env()
 
     backend = jax.default_backend()
     print(f"backend: {backend} {jax.devices()}", file=sys.stderr)

@@ -146,6 +146,19 @@ def make_mesh(axis_shapes: dict[str, int], *, devices: Optional[Sequence[Any]] =
     return Mesh(arr, names)
 
 
+def apply_platform_env() -> None:
+    """Make ``$JAX_PLATFORMS`` authoritative even under a site-installed plugin.
+
+    Plugin boot code (sitecustomize) may force-select its platform via
+    ``jax.config`` at interpreter start, after which the env var alone no longer
+    wins. Scripts that honor ``JAX_PLATFORMS=cpu`` (benches, tools) call this
+    once before any backend use."""
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def device_liveness_probe(timeout: float = 30.0, device=None) -> bool:
     """Check the accelerator still executes and completes work.
 
