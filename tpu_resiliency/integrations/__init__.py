@@ -21,9 +21,11 @@ from tpu_resiliency.integrations.straggler_callback import StragglerDetectionCal
 
 # orbax itself loads lazily, at OrbaxCheckpointCallback construction
 from tpu_resiliency.integrations.orbax_adapter import OrbaxCheckpointCallback
+from tpu_resiliency.integrations.preemption import PreemptionCheckpointCallback
 
 __all__ = [
     "OrbaxCheckpointCallback",
+    "PreemptionCheckpointCallback",
     "Callback",
     "CallbackRunner",
     "LoopContext",
