@@ -40,6 +40,9 @@ def main() -> int:
     import numpy as np
 
     from tpu_resiliency.models import transformer as tfm
+    from tpu_resiliency.platform.device import apply_platform_env
+
+    apply_platform_env()
 
     cfg = tfm.TransformerConfig(
         vocab_size=args.vocab,
@@ -100,6 +103,12 @@ def main() -> int:
                 "unit": "tokens/s",
                 "ms_per_step": round(per_step * 1e3, 2),
                 "final_loss": round(float(loss), 4),
+                "backend": jax.default_backend(),
+                "mfu_vs_v5e_peak": round(
+                    # 6*N*tokens/s FLOPs vs v5e bf16 peak 197 TFLOP/s — only
+                    # meaningful when backend == tpu.
+                    6 * n_params * tokens_per_s / 197e12, 4
+                ),
             }
         )
     )
