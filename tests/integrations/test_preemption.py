@@ -61,6 +61,9 @@ CHILD = textwrap.dedent(
 
     print(f"READY {os.getpid()}", flush=True)
     ctx = run_training(step_fn, {"w": jnp.zeros(())}, num_steps=400, callbacks=[cb])
+    # Coordinator-last teardown: without it, a peer's atexit disconnect races
+    # the coordinator service's death and LOG(FATAL)s the peer.
+    jdist.shutdown_graceful(proc_id, grace=3.0)
     print(
         "PREEMPT-RESULT "
         + json.dumps({"rank": proc_id, "stopped_at": ctx.step,
@@ -93,7 +96,13 @@ def test_one_rank_notice_synchronizes_all_saves(tmp_path):
         # warmup take a couple of seconds; steps are 0.05 s and the horizon is
         # 400 steps, so the notice lands mid-run with wide margin either way).
         time.sleep(6.0)
-        assert procs[0].poll() is None and procs[1].poll() is None
+        for r, p in enumerate(procs):
+            if p.poll() is not None:
+                out, err = p.communicate(timeout=10)
+                raise AssertionError(
+                    f"rank {r} died during warmup (rc={p.returncode}):\n"
+                    f"{out}\n{err[-3000:]}"
+                )
         procs[1].send_signal(signal.SIGTERM)  # the preemption notice
         results = {}
         for r, p in enumerate(procs):

@@ -1,5 +1,7 @@
 """CLI plumbing: ft-param extraction, script-arg boundaries, endpoint locality."""
 
+import os
+
 from tpu_resiliency.launcher.launch import (
     endpoint_is_local,
     extract_ft_params,
@@ -44,3 +46,99 @@ def test_endpoint_is_local():
 
     assert endpoint_is_local(socket.gethostname())
     assert not endpoint_is_local("some-other-host.invalid")
+
+
+def test_standalone_module_run(tmp_path):
+    """--standalone --module: ephemeral private store, one node, python -m worker
+    (reference --standalone/--module)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    pkg = tmp_path / "trainmod.py"
+    pkg.write_text(
+        textwrap.dedent(
+            f"""
+            import os
+            with open(r"{tmp_path}/mod_out.txt", "w") as f:
+                f.write(os.environ["WORLD_SIZE"] + ":" + __name__)
+            """
+        )
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tmp_path) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--module", "--nproc-per-node", "1",
+         "--no-ft-monitors", "--rdzv-last-call", "0.2",
+         "--run-dir", str(tmp_path / "run"), "trainmod"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=str(tmp_path),
+    )
+    assert r.returncode == 0, r.stderr
+    # Ran as a module: __name__ is __main__ under -m.
+    assert (tmp_path / "mod_out.txt").read_text() == "1:__main__"
+
+
+def test_module_excludes_no_python(tmp_path):
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--module", "--no-python", "x"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+    assert "mutually exclusive" in r.stderr
+
+
+def test_rdzv_id_isolates_jobs_on_shared_store(tmp_path):
+    """Two concurrent single-node jobs share one store endpoint but different
+    --rdzv-id: neither sees the other's rendezvous (reference --rdzv-id)."""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    from tpu_resiliency.platform.store import KVServer
+
+    # Externally hosted store (python -m tpu_resiliency.platform.store in prod):
+    # it outlives both jobs, which a job-hosted store does not.
+    server = KVServer(host="127.0.0.1", port=0)
+    port = server.port
+    script = tmp_path / "job.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import os, sys, time
+            time.sleep(1.0)  # overlap the two jobs
+            with open(r"{tmp_path}/job_" + sys.argv[1] + ".txt", "w") as f:
+                f.write(os.environ["WORLD_SIZE"])
+            """
+        )
+    )
+    env = dict(os.environ)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+             "--nproc-per-node", "1", "--rdzv-endpoint", f"127.0.0.1:{port}",
+             "--rdzv-id", name, "--no-ft-monitors", "--rdzv-last-call", "0.2",
+             "--run-dir", str(tmp_path / f"run_{name}"),
+             str(script), name],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path),
+        )
+        for name in ("jobA", "jobB")
+    ]
+    try:
+        for name, p in zip(("jobA", "jobB"), procs):
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"{name}:\n{err}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.close()
+    # Each job formed its OWN single-node world (no cross-job rendezvous merge).
+    assert (tmp_path / "job_jobA.txt").read_text() == "1"
+    assert (tmp_path / "job_jobB.txt").read_text() == "1"

@@ -35,6 +35,12 @@ class PreemptionCheckpointCallback(Callback):
 
     ``stop_on_preemption=False`` keeps training (save-and-continue — useful when
     the scheduler sometimes cancels the reclamation).
+
+    After the loop stops, tear jax.distributed down coordinator-last before
+    process exit — :func:`platform.distributed.shutdown_ordered` (store-backed,
+    deterministic) or :func:`shutdown_graceful` (store-free) — or a peer's
+    atexit disconnect can race the coordinator's death and terminate that peer
+    with a spurious fatal.
     """
 
     def __init__(

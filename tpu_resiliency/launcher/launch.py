@@ -59,6 +59,18 @@ def build_parser() -> argparse.ArgumentParser:
         "become spares (the reference's redundancy list)",
     )
     p.add_argument("--rdzv-endpoint", default="127.0.0.1:29511", help="host:port of the store")
+    p.add_argument(
+        "--rdzv-id",
+        default="default",
+        help="job identity namespacing the coordination state: two jobs sharing "
+        "one store server never see each other's rendezvous (reference --rdzv-id)",
+    )
+    p.add_argument(
+        "--standalone",
+        action="store_true",
+        help="single-node convenience: host the store on an ephemeral local port "
+        "and pin --nnodes 1 (reference --standalone)",
+    )
     p.add_argument("--node-id", default="", help="stable node identity (default: generated)")
     p.add_argument("--max-restarts", type=int, default=3)
     p.add_argument(
@@ -96,7 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="run the script as a raw executable instead of through the interpreter",
     )
-    p.add_argument("script", help="training script (plus its args)")
+    p.add_argument(
+        "--module",
+        "-m",
+        action="store_true",
+        help="treat the positional as a python module (python -m NAME), "
+        "reference --module",
+    )
+    p.add_argument("script", help="training script or module (plus its args)")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p
 
@@ -107,6 +126,9 @@ _STORE_TRUE_FLAGS = {
     "--upscaling-enabled",
     "--no-ft-monitors",
     "--no-python",
+    "--module",
+    "-m",
+    "--standalone",
     "-h",
     "--help",
 }
@@ -175,7 +197,9 @@ def endpoint_is_local(host: str) -> bool:
     return bool(ep_ips & local_ips)
 
 
-def host_or_connect_store(endpoint: str) -> tuple[CoordStore, Optional[KVServer], str, int]:
+def host_or_connect_store(
+    endpoint: str, rdzv_id: str = "default"
+) -> tuple[CoordStore, Optional[KVServer], str, int]:
     """Bind the KVServer on the endpoint port when the endpoint IS this machine and
     the port is free; otherwise connect as a client.
 
@@ -196,7 +220,10 @@ def host_or_connect_store(endpoint: str) -> tuple[CoordStore, Optional[KVServer]
             client_host = "127.0.0.1"
         except OSError:
             client_host = "127.0.0.1"
-    store = CoordStore(client_host, port, prefix=STORE_PREFIX, auth_key=auth_key)
+    # rdzv_id namespaces every launcher key: two jobs sharing one store server
+    # never see each other's rendezvous/agent state (reference --rdzv-id).
+    prefix = STORE_PREFIX + (f"{rdzv_id}/" if rdzv_id != "default" else "")
+    store = CoordStore(client_host, port, prefix=prefix, auth_key=auth_key)
     return store, server, client_host, port
 
 
@@ -212,20 +239,58 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     ft_cfg = FaultToleranceConfig.from_args(ft_ns, base=base_ft)
 
+    # Flag validation first: reject before ANY side effects (env mutation,
+    # store hosting).
+    if args.module and args.no_python:
+        log.error("--module and --no-python are mutually exclusive")
+        return 2
+    if args.standalone and (
+        args.rdzv_endpoint != "127.0.0.1:29511" or args.nnodes != "1"
+    ):
+        # Silently discarding an explicit endpoint/nnodes would strand the other
+        # nodes at a rendezvous this job never joins.
+        log.error("--standalone conflicts with explicit --rdzv-endpoint/--nnodes")
+        return 2
+
     if args.events_file:
         # One exported variable wires the whole tree: the agent records through it
         # and every spawned worker/monitor inherits it (events.py env sink).
         os.environ[EVENTS_FILE_ENV] = os.path.abspath(args.events_file)
 
+    if args.standalone:
+        # Single-node convenience (reference --standalone): private ephemeral
+        # store, one node — no rendezvous configuration needed.
+        args.rdzv_endpoint = "127.0.0.1:0"
+        args.nnodes = "1"
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
-    store, server, store_host, store_port = host_or_connect_store(args.rdzv_endpoint)
+    store, server, store_host, store_port = host_or_connect_store(
+        args.rdzv_endpoint, rdzv_id=args.rdzv_id
+    )
+    # Cross-job registry OUTSIDE any rdzv-id namespace: which jobs are on this
+    # endpoint. Powers the hosted-store teardown warning (a job-hosted server
+    # dies with its job; other --rdzv-id jobs need to know why they lost it).
+    import time as time_mod
+    import uuid
+
+    jobs_reg = CoordStore(
+        store_host, store_port, prefix="launcher-jobs/",
+        auth_key=os.environ.get(AUTH_KEY_ENV) or None,
+    )
+    job_token = f"{args.rdzv_id}/{uuid.uuid4().hex[:8]}"
+    try:
+        jobs_reg.set(job_token, time_mod.time())
+    except Exception:
+        pass
     # Workers reach the store through the agent-visible address: if we host it,
     # that's this machine; remote workers of other agents use their agent's view.
     endpoint_host = args.rdzv_endpoint.partition(":")[0] or "127.0.0.1"
     worker_store_host = "127.0.0.1" if server is not None else endpoint_host
 
+    worker_argv = [args.script] + list(args.script_args)
+    if args.module:
+        worker_argv = ["-m"] + worker_argv
     cfg = AgentConfig(
-        argv=[args.script] + list(args.script_args),
+        argv=worker_argv,
         nproc_per_node=args.nproc_per_node,
         min_nodes=min_nodes,
         max_nodes=max_nodes,
@@ -261,6 +326,30 @@ def main(argv: Optional[list[str]] = None) -> int:
                 agent.rdzv.await_peers_exit()
             except Exception:
                 pass
+            # An in-process-hosted store dies with this job (same lifetime as
+            # torchrun's agent-hosted c10d store). Other --rdzv-id jobs on this
+            # endpoint cannot hold us open — warn so their failures aren't
+            # mysterious; host the store externally
+            # (python -m tpu_resiliency.platform.store) for multi-job endpoints.
+            try:
+                foreign = {
+                    k.split("/")[0]
+                    for k in jobs_reg.prefix_get("")
+                    if not k.startswith(f"{args.rdzv_id}/")
+                }
+                if foreign:
+                    log.warning(
+                        f"closing the job-hosted store with other rdzv-id jobs "
+                        f"still registered ({sorted(foreign)[:5]}); host the "
+                        f"store externally to outlive this job"
+                    )
+            except Exception:
+                pass
+        try:
+            jobs_reg.delete(job_token)
+        except Exception:
+            pass
+        jobs_reg.close()
         store.close()
         if server is not None:
             server.close()

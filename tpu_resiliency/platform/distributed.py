@@ -156,6 +156,31 @@ def shutdown_ordered(
         log.warning(f"shutdown_ordered: coordinator shutdown failed: {e!r}")
 
 
+def shutdown_graceful(process_id: int, grace: float = 5.0) -> None:
+    """End-of-job teardown WITHOUT a coordination store: non-coordinator ranks
+    disconnect immediately; the coordinator idles ``grace`` seconds before
+    tearing its service down, so a peer's slightly-later disconnect RPC cannot
+    LOG(FATAL) that peer at interpreter exit (recoverable clients have no
+    synchronized shutdown barrier — see :func:`shutdown_ordered`, which is
+    deterministic and preferred when a KV store is available). Typical use: the
+    exit path after :class:`PreemptionCheckpointCallback` stops the loop.
+    Never raises."""
+    import time as _time
+
+    import jax
+
+    if not client_active():
+        return
+    try:
+        # Only the coordinator waits, and only when peers exist whose late
+        # disconnects its service must outlive (single-process worlds skip it).
+        if process_id == 0 and jax.process_count() > 1:
+            _time.sleep(grace)
+        jax.distributed.shutdown()
+    except Exception as e:
+        log.warning(f"shutdown_graceful: {e!r}")
+
+
 def shutdown_for_restart() -> bool:
     """Tear down the distributed client/service AND the XLA backends so a later
     :func:`initialize` with a different world is legal.

@@ -1105,3 +1105,31 @@ def store_addr_from_env() -> tuple[str, int]:
     host = os.environ.get("TPU_RESILIENCY_STORE_HOST", os.environ.get("MASTER_ADDR", "127.0.0.1"))
     port = int(os.environ.get("TPU_RESILIENCY_STORE_PORT", os.environ.get("MASTER_PORT", "29511")))
     return host, port
+
+
+def _serve_forever(argv: Optional[list[str]] = None) -> int:
+    """Standalone store server: ``python -m tpu_resiliency.platform.store
+    [HOST:]PORT`` — a coordination store that OUTLIVES any one job, for
+    multi-job endpoints (``tpu-ft-launcher --rdzv-id``) where a job-hosted
+    store would die with the first job to finish. Runs until SIGTERM/SIGINT."""
+    import argparse
+    import signal as _signal
+
+    ap = argparse.ArgumentParser(description=_serve_forever.__doc__)
+    ap.add_argument("endpoint", nargs="?", default="127.0.0.1:29511")
+    args = ap.parse_args(argv)
+    host, _, port_s = args.endpoint.rpartition(":")
+    server = KVServer(host=host or "127.0.0.1", port=int(port_s))
+    print(f"store serving on {server.host}:{server.port}", flush=True)
+    done = threading.Event()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        _signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    server.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(_serve_forever())
