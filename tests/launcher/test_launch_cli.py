@@ -142,3 +142,52 @@ def test_rdzv_id_isolates_jobs_on_shared_store(tmp_path):
     # Each job formed its OWN single-node world (no cross-job rendezvous merge).
     assert (tmp_path / "job_jobA.txt").read_text() == "1"
     assert (tmp_path / "job_jobB.txt").read_text() == "1"
+
+
+def test_standalone_conflicts_with_explicit_rdzv():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--rdzv-endpoint", "host0:29511", "x.py"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+    assert "--standalone conflicts" in r.stderr
+
+
+def test_standalone_store_server_entry():
+    """`python -m tpu_resiliency.platform.store HOST:0`: serves, answers a
+    client, exits 0 on SIGTERM — the external store for multi-job endpoints."""
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpu_resiliency.platform.store", "127.0.0.1:0"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        line = ""
+        while time.monotonic() < deadline:
+            line = p.stdout.readline()
+            if "store serving on" in line or not line:
+                break  # announced, or child stdout hit EOF (startup crash)
+        assert "store serving on" in line, (
+            f"server never announced (rc={p.poll()}): {line!r}\n{p.stderr.read()[-2000:]}"
+        )
+        port = int(line.rsplit(":", 1)[1])
+        from tpu_resiliency.platform.store import CoordStore
+
+        c = CoordStore("127.0.0.1", port, timeout=10.0)
+        c.set("k", 42)
+        assert c.get("k", timeout=5.0) == 42
+        c.close()
+        p.send_signal(signal.SIGTERM)
+        assert p.wait(timeout=15) == 0
+    finally:
+        if p.poll() is None:
+            p.kill()
