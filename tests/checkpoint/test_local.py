@@ -10,6 +10,7 @@ import pickle
 
 import numpy as np
 import pytest
+from hypothesis import given as hyp_given, settings as hyp_settings, strategies as hyp_st
 
 from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
@@ -505,3 +506,29 @@ class TestLazyCliqueReplication:
         results = run_ranks(world, body, timeout=60.0)
         assert results[0] == {0: "blob-0", 1: "blob-1"}
         assert results[1] == {0: "blob-0", 1: "blob-1"}
+
+
+class TestGroupSequenceProperties:
+    """Hypothesis invariants for the remainder-folding clique math — the logic a
+    reassignment bug would corrupt silently."""
+
+    @hyp_settings(max_examples=200, deadline=None)
+    @hyp_given(
+        ranks=hyp_st.sets(hyp_st.integers(0, 500), min_size=1, max_size=64),
+        jump=hyp_st.integers(1, 8),
+        factor=hyp_st.integers(1, 8),
+    )
+    def test_partition_and_no_singletons(self, ranks, jump, factor):
+        from tpu_resiliency.checkpoint.replication import group_sequence_for
+
+        groups = group_sequence_for(ranks, jump, factor)
+        flat = [r for g in groups for r in g]
+        # Exact partition: every active rank in exactly one clique.
+        assert sorted(flat) == sorted(ranks)
+        assert len(flat) == len(set(flat))
+        # No unmirrored rank unless replication is off or world is 1.
+        if factor >= 2 and len(ranks) >= 2:
+            assert all(len(g) >= 2 for g in groups), groups
+        # Full-spacing blocks never exceed jump*factor; folded tails are
+        # bounded by one extra block's worth of members.
+        assert all(len(g) <= jump * factor + factor for g in groups)

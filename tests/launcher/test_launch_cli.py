@@ -165,19 +165,25 @@ def test_standalone_store_server_entry():
     import sys
     import time
 
+    import threading
+
     p = subprocess.Popen(
         [sys.executable, "-m", "tpu_resiliency.platform.store", "127.0.0.1:0"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
+    lines: list = []
+    threading.Thread(
+        target=lambda: lines.extend(p.stdout), daemon=True
+    ).start()  # never block the test thread on the pipe
     try:
         deadline = time.monotonic() + 60
-        line = ""
         while time.monotonic() < deadline:
-            line = p.stdout.readline()
-            if "store serving on" in line or not line:
-                break  # announced, or child stdout hit EOF (startup crash)
-        assert "store serving on" in line, (
-            f"server never announced (rc={p.poll()}): {line!r}\n{p.stderr.read()[-2000:]}"
+            if any("store serving on" in ln for ln in lines) or p.poll() is not None:
+                break
+            time.sleep(0.1)
+        line = next((ln for ln in lines if "store serving on" in ln), "")
+        assert line, (
+            f"server never announced (rc={p.poll()}):\n{''.join(lines)[-2000:]}"
         )
         port = int(line.rsplit(":", 1)[1])
         from tpu_resiliency.platform.store import CoordStore
