@@ -172,15 +172,18 @@ def test_standalone_store_server_entry():
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     lines: list = []
-    threading.Thread(
+    reader = threading.Thread(
         target=lambda: lines.extend(p.stdout), daemon=True
-    ).start()  # never block the test thread on the pipe
+    )
+    reader.start()  # never block the test thread on the pipe
     try:
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             if any("store serving on" in ln for ln in lines) or p.poll() is not None:
                 break
             time.sleep(0.1)
+        if p.poll() is not None:
+            reader.join(2.0)  # drain the crash traceback before formatting
         line = next((ln for ln in lines if "store serving on" in ln), "")
         assert line, (
             f"server never announced (rc={p.poll()}):\n{''.join(lines)[-2000:]}"
