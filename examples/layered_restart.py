@@ -31,6 +31,11 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax
+
+from tpu_resiliency.platform.device import apply_platform_env
+
+apply_platform_env()  # the env var alone does not override the TPU plugin's boot config
+
 import jax.numpy as jnp
 
 from tpu_resiliency.inprocess import CallWrapper, Wrapper

@@ -122,6 +122,7 @@ def main() -> int:
 
         procs = []
         outputs: list[list[str]] = []
+        readers: list[threading.Thread] = []
         for r in range(args.world):
             p = subprocess.Popen(
                 [sys.executable, script, str(r), str(args.world), str(jd_port), ckpt_root],
@@ -129,27 +130,31 @@ def main() -> int:
                 env=child_env,
             )
             buf: list[str] = []
-            threading.Thread(target=lambda p=p, b=buf: b.extend(p.stdout),
-                             daemon=True).start()
+            t = threading.Thread(target=lambda p=p, b=buf: b.extend(p.stdout),
+                                 daemon=True)
+            t.start()
             procs.append(p)
             outputs.append(buf)
+            readers.append(t)
         # Deliver the notice only once every rank is PAST jdist.initialize (the
         # preemption handler exists) — a SIGTERM before that just kills the rank.
         deadline = time.monotonic() + 120.0
+        ready = False
         while time.monotonic() < deadline:
-            if all(any(ln.startswith("READY") for ln in b) for b in outputs):
-                break
-            if any(p.poll() is not None for p in procs):
+            ready = all(any(ln.startswith("READY") for ln in b) for b in outputs)
+            if ready or any(p.poll() is not None for p in procs):
                 break
             time.sleep(0.2)
-        for r, p in enumerate(procs):
-            if p.poll() is not None:
-                print(f"[parent] rank {r} died during startup (rc={p.returncode}):")
+        if not ready:
+            # Never deliver the notice before the handler exists: a pre-READY
+            # SIGTERM just kills the rank.
+            for r, p in enumerate(procs):
+                state = p.returncode if p.poll() is not None else "hung in startup"
+                print(f"[parent] rank {r} not READY ({state}):")
                 print("".join(outputs[r])[-1500:])
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
-                return 1
+                if p.poll() is None:
+                    p.kill()
+            return 1
         time.sleep(2.0)  # everyone stepping
         print("[parent] delivering preemption notice (SIGTERM) to rank 1")
         procs[min(1, args.world - 1)].send_signal(signal.SIGTERM)
@@ -161,7 +166,7 @@ def main() -> int:
             except subprocess.TimeoutExpired:
                 p.kill()
                 ok = False
-            time.sleep(0.2)  # let the reader thread drain the tail
+            readers[r].join(5.0)  # drain the tail before parsing
             out = "".join(outputs[r])
             got = False
             for ln in out.splitlines():
