@@ -327,3 +327,35 @@ def test_dead_agent_detected_and_spare_promoted(tmp_path):
         server.close()
         if p0.poll() is None:
             p0.kill()
+
+
+def test_resilient_training_example(tmp_path):
+    """The full-stack example (FT heartbeats + straggler sections + hierarchical
+    checkpoints + injected crash) driven by the real launcher: crash in round 0,
+    resume from the local checkpoint in round 1."""
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = launch_async(
+        ["--nproc-per-node", "1", "--rdzv-endpoint", "127.0.0.1:0",
+         "--max-restarts", "2", "--rdzv-last-call", "0.2",
+         "--monitor-interval", "0.1",
+         "--ft-param-initial_rank_heartbeat_timeout", "60",
+         "--ft-param-rank_heartbeat_timeout", "60"],
+        os.path.join(repo, "examples", "resilient_training.py"),
+        tmp_path,
+        extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "TPU_RESILIENCY_LOG_LEVEL": "INFO",
+            "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        },
+        name="resilient",
+    )
+    try:
+        out, err = p.communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        out, err = p.communicate(timeout=30)
+        raise AssertionError(f"launcher wedged:\n{out[-2000:]}\n{err[-2000:]}")
+    assert p.returncode == 0, f"{out[-2000:]}\n{err[-2000:]}"
+    assert "resumed" in out.lower() or "resumed" in err.lower(), (out[-1500:], err[-1500:])
