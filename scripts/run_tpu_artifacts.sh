@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One command for everything that needs a LIVE TPU — run the moment the tunnel
+# recovers (round-4 builder session never saw it up; see BASELINE.md "Pallas
+# window gate" + VERDICT r3 item 1):
+#
+#   ./scripts/run_tpu_artifacts.sh
+#
+# Produces, in repo root:
+#   BENCH_tpu.json            - bench.py headline line (backend must say "tpu")
+#   BENCH_pallas_sweep.json   - W/R crossover table + TPU_RESILIENCY_PALLAS_MAX_WINDOW export
+#   BENCH_model.json          - flagship train-step tokens/s + MFU denominator
+set -u
+cd "$(dirname "$0")/.."
+probe() { timeout 240 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu', d; print('TPU OK', d)"; }
+echo "== probing TPU"
+probe || { echo "TPU unreachable; not falling back to CPU for these artifacts"; exit 1; }
+echo "== bench.py (headline)"
+timeout 3600 python bench.py > BENCH_tpu.json 2> bench_tpu.log && tail -1 BENCH_tpu.json
+echo "== pallas sweep"
+timeout 3600 python scripts/bench_pallas_sweep.py 2> sweep_tpu.log | tee /dev/stderr | tail -1 > BENCH_pallas_sweep.json
+echo "== model denominator"
+timeout 3600 python scripts/bench_model.py 2> model_tpu.log | tail -1 > BENCH_model.json && cat BENCH_model.json
+echo "== done; encode the sweep's TPU_RESILIENCY_PALLAS_MAX_WINDOW export in BASELINE.md"
