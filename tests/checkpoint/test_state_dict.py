@@ -270,9 +270,10 @@ class TestSeparationHint:
         ckpt = AsyncCheckpointer()
         ckpt.async_save(tree, path, meta={"it": 11}, separation_hint="opt_state")
         ckpt.finalize_all()
-        # Two container files: main (params+step) and the routed optimizer file.
+        # Two container files: main (params+step) and the routed optimizer file
+        # (named by the save's pair token).
         assert (tmp_path / "model.ckpt").exists()
-        assert (tmp_path / "model.opt_state.ckpt").exists()
+        assert len(list(tmp_path.glob("model.opt_state.*.ckpt"))) == 1
         main_tree, _ = AsyncCheckpointer.load(path)
         assert "opt_state" not in main_tree
         merged, meta = AsyncCheckpointer.load(path, separation_hint="opt_state")
@@ -309,27 +310,105 @@ class TestStripedDominantLeaf:
 
 
 class TestTornPairDetection:
-    def test_mixed_generations_refused(self, tmp_path):
+    def test_crash_between_renames_keeps_old_pair_loadable(self, tmp_path):
+        """A crash after the new hint file landed but before the main file's
+        commit rename must leave the PREVIOUS generation fully loadable (the
+        r4 advisor's durability finding: fixed-name hints destroyed it)."""
         path = str(tmp_path / "m.ckpt")
         tree1 = {"params": {"w": np.ones((2,), np.float32)}, "opt": {"m": np.zeros((2,), np.float32)}}
-        tree2 = {"params": {"w": np.full((2,), 5.0, np.float32)}, "opt": {"m": np.full((2,), 5.0, np.float32)}}
         ckpt = AsyncCheckpointer()
         ckpt.async_save(tree1, path, separation_hint="opt")
         ckpt.finalize_all()
+        # Simulate the torn window: generation 2's token-named hint file exists,
+        # main never committed (writer died before its rename).
+        ckpt_format.write_payload(
+            str(tmp_path / ("m.opt." + "ab" * 8 + ".ckpt")),
+            b"h",
+            [np.full((2,), 9.0, np.float32)],
+            meta={"_pair_token": "ab" * 8},
+        )
+        merged, _ = AsyncCheckpointer.load(path, separation_hint="opt")
+        np.testing.assert_array_equal(merged["opt"]["m"], tree1["opt"]["m"])
+
+    def test_token_mismatch_refused(self, tmp_path):
+        path = str(tmp_path / "m.ckpt")
+        tree = {"params": {"w": np.ones((2,), np.float32)}, "opt": {"m": np.zeros((2,), np.float32)}}
+        ckpt = AsyncCheckpointer()
+        ckpt.async_save(tree, path, separation_hint="opt")
+        ckpt.finalize_all()
         import shutil
 
-        # Keep generation-1's hinted file; write generation 2; then simulate the
-        # torn state: new main + old hinted.
-        shutil.copy(str(tmp_path / "m.opt.ckpt"), str(tmp_path / "old_opt.ckpt"))
-        ckpt.async_save(tree2, path, separation_hint="opt")
+        # Corrupt: a file at the token-named path whose internal token differs
+        # (take a different save's hint file and drop it on the expected name).
+        (hint_file,) = tmp_path.glob("m.opt.*.ckpt")
+        ckpt.async_save(tree, str(tmp_path / "other.ckpt"), separation_hint="opt")
         ckpt.finalize_all()
-        shutil.copy(str(tmp_path / "old_opt.ckpt"), str(tmp_path / "m.opt.ckpt"))
+        (other_hint,) = tmp_path.glob("other.opt.*.ckpt")
+        shutil.copy(str(other_hint), str(hint_file))
         import pytest as _pytest
 
         from tpu_resiliency.exceptions import CheckpointError
 
         with _pytest.raises(CheckpointError, match="torn"):
             AsyncCheckpointer.load(path, separation_hint="opt")
+
+    def test_superseded_hint_files_pruned_after_commit(self, tmp_path):
+        path = str(tmp_path / "m.ckpt")
+        ckpt = AsyncCheckpointer()
+        for step in range(3):
+            tree = {"params": {"w": np.full((2,), float(step), np.float32)},
+                    "opt": {"m": np.full((2,), float(step), np.float32)}}
+            ckpt.async_save(tree, path, separation_hint="opt")
+            ckpt.finalize_all()
+        # Only the committed generation's hint file survives cleanup.
+        assert len(list(tmp_path.glob("m.opt.*.ckpt"))) == 1
+        merged, _ = AsyncCheckpointer.load(path, separation_hint="opt")
+        np.testing.assert_array_equal(
+            merged["opt"]["m"], np.full((2,), 2.0, np.float32)
+        )
+
+    def test_overlapping_saves_to_same_path_serialize(self, tmp_path):
+        """Back-to-back async saves to one path without an intervening finalize
+        must serialize: they share the .dirty tmp file AND the hint-file
+        cleanup (one save would prune the other's just-written hint)."""
+        path = str(tmp_path / "m.ckpt")
+        ckpt = AsyncCheckpointer()
+        for step in range(4):
+            tree = {"params": {"w": np.full((64,), float(step), np.float32)},
+                    "opt": {"m": np.full((64,), float(step), np.float32)}}
+            ckpt.async_save(tree, path, separation_hint="opt")
+        ckpt.finalize_all()
+        merged, _ = AsyncCheckpointer.load(path, separation_hint="opt")
+        np.testing.assert_array_equal(
+            merged["opt"]["m"], np.full((64,), 3.0, np.float32)
+        )
+        assert len(list(tmp_path.glob("m.opt.*.ckpt"))) == 1
+
+    def test_glob_metachars_in_path_still_pruned(self, tmp_path):
+        sweep = tmp_path / "run[1]"
+        sweep.mkdir()
+        path = str(sweep / "m.ckpt")
+        ckpt = AsyncCheckpointer()
+        for step in range(2):
+            tree = {"a": {"x": np.full((2,), float(step), np.float32)},
+                    "b": {"y": np.full((2,), float(step), np.float32)}}
+            ckpt.async_save(tree, path, separation_hint="b")
+            ckpt.finalize_all()
+        assert len(list(sweep.glob("m.b.*.ckpt"))) == 1
+        merged, _ = AsyncCheckpointer.load(path, separation_hint="b")
+        np.testing.assert_array_equal(merged["b"]["y"], np.full((2,), 1.0, np.float32))
+
+    def test_numpy_meta_round_trips(self, tmp_path):
+        """User meta holding numpy arrays must not break the pair check
+        (dict != on arrays raises ValueError; tokens alone are compared)."""
+        path = str(tmp_path / "m.ckpt")
+        tree = {"a": {"x": np.ones((2,), np.float32)}, "b": {"y": np.ones((2,), np.float32)}}
+        ckpt = AsyncCheckpointer()
+        ckpt.async_save(tree, path, meta={"rng": np.arange(4)}, separation_hint="b")
+        ckpt.finalize_all()
+        merged, meta = AsyncCheckpointer.load(path, separation_hint="b")
+        np.testing.assert_array_equal(meta["rng"], np.arange(4))
+        assert "_pair_token" not in meta
 
     def test_single_d2h_pair_roundtrip_strips_token(self, tmp_path):
         path = str(tmp_path / "t.ckpt")
@@ -371,5 +450,6 @@ class TestStripedEdgeCases:
         ckpt.finalize_all()
         # Loading either file of the pair directly keeps user meta clean.
         _, meta_main = AsyncCheckpointer.load(path)
-        _, meta_hint = AsyncCheckpointer.load(str(tmp_path / "d.b.ckpt"))
+        (hint_file,) = tmp_path.glob("d.b.*.ckpt")
+        _, meta_hint = AsyncCheckpointer.load(str(hint_file))
         assert meta_main == {"it": 4} and meta_hint == {"it": 4}

@@ -157,6 +157,74 @@ def test_standalone_conflicts_with_explicit_rdzv():
     assert "--standalone conflicts" in r.stderr
 
 
+def test_standalone_accepts_equivalent_nnodes_rejects_typed_endpoint():
+    """Explicitness, not literal values, drives the --standalone conflict:
+    `--nnodes 1:1` means one node (accepted); typing even the DEFAULT endpoint
+    conflicts (it would be silently replaced by the ephemeral store)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--rdzv-endpoint", "127.0.0.1:29511", "x.py"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2 and "--standalone conflicts" in r.stderr
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--nnodes", "2", "x.py"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2 and "single node" in r.stderr
+    # --nnodes 1:1 is consistent with --standalone: the job runs (worker exits 0).
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--nnodes", "1:1", "--max-restarts", "0",
+         "-m", "platform"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+
+
+def test_malformed_nnodes_clean_error():
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--nnodes", "2x", "x.py"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 2
+    assert "invalid --nnodes" in r.stderr
+
+
+def test_live_store_on_endpoint_joins_without_bind_stall():
+    """A second agent on a busy shared endpoint must connect as a client
+    immediately (handshake probe), not wait out the 8 s EADDRINUSE window."""
+    import time
+
+    from tpu_resiliency.launcher.launch import host_or_connect_store
+    from tpu_resiliency.platform.store import KVServer, store_answers
+
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        assert store_answers("127.0.0.1", server.port)
+        t0 = time.monotonic()
+        store, second_server, host, port = host_or_connect_store(
+            f"127.0.0.1:{server.port}"
+        )
+        elapsed = time.monotonic() - t0
+        assert second_server is None and port == server.port
+        assert elapsed < 4.0, f"client join stalled {elapsed:.1f}s"
+        store.set("k", 1)
+        assert store.get("k", timeout=5.0) == 1
+        store.close()
+    finally:
+        server.close()
+    assert not store_answers("127.0.0.1", server.port)
+
+
 def test_standalone_store_server_entry():
     """`python -m tpu_resiliency.platform.store HOST:0`: serves, answers a
     client, exits 0 on SIGTERM — the external store for multi-job endpoints."""

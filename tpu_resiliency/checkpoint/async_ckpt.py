@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import time
 from typing import Any, Optional
 
 from tpu_resiliency.checkpoint import format as ckpt_format
@@ -32,11 +33,25 @@ from tpu_resiliency.utils.logging import get_logger
 log = get_logger(__name__)
 
 
-def _write_containers(writes) -> None:
+def _write_containers(writes, cleanup=()) -> None:
     """Async-part worker (module-level: picklable). Order matters for
-    separation_hint pairs: the LAST write's rename is the commit point."""
+    separation_hint pairs: the LAST write's rename is the commit point.
+
+    ``cleanup``: ``(glob_pattern, keep_path)`` pairs processed only AFTER every
+    write committed — prunes superseded token-named hint files. Best-effort: a
+    crash mid-cleanup strands stale files (harmless; next save prunes them),
+    never a loadable generation."""
+    import glob as _glob
+
     for path, hollow_bytes, tensors, meta in writes:
         ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+    for pattern, keep in cleanup:
+        for stale in _glob.glob(pattern):
+            if stale != keep:
+                try:
+                    os.unlink(stale)
+                except OSError:
+                    pass
 
 
 def _split_hollow(full: dict, tensors: list, hint: str):
@@ -75,6 +90,27 @@ class AsyncCheckpointer:
 
     def __init__(self, caller: str = "thread", sync_fn=None):
         self.queue = AsyncCallsQueue(caller=caller, sync_fn=sync_fn)
+        #: schedule idx → the file paths that save touches. Two in-flight saves
+        #: to one path would race on the shared ``.dirty`` tmp file AND the
+        #: hint-file cleanup (one save pruning the other's just-written hint),
+        #: so overlapping targets serialize on the earlier save.
+        self._inflight_paths: dict[int, frozenset] = {}
+
+    def _serialize_conflicting(self, targets: frozenset) -> None:
+        while True:
+            live = set(self.queue.unfinalized_indices)
+            self._inflight_paths = {
+                i: p for i, p in self._inflight_paths.items() if i in live
+            }
+            if not any(targets & paths for paths in self._inflight_paths.values()):
+                return
+            self.queue.maybe_finalize_async_calls(blocking=True)
+            # One blocking call need not drain: a cross-rank sync_fn vetoes
+            # finalization until EVERY rank's write finished, so keep retrying
+            # (briefly backing off the all-reduce) until the conflicting save
+            # is truly gone — scheduling anyway would race on the shared
+            # .dirty tmp file.
+            time.sleep(0.01)
 
     @staticmethod
     def _hollow_bytes(sd: PyTreeStateDict) -> bytes:
@@ -94,14 +130,19 @@ class AsyncCheckpointer:
         (lets a caller saving to several tiers pay the D2H copy once).
 
         ``separation_hint``: name of a top-level mapping key (e.g.
-        ``"opt_state"``) routed to its OWN container file ``<base>.<hint><ext>``
-        — the reference's ``separation_hint`` (``filesystem_async.py:558``),
-        letting storage policy differ per content class (keep every model file,
-        prune optimizer files early; put optimizer state on cheaper storage).
-        The tree's top level must be a mapping containing the key; pass the same
-        hint to :meth:`load`. The hollow/payload split happens once (one batched
-        D2H) and the parts share a save token, so a crash between the two file
-        renames is detected at load instead of silently merging generations.
+        ``"opt_state"``) routed to its OWN container file
+        ``<base>.<hint>.<token><ext>`` — the reference's ``separation_hint``
+        (``filesystem_async.py:558``), letting storage policy differ per content
+        class (keep every model file, prune optimizer files early; put optimizer
+        state on cheaper storage). The tree's top level must be a mapping
+        containing the key; pass the same hint to :meth:`load`. The
+        hollow/payload split happens once (one batched D2H).
+
+        Durability contract: the hint file is named by the save's unique pair
+        token and written FIRST; the main file (whose meta records the token)
+        renames LAST and is the sole commit point. A crash anywhere in between
+        leaves the previous generation's main+hint pair fully loadable — the old
+        token-named hint file is pruned only after the new main file committed.
         """
         if isinstance(tree, PyTreeStateDict):
             sd = tree
@@ -121,6 +162,7 @@ class AsyncCheckpointer:
                     meta or {},
                 )
             ]
+            req = AsyncRequest(async_fn=_write_containers, async_fn_args=(writes,))
         else:
             full = sd.hollow_tree
             if not isinstance(full, dict) or separation_hint not in full:
@@ -131,18 +173,23 @@ class AsyncCheckpointer:
                 )
             import secrets
 
-            # Identical unique token in both files: a torn pair (crash between
-            # the two renames) has MISMATCHED tokens and load refuses the merge
-            # — user-supplied meta alone can't carry this (meta=None is the
-            # common case, and {} == {} would wave a torn pair through).
-            meta_w = {**(meta or {}), "_pair_token": secrets.token_hex(8)}
+            # The token both NAMES the hint file and rides in each meta: the
+            # main file commits last and points at exactly one hint file, so a
+            # crash between the two renames can never shadow or tear the
+            # previous generation — user-supplied meta alone can't carry this
+            # (meta=None is the common case).
+            token = secrets.token_hex(8)
+            meta_w = {**(meta or {}), "_pair_token": token}
             # Hinted file FIRST: the main file's rename is the commit point.
             (hint_tree, hint_tensors), (rest_tree, rest_tensors) = _split_hollow(
                 full, sd.tensors(), separation_hint
             )
+            hint_target = self._rank_path(
+                self._hint_path(path, separation_hint, token), rank
+            )
             writes = [
                 (
-                    self._rank_path(self._hint_path(path, separation_hint), rank),
+                    hint_target,
                     pickle.dumps(hint_tree, protocol=pickle.HIGHEST_PROTOCOL),
                     hint_tensors,
                     meta_w,
@@ -154,8 +201,14 @@ class AsyncCheckpointer:
                     meta_w,
                 ),
             ]
-        req = AsyncRequest(async_fn=_write_containers, async_fn_args=(writes,))
-        self.queue.schedule_async_request(req)
+            cleanup = ((self._hint_glob(path, separation_hint, rank), hint_target),)
+            req = AsyncRequest(
+                async_fn=_write_containers, async_fn_args=(writes, cleanup)
+            )
+        targets = frozenset(w[0] for w in writes)
+        self._serialize_conflicting(targets)
+        idx = self.queue.schedule_async_request(req)
+        self._inflight_paths[idx] = targets
         return req
 
     def save(self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None) -> None:
@@ -181,9 +234,26 @@ class AsyncCheckpointer:
         return f"{base}.r{rank}{ext}"
 
     @staticmethod
-    def _hint_path(path: str, hint: str) -> str:
+    def _hint_path(path: str, hint: str, token: str) -> str:
         base, ext = os.path.splitext(path)
-        return f"{base}.{hint}{ext}"
+        return f"{base}.{hint}.{token}{ext}"
+
+    @staticmethod
+    def _hint_glob(path: str, hint: str, rank: Optional[int]) -> str:
+        """Glob matching every generation's hint file for this (path, hint,
+        rank) — 16 lowercase-hex chars, the exact shape of ``token_hex(8)``,
+        so sibling ranks and other hints never match. The user-controlled parts
+        are glob-escaped: metacharacters in a sweep dir like ``run[1]/`` must
+        match literally, not as character classes."""
+        import glob as _glob
+
+        base, ext = os.path.splitext(path)
+        rank_sfx = "" if rank is None else f".r{rank}"
+        return (
+            _glob.escape(f"{base}.{hint}.")
+            + "[0-9a-f]" * 16
+            + _glob.escape(f"{rank_sfx}{ext}")
+        )
 
     @staticmethod
     def load(
@@ -215,22 +285,32 @@ class AsyncCheckpointer:
                 } or None
                 if separation_hint in shardings:
                     shard_hint = {separation_hint: shardings[separation_hint]}
-            hint_file = AsyncCheckpointer._hint_path(path, separation_hint)
-            # Compare the RAW metas of the very files being merged (tokens
-            # included): the pair is written hinted-first / main-last with a
-            # shared unique save token, so any mismatch — token or user meta —
-            # means the two files are not from the same save (a crash between
-            # the renames, or a concurrent save finalizing mid-load).
+            # The committed main file names its pair: its meta token selects
+            # the one hint file written in the same save, so a crash between
+            # the two renames (new hint landed, old main still committed)
+            # resolves to the OLD, complete pair instead of a torn merge.
             rest, meta_raw = AsyncCheckpointer._load_file(
                 AsyncCheckpointer._rank_path(path, rank), shard_rest, device
             )
-            hinted, hint_raw = AsyncCheckpointer._load_file(
-                AsyncCheckpointer._rank_path(hint_file, rank), shard_hint, device
-            )
-            if hint_raw != meta_raw:
+            token = meta_raw.get("_pair_token")
+            if not isinstance(token, str):
                 raise CheckpointError(
-                    f"separated checkpoint pair is torn: main meta {meta_raw!r} "
-                    f"!= {separation_hint} meta {hint_raw!r}"
+                    f"{path} was not written with separation_hint="
+                    f"{separation_hint!r} (no pair token in its meta)"
+                )
+            hint_file = AsyncCheckpointer._rank_path(
+                AsyncCheckpointer._hint_path(path, separation_hint, token), rank
+            )
+            hinted, hint_raw = AsyncCheckpointer._load_file(
+                hint_file, shard_hint, device
+            )
+            # Compare ONLY the tokens: they are unique per save, so equality is
+            # sufficient — and user meta may hold numpy arrays, whose dict
+            # inequality raises instead of answering.
+            if hint_raw.get("_pair_token") != token:
+                raise CheckpointError(
+                    f"separated checkpoint pair is torn: {hint_file} carries "
+                    f"token {hint_raw.get('_pair_token')!r}, main expects {token!r}"
                 )
             meta = {k: v for k, v in meta_raw.items() if k != "_pair_token"}
             return {**rest, **hinted}, meta

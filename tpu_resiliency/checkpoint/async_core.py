@@ -341,6 +341,13 @@ class AsyncCallsQueue:
     def num_unfinalized_calls(self) -> int:
         return len(self._active)
 
+    @property
+    def unfinalized_indices(self) -> list[int]:
+        """Schedule indices still in flight (FIFO order) — lets callers track
+        per-request bookkeeping across finalize/failure paths without guessing
+        which indices the last finalize consumed."""
+        return [c.idx for c in self._active]
+
     def schedule_async_request(self, req: AsyncRequest) -> int:
         """Run preload synchronously, then hand the async part to a caller."""
         if req.preload_fn is not None:

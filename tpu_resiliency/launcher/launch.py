@@ -27,7 +27,12 @@ import sys
 from typing import Optional
 
 from tpu_resiliency.launcher.agent import AgentConfig, ElasticAgent, WorkersFailed
-from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore, KVServer
+from tpu_resiliency.platform.store import (
+    AUTH_KEY_ENV,
+    CoordStore,
+    KVServer,
+    store_answers,
+)
 from tpu_resiliency.utils.events import EVENTS_FILE_ENV
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
@@ -52,13 +57,18 @@ def build_parser() -> argparse.ArgumentParser:
         allow_abbrev=False,
     )
     p.add_argument("--nproc-per-node", type=int, default=1)
+    # None defaults let the conflict check distinguish "omitted" from "typed the
+    # default value" — main() fills in '1' / '127.0.0.1:29511'.
     p.add_argument(
         "--nnodes",
-        default="1",
+        default=None,
         help="node count, fixed ('2') or elastic range ('MIN:MAX'); surplus joiners "
-        "become spares (the reference's redundancy list)",
+        "become spares (the reference's redundancy list); default 1",
     )
-    p.add_argument("--rdzv-endpoint", default="127.0.0.1:29511", help="host:port of the store")
+    p.add_argument(
+        "--rdzv-endpoint", default=None,
+        help="host:port of the store (default 127.0.0.1:29511)",
+    )
     p.add_argument(
         "--rdzv-id",
         default="default",
@@ -212,14 +222,35 @@ def host_or_connect_store(
     server: Optional[KVServer] = None
     client_host = host or "127.0.0.1"
     if endpoint_is_local(host):
-        try:
-            bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
-            server = KVServer(host=bind_host, port=port, auth_key=auth_key)
-            port = server.port  # resolves port 0 → the ephemeral port actually bound
-            log.info(f"hosting coordination store on :{port}")
-            client_host = "127.0.0.1"
-        except OSError:
-            client_host = "127.0.0.1"
+        # A live store already answering on the port (another job on this
+        # shared endpoint, or an externally hosted server) means connect NOW —
+        # entering the bind path would stall in its EADDRINUSE retry window
+        # before falling back to client mode. Probe loopback first (job-hosted
+        # stores bind it), then the endpoint's own address (an external server
+        # may bind only the machine's non-loopback interface).
+        probe_hosts = ["127.0.0.1"]
+        if host and host not in ("127.0.0.1", "localhost", "0.0.0.0"):
+            probe_hosts.append(host)
+        live_host = next(
+            (
+                h
+                for h in probe_hosts
+                if port != 0 and store_answers(h, port, auth_key=auth_key)
+            ),
+            None,
+        )
+        if live_host is not None:
+            log.info(f"live coordination store on {live_host}:{port}; joining as client")
+            client_host = live_host
+        else:
+            try:
+                bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
+                server = KVServer(host=bind_host, port=port, auth_key=auth_key)
+                port = server.port  # resolves port 0 → the ephemeral port actually bound
+                log.info(f"hosting coordination store on :{port}")
+                client_host = "127.0.0.1"
+            except OSError:
+                client_host = "127.0.0.1"
     # rdzv_id namespaces every launcher key: two jobs sharing one store server
     # never see each other's rendezvous/agent state (reference --rdzv-id).
     prefix = STORE_PREFIX + (f"{rdzv_id}/" if rdzv_id != "default" else "")
@@ -244,13 +275,26 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.module and args.no_python:
         log.error("--module and --no-python are mutually exclusive")
         return 2
-    if args.standalone and (
-        args.rdzv_endpoint != "127.0.0.1:29511" or args.nnodes != "1"
-    ):
-        # Silently discarding an explicit endpoint/nnodes would strand the other
-        # nodes at a rendezvous this job never joins.
-        log.error("--standalone conflicts with explicit --rdzv-endpoint/--nnodes")
+    try:
+        nnodes_spec = parse_nnodes(args.nnodes) if args.nnodes is not None else (1, 1)
+    except ValueError:
+        log.error(f"invalid --nnodes spec {args.nnodes!r}: want N or MIN:MAX")
         return 2
+    if args.standalone:
+        # Silently discarding an explicit endpoint would strand the other nodes
+        # at a rendezvous this job never joins. Explicitness (not the literal
+        # value) decides: typing the default endpoint still conflicts, while any
+        # --nnodes spec meaning exactly one node ('1', '1:1') is consistent.
+        if args.rdzv_endpoint is not None:
+            log.error("--standalone conflicts with explicit --rdzv-endpoint")
+            return 2
+        if nnodes_spec != (1, 1):
+            log.error("--standalone requires a single node (--nnodes 1)")
+            return 2
+    if args.rdzv_endpoint is None:
+        args.rdzv_endpoint = "127.0.0.1:29511"
+    if args.nnodes is None:
+        args.nnodes = "1"
 
     if args.events_file:
         # One exported variable wires the whole tree: the agent records through it

@@ -70,6 +70,22 @@ def _hmac(key: str, nonce: bytes) -> bytes:
     return hmac.new(key.encode(), nonce, hashlib.sha256).digest()
 
 
+def _client_hello(sock: socket.socket, auth_key: str | None) -> None:
+    """Client side of the server hello (+ optional HMAC challenge). The ONE
+    definition of the wire handshake shared by the persistent client and the
+    liveness probe — a protocol change updated in only one place would make
+    ``store_answers`` silently report every live store as dead."""
+    hello = framing.recv_obj(sock, max_frame=1024)
+    if not isinstance(hello, dict) or "auth" not in hello:
+        raise StoreError("malformed store hello")
+    if hello["auth"]:
+        if not auth_key:
+            raise StoreError(
+                f"store requires authentication; set ${AUTH_KEY_ENV} or pass auth_key"
+            )
+        framing.send_obj(sock, {"mac": _hmac(auth_key, hello["nonce"])})
+
+
 @dataclasses.dataclass
 class _Barrier:
     generation: int = 0
@@ -779,15 +795,7 @@ class KVClient:
         raise StoreError(f"cannot connect to store at {self.host}:{self.port}: {last!r}")
 
     def _client_handshake(self, sock: socket.socket) -> None:
-        hello = framing.recv_obj(sock, max_frame=1024)
-        if not isinstance(hello, dict) or "auth" not in hello:
-            raise StoreError("malformed store hello")
-        if hello["auth"]:
-            if not self.auth_key:
-                raise StoreError(
-                    f"store requires authentication; set ${AUTH_KEY_ENV} or pass auth_key"
-                )
-            framing.send_obj(sock, {"mac": _hmac(self.auth_key, hello["nonce"])})
+        _client_hello(sock, self.auth_key)
 
     def close(self) -> None:
         with self._lock:
@@ -1098,6 +1106,39 @@ def host_store(
         port = server.port
     client = CoordStore(host, port, prefix=prefix, timeout=timeout, auth_key=auth_key)
     return client, server
+
+
+def store_answers(
+    host: str, port: int, *, auth_key: str | None = None, timeout: float = 1.0
+) -> bool:
+    """True iff a live :class:`KVServer` completes a handshake and answers
+    ``ping`` within ``timeout``.
+
+    Distinguishes a legitimately live store on a busy port (another job on a
+    shared ``--rdzv-id`` endpoint — connect to it) from a lingering listener
+    mid-teardown, which holds the port but never answers (wait out the bind
+    retry). A would-be client can therefore decide instantly instead of paying
+    the hosting path's EADDRINUSE retry window."""
+    if auth_key is None:
+        auth_key = os.environ.get(AUTH_KEY_ENV) or None
+    try:
+        sock = socket.create_connection((host, port), timeout=timeout)
+    except OSError:
+        return False
+    try:
+        sock.settimeout(timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _client_hello(sock, auth_key)
+        framing.send_obj(sock, {"op": "ping"})
+        resp = framing.recv_obj(sock)
+        return isinstance(resp, dict) and resp.get("value") == "pong"
+    except (OSError, EOFError, ValueError, StoreError):
+        return False
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
 
 
 def store_addr_from_env() -> tuple[str, int]:
