@@ -106,10 +106,11 @@ def device_scoring(data, counts, variant="xla"):
     from tpu_resiliency.telemetry import scoring
     from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
 
-    if variant in ("pallas", "pallas-pairwise"):
+    if variant in ("pallas", "pallas-pairwise", "pallas-radix"):
         from tpu_resiliency.ops.scoring_pallas import fused_median_weights
 
-        mode = "loop" if variant == "pallas" else "pairwise"
+        mode = {"pallas": "loop", "pallas-pairwise": "pairwise",
+                "pallas-radix": "radix"}[variant]
 
         def score_program(d, c, e, h):
             mw = fused_median_weights(d, c, mode=mode)
@@ -356,7 +357,9 @@ def main():
     meas_backends: set = set()
 
     results = {}
-    for name in ["xla"] + (["pallas", "pallas-pairwise"] if on_tpu else []):
+    for name in ["xla"] + (
+        ["pallas", "pallas-pairwise", "pallas-radix"] if on_tpu else []
+    ):
         res = run_variant_subprocess(name)
         if res is not None:
             results[name] = (res["per_step"], res["f1"])

@@ -1,16 +1,22 @@
-"""Sweep the Pallas fused-median kernel vs XLA's sort lowering over (W, R) —
-the measured crossover behind ``scoring_pallas.pallas_supported``'s window gate
-(VERDICT r3 item 5).
+"""Sweep the Pallas fused-median kernels vs XLA's sort lowering over (W, R) —
+the measured data behind ``scoring_pallas`` auto-selection (VERDICT r3 item 5 /
+r4 item 3).
+
+Three kernel formulations are measured: ``loop`` (rank-counting, O(W²)),
+``pairwise`` (all-pairs block, O(W²) VMEM-heavy, W≤64 only), and ``radix``
+(bit-select, O(32·W) — the scaling-safe mode). The JSON tail derives the
+auto-select boundary from the measurements:
+
+- ``loop_max_window``: largest W where the loop kernel is the best variant at
+  every tested R → export as ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW`` (beyond it
+  auto-select runs radix).
+- ``pallas_beats_xla_at``: per-W verdict of best-Pallas vs XLA (the
+  use_pallas gate justification).
 
 Run on a real TPU (device-true per-program times via the framework's own
 DeviceTimeProfiler; wall clocks lie on remote-dispatch runtimes):
 
     python scripts/bench_pallas_sweep.py [--ws 32,64,128,256] [--rs 256,1024,4096]
-
-Prints one table row per (R, W) with loop-mode Pallas, pairwise Pallas (W<=64;
-its [RT,S,W,W] temporaries exceed VMEM beyond that), and XLA times, plus a final
-JSON line with the measured max winning window to export as
-``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
 """
 
 import argparse
@@ -41,7 +47,7 @@ def measure(r, w, variant):
     else:
         from tpu_resiliency.ops.scoring_pallas import fused_median_weights
 
-        mode = "loop" if variant == "pallas" else "pairwise"
+        mode = variant.removeprefix("pallas-")
 
         def program(d, c, e, h):
             mw = fused_median_weights(d, c, mode=mode)
@@ -69,6 +75,9 @@ def measure(r, w, variant):
     return (time.perf_counter() - t0) / ITERS * 1e3
 
 
+VARIANTS = ("pallas-loop", "pallas-pairwise", "pallas-radix", "xla")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ws", default="32,64,128,256")
@@ -86,11 +95,12 @@ def main():
     backend = jax.default_backend()
     print(f"backend: {backend} {jax.devices()}", file=sys.stderr)
     results = {}
-    win_by_w = {w: True for w in ws}
+    loop_best_by_w = {w: True for w in ws}
+    pallas_wins_by_w = {w: True for w in ws}
     for r in rs:
         for w in ws:
             row = {}
-            for variant in ("pallas", "pallas-pairwise", "xla"):
+            for variant in VARIANTS:
                 if variant == "pallas-pairwise" and w > 64:
                     continue  # quadratic VMEM temporaries exceed budget
                 try:
@@ -99,37 +109,55 @@ def main():
                     row[variant] = None
                     print(f"R={r} W={w} {variant}: FAILED {e!r}"[:200], file=sys.stderr)
             results[f"{r}x{w}"] = row
-            best_pallas = min(
-                (v for k, v in row.items() if k != "xla" and v is not None),
-                default=None,
+            pallas_times = {
+                k: v for k, v in row.items() if k != "xla" and v is not None
+            }
+            best_pallas = min(pallas_times.values(), default=None)
+            # THIS row's verdict; the *_by_w flags separately accumulate the
+            # every-R requirement for the exported defaults.
+            row_pallas_wins = (
+                best_pallas is not None
+                and row.get("xla") is not None
+                and best_pallas < row["xla"]
             )
-            verdict = (
-                "pallas" if best_pallas is not None and row.get("xla") is not None
-                and best_pallas < row["xla"] else "xla"
+            if not row_pallas_wins:
+                pallas_wins_by_w[w] = False
+            # The cap governs loop-vs-its-auto-alternatives (radix / XLA);
+            # pairwise is never auto-selected, so it doesn't vote.
+            loop_t = row.get("pallas-loop")
+            loop_ok = (
+                loop_t is not None
+                and (row.get("pallas-radix") is None or loop_t <= row["pallas-radix"])
+                and (row.get("xla") is None or loop_t < row["xla"])
             )
-            if verdict != "pallas":
-                win_by_w[w] = False
+            if not loop_ok:
+                loop_best_by_w[w] = False
             cells = "  ".join(
                 f"{k}={v:.3f}ms" if v is not None else f"{k}=FAIL"
                 for k, v in row.items()
             )
+            verdict = "pallas" if row_pallas_wins else "xla"
             print(f"R={r:5d} W={w:4d}: {cells}  -> {verdict}")
-    # The cap must be safe for EVERY rank count: a window qualifies only if
-    # Pallas won at every tested R, and only while all smaller tested windows
-    # also qualified (one noise win past a loss must not raise the cap).
-    max_winning_w = 0
+    # The loop cap must be safe for EVERY rank count: a window qualifies only
+    # if the loop kernel was the best variant at every tested R, and only while
+    # all smaller tested windows also qualified (one noise win past a loss must
+    # not raise the cap).
+    loop_max_window = 0
     for w in sorted(ws):
-        if not win_by_w[w]:
+        if not loop_best_by_w[w]:
             break
-        max_winning_w = w
+        loop_max_window = w
     print(
         json.dumps(
             {
                 "backend": backend,
                 "signals": S,
                 "results_ms": results,
-                "max_winning_window": max_winning_w,
-                "export": f"TPU_RESILIENCY_PALLAS_MAX_WINDOW={max_winning_w}",
+                "loop_max_window": loop_max_window,
+                "pallas_beats_xla_at": {
+                    str(w): pallas_wins_by_w[w] for w in sorted(ws)
+                },
+                "export": f"TPU_RESILIENCY_PALLAS_MAX_WINDOW={loop_max_window}",
             }
         )
     )

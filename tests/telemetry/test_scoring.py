@@ -177,35 +177,100 @@ def test_pallas_pairwise_mode_matches_loop_mode():
     np.testing.assert_allclose(np.asarray(loop[1]), np.asarray(pair[1]), rtol=1e-6)
 
 
+def test_pallas_radix_mode_matches_loop_mode():
+    """The radix-select formulation is the same function as the rank-counting
+    loop — including empty windows, single samples, and whole-window ties."""
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(11)
+    r, s, w = 16, 8, 16
+    data, counts = _mk_windows(rng, r, s, w)
+    counts[0, 0] = 5
+    counts[2, 3] = 0
+    counts[5, 1] = 1
+    data[7, 2, :] = 1.5  # ties across the whole window
+    data[3, 4, :] = np.float32(1e-30)  # subnormal-adjacent magnitudes
+
+    loop = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="loop"
+    )
+    radix = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="radix"
+    )
+    np.testing.assert_array_equal(np.asarray(loop[0]), np.asarray(radix[0]))
+    np.testing.assert_allclose(np.asarray(loop[1]), np.asarray(radix[1]), rtol=1e-6)
+
+
+def test_pallas_radix_large_window_matches_numpy():
+    """W=128/W=192 (beyond the quadratic cap, incl. non-power-of-two): the radix
+    kernel must agree with numpy's median exactly on the valid prefix."""
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(12)
+    for w in (128, 192):
+        r, s = 8, 4
+        data = rng.uniform(0.5, 2.0, (r, s, w)).astype(np.float32)
+        counts = rng.integers(0, w + 1, (r, s)).astype(np.int32)
+        med, wt = fused_median_weights(
+            jnp.asarray(data), jnp.asarray(counts), rank_tile=8,
+            interpret=True, mode="radix",
+        )
+        med, wt = np.asarray(med), np.asarray(wt)
+        for i in range(r):
+            for j in range(s):
+                n = counts[i, j]
+                if n == 0:
+                    assert med[i, j] == np.inf
+                    assert wt[i, j] == 0.0
+                else:
+                    valid = np.sort(data[i, j, :n])
+                    expect = 0.5 * (valid[(n - 1) // 2] + valid[n // 2])
+                    assert med[i, j] == np.float32(expect), (i, j, n)
+                    np.testing.assert_allclose(wt[i, j], data[i, j, :n].sum(), rtol=1e-5)
+
+
 def test_pallas_window_gate(monkeypatch):
-    """Auto-selection must not hand a large-window user the O(W^2) kernel:
-    the gate caps at the measured/modeled crossover, env-overridable once the
-    per-device sweep (scripts/bench_pallas_sweep.py) has run."""
+    """Auto-selection must not hand a large-window user an O(W^2) kernel: the
+    quadratic modes cap at the measured crossover (env-overridable once the
+    per-device sweep has run); mode-auto switches to radix instead of
+    falling back to the XLA sort."""
     from tpu_resiliency.ops import scoring_pallas as sp
 
     # Shape gating alone (no window): unchanged behavior.
     assert sp.pallas_supported(32)
     assert not sp.pallas_supported(33)
-    # Window gating: default crossover cap is 64.
+    # Mode-auto: past the cap the mode would be radix, but auto-selection
+    # requires the device-measured opt-in; explicit radix always works.
     assert sp.pallas_supported(32, window=32)
-    assert sp.pallas_supported(32, window=64)
     assert not sp.pallas_supported(32, window=128)
-    assert not sp.pallas_supported(32, window=256)
-    # Operator encoded a measured crossover.
-    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "128")
+    assert sp.auto_mode(64) == "loop"
+    assert sp.auto_mode(128) == "radix"
+    monkeypatch.setenv(sp.RADIX_ENV, "on")
     assert sp.pallas_supported(32, window=128)
-    assert not sp.pallas_supported(32, window=256)
+    assert sp.pallas_supported(32, window=256)
+    monkeypatch.delenv(sp.RADIX_ENV)
+    # Explicit quadratic modes stay capped.
+    assert sp.pallas_supported(32, mode="loop", window=64)
+    assert not sp.pallas_supported(32, mode="loop", window=128)
+    assert not sp.pallas_supported(32, mode="pairwise", window=128)
+    assert sp.pallas_supported(32, mode="radix", window=256)
+    # Operator encoded a measured crossover: the loop kernel reaches further.
+    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "128")
+    assert sp.auto_mode(128) == "loop"
+    assert sp.pallas_supported(32, mode="loop", window=128)
+    assert not sp.pallas_supported(32, mode="loop", window=256)
     monkeypatch.setenv(sp.MAX_WINDOW_ENV, "junk")
     assert sp.max_auto_window() == sp.DEFAULT_MAX_WINDOW
 
 
-def test_mesh_telemetry_autoselect_respects_window(monkeypatch):
-    """MeshTelemetry(use_pallas=None) on a large window stays on XLA even when
-    the backend claims to be TPU."""
+def test_mesh_telemetry_autoselect_large_window(monkeypatch):
+    """MeshTelemetry(use_pallas=None) at large windows: XLA until the radix
+    kernel's device measurement is opted in, then the Pallas radix path."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
+    from tpu_resiliency.ops import scoring_pallas as sp
     from tpu_resiliency.telemetry.sharded import MeshTelemetry
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
@@ -213,7 +278,10 @@ def test_mesh_telemetry_autoselect_respects_window(monkeypatch):
     try:
         mt_small = MeshTelemetry(mesh, "rank", n_ranks=32, window=32)
         mt_large = MeshTelemetry(mesh, "rank", n_ranks=32, window=128)
+        monkeypatch.setenv(sp.RADIX_ENV, "on")
+        mt_large_opted = MeshTelemetry(mesh, "rank", n_ranks=32, window=128)
     finally:
         monkeypatch.undo()
     assert mt_small.use_pallas is True
     assert mt_large.use_pallas is False
+    assert mt_large_opted.use_pallas is True

@@ -24,7 +24,9 @@ a 2.0× win, identical F1. It is therefore the **default window reduction on TPU
 the mesh scoring path (``MeshTelemetry(use_pallas=None)`` auto-selects by backend and
 shape via :func:`pallas_supported`); non-TPU backends use the XLA lowering. Earlier
 rounds' conclusions ("loses 100×", then "parity") were wall-clock measurement
-artifacts. Caveat: rank-counting is O(W²) — re-measure before large windows.
+artifacts. Rank-counting is O(W²), so auto-selection caps it at the measured
+window crossover and switches to the O(32·W) radix-select kernel beyond it
+(``auto_mode``); ``scripts/bench_pallas_sweep.py`` measures all three variants.
 """
 
 from __future__ import annotations
@@ -103,15 +105,84 @@ def _median_weights_pairwise_kernel(data_ref, counts_ref, med_ref, weight_ref):
     _write_median_and_weight(data, counts, valid, rank, med_ref, weight_ref)
 
 
-#: Largest window the Pallas kernel auto-selects for. Rank-counting is O(W²)
-#: against XLA's O(W log W) sort: from the measured W=32 point (4.31 ms Pallas
-#: vs 8.43 ms XLA, device-true), the scaling model T_pallas∝W², T_xla∝W·logW
-#: puts the crossover between 64 and 128 — so the default cap is 64, the
-#: largest predicted-winning size. ``scripts/bench_pallas_sweep.py`` measures
-#: the real crossover per device; operators encode its result via
+def _radix_select(x, key, cand0, k):
+    """Exact k-th smallest (0-indexed among ``cand0`` elements) per trailing-W
+    group via MSB-first radix selection on the 32 sort-key bits: 32 masked
+    count-and-narrow passes, O(32·W) — the O(W·log) formulation that keeps the
+    Pallas path winning where rank-counting's O(W²) would hand large windows
+    back to the XLA sort. All remaining candidates after 32 bits share the
+    selected value bit-for-bit, so extraction is a masked min."""
+    def body(i, carry):
+        cand, k = carry
+        bit = 31 - i
+        # Bits of the UNSIGNED order key u = key ^ 0x80000000: bit 31 is the
+        # inverted sign of the signed key; bits 30..0 coincide with key's.
+        bitval = jnp.where(
+            bit == 31,
+            (key >= 0).astype(jnp.int32),
+            jax.lax.shift_right_logical(key, bit) & 1,
+        )
+        zero = cand & (bitval == 0)
+        c0 = jnp.sum(zero.astype(jnp.int32), axis=-1)
+        go_zero = k < c0
+        cand = cand & jnp.where(go_zero[..., None], bitval == 0, bitval == 1)
+        k = jnp.where(go_zero, k, k - c0)
+        return cand, k
+
+    cand, _ = jax.lax.fori_loop(0, 32, body, (cand0, k))
+    return jnp.min(jnp.where(cand, x, jnp.inf), axis=-1)
+
+
+def _median_weights_radix_kernel(data_ref, counts_ref, med_ref, weight_ref):
+    """O(W·log)-class variant: radix-select both median order statistics
+    instead of rank-counting. 64 VPU passes total regardless of W, so it is the
+    auto-selected mode past the loop kernel's measured window cap. Assumes no
+    NaNs (timing windows; invalid slots are masked before keying)."""
+    data = data_ref[:]  # [RT, S, W] f32
+    counts = counts_ref[:]  # [RT, S] i32
+    rt, s, w = data.shape
+
+    pos = jax.lax.broadcasted_iota(jnp.int32, (rt, s, w), dimension=2)
+    valid = pos < counts[:, :, None]
+    x = jnp.where(valid, data, jnp.inf)
+
+    # Monotone float→int32 key: signed comparison of the key matches float
+    # order (non-negatives keep their bits; negatives bit-complement then flip
+    # the sign bit).
+    b = jax.lax.bitcast_convert_type(x, jnp.int32)
+    key = jnp.where(b >= 0, b, jnp.bitwise_xor(jnp.bitwise_not(b), jnp.int32(-(2**31))))
+
+    n = jnp.maximum(counts, 1)
+    lo = _radix_select(x, key, valid, (n - 1) // 2)
+    hi = _radix_select(x, key, valid, n // 2)
+    med = 0.5 * (lo + hi)
+    med_ref[:] = jnp.where(counts > 0, med, jnp.inf)
+    weight_ref[:] = jnp.sum(jnp.where(valid, data, 0.0), axis=2)
+
+
+#: Largest window the O(W²) kernels (loop / pairwise) are auto-selected for;
+#: beyond it auto-selection switches to the radix kernel (O(32·W), no cap)
+#: instead of falling back to the XLA sort. From the measured W=32 point
+#: (4.31 ms loop vs 8.43 ms XLA, device-true) the T∝W² model puts loop's
+#: crossover between 64 and 128 — the default cap is 64, the largest
+#: predicted-winning size. ``scripts/bench_pallas_sweep.py`` measures the real
+#: per-device crossover; operators encode its result via
 #: ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
 DEFAULT_MAX_WINDOW = 64
 MAX_WINDOW_ENV = "TPU_RESILIENCY_PALLAS_MAX_WINDOW"
+
+#: Opt-in for AUTO-selecting the radix kernel past the loop cap (explicit
+#: ``mode="radix"`` always works). Default off: the kernel is CPU-interpret
+#: validated but has no on-device measurement yet — until the sweep artifact
+#: shows it beating the XLA sort at large W, auto-selection must not swap a
+#: user's proven XLA path for an unmeasured kernel. ``run_tpu_artifacts.sh``
+#: runs the sweep; its JSON (``pallas_beats_xla_at``) is the basis for
+#: setting this to "on" (or flipping the in-tree default).
+RADIX_ENV = "TPU_RESILIENCY_PALLAS_RADIX"
+DEFAULT_RADIX_AUTO = False
+
+#: Modes whose work grows quadratically with the window (subject to the cap).
+_QUADRATIC_MODES = ("loop", "pairwise")
 
 
 def max_auto_window() -> int:
@@ -123,27 +194,62 @@ def max_auto_window() -> int:
         return DEFAULT_MAX_WINDOW
 
 
+def radix_auto_enabled() -> bool:
+    import os
+
+    v = os.environ.get(RADIX_ENV)
+    if v is None:
+        return DEFAULT_RADIX_AUTO
+    return v.strip().lower() in ("1", "on", "true", "yes")
+
+
+def auto_mode(window: int) -> str:
+    """Mode choice for an auto-selected Pallas path: the measured-winning
+    quadratic ``loop`` kernel up to the window cap, the scaling-safe ``radix``
+    kernel beyond it."""
+    return "loop" if window <= max_auto_window() else "radix"
+
+
+def default_rank_tile(mode: str) -> int:
+    # pairwise materializes [RT, S, W, W] temporaries — quadratic VMEM, so it
+    # runs at a much smaller rank tile.
+    return 8 if mode == "pairwise" else 32
+
+
 def pallas_supported(
     n_ranks: int,
     rank_tile: int | None = None,
-    mode: str = "loop",
+    mode: str | None = None,
     window: int | None = None,
 ) -> bool:
     """Shape gate for auto-selection: the kernel tiles the rank axis, so the
-    per-shard rank count must be a whole number of tiles (or fit in one). Pass the
-    same ``mode`` (and ``rank_tile``, if overridden) that will be given to
-    :func:`fused_median_weights` — the modes default to different tiles.
+    per-shard rank count must be a whole number of tiles (or fit in one). Pass
+    the same ``mode``/``rank_tile`` that will be given to
+    :func:`fused_median_weights`; ``mode=None`` means :func:`auto_mode` (which
+    needs ``window``).
 
-    ``window``: when given, also gate on the measured/modeled O(W²) crossover
-    (:data:`DEFAULT_MAX_WINDOW`, env-overridable) — beyond it the XLA sort
-    lowering wins and auto-selection must not hand a W=128 user a silent
-    quadratic blowup."""
-    if window is not None and window > max_auto_window():
+    An explicitly quadratic ``mode`` is rejected past the measured window cap —
+    auto-selection must not hand a W=128 user a silent O(W²) blowup. With mode
+    auto, windows past the cap route to the radix kernel only once it is
+    device-measured/opted-in (:func:`radix_auto_enabled`); until then they
+    fall back to the XLA sort."""
+    if mode is None:
+        mode = auto_mode(window) if window is not None else "loop"
+        if mode == "radix" and not radix_auto_enabled():
+            return False
+    elif window is not None and mode in _QUADRATIC_MODES and window > max_auto_window():
         return False
     if rank_tile is None:
-        rank_tile = 32 if mode == "loop" else 8
+        rank_tile = default_rank_tile(mode)
     tile = min(rank_tile, n_ranks)
     return tile > 0 and n_ranks % tile == 0
+
+
+_KERNELS = {
+    "loop": _median_weights_kernel,
+    "pairwise": _median_weights_pairwise_kernel,
+    "radix": _median_weights_radix_kernel,
+}
 
 
 @functools.partial(jax.jit, static_argnames=("rank_tile", "interpret", "mode"))
@@ -153,22 +259,25 @@ def fused_median_weights(
     *,
     rank_tile: int | None = None,
     interpret: bool | None = None,
-    mode: str = "loop",
+    mode: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """``(medians [R,S], weights [R,S])`` from windows ``data [R,S,W]``, ``counts [R,S]``.
 
     Tiled over the rank axis; each grid step holds a ``[rank_tile, S, W]`` block in
     VMEM. ``interpret`` defaults to True off-TPU so tests run on CPU. ``mode``:
-    ``"loop"`` (W sequential rank-counting passes, rank_tile 32) or ``"pairwise"``
+    ``"loop"`` (W rank-counting passes, O(W²), rank_tile 32), ``"pairwise"``
     (one [RT, S, W, W] comparison block, rank_tile 8 for the quadratic VMEM
-    temporaries).
+    temporaries), ``"radix"`` (64 bit-select passes, O(32·W) — scales to large
+    windows), or ``None`` for the measured :func:`auto_mode` by window size.
     """
     r, s, w = data.shape
-    if mode not in ("loop", "pairwise"):
-        raise ValueError(f"unknown mode {mode!r}")
-    kernel = _median_weights_kernel if mode == "loop" else _median_weights_pairwise_kernel
+    if mode is None:
+        mode = auto_mode(w)
+    if mode not in _KERNELS:
+        raise ValueError(f"unknown mode {mode!r}; one of {sorted(_KERNELS)}")
+    kernel = _KERNELS[mode]
     if rank_tile is None:
-        rank_tile = 32 if mode == "loop" else 8
+        rank_tile = default_rank_tile(mode)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     rank_tile = min(rank_tile, r)

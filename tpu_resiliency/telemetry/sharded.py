@@ -126,10 +126,12 @@ class MeshTelemetry:
         if use_pallas is None:
             # The fused Pallas window reduction beats XLA's sort lowering 2x on
             # TPU at the default window (device-true measurement, BASELINE.md);
-            # other backends can't run the kernel, the kernel tiles the rank
-            # axis so incompatible per-shard rank counts fall back to the
-            # shape-generic XLA path, and windows past the O(W²) crossover stay
-            # on XLA (scoring_pallas.DEFAULT_MAX_WINDOW).
+            # other backends can't run the kernel, and the kernel tiles the
+            # rank axis so incompatible per-shard rank counts fall back to the
+            # shape-generic XLA path. Windows past the O(W²) crossover
+            # (scoring_pallas.DEFAULT_MAX_WINDOW) auto-select the radix kernel
+            # once it is device-measured/opted-in ($TPU_RESILIENCY_PALLAS_RADIX),
+            # else stay on XLA.
             from tpu_resiliency.ops.scoring_pallas import pallas_supported
 
             use_pallas = (
