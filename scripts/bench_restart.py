@@ -153,12 +153,16 @@ time.sleep(0.5)
 """
 
 
-def bench_injob() -> dict:
+def bench_injob(warm_spares: int = 0) -> dict:
     """Respawn latency, decomposed from the launcher's own structured event stream
     (wall-clock, same clock as the worker stamps): worker exit → failure detection →
     next rendezvous round closing → respawned worker's first Python statement. The
     last segment is dominated by the environment's interpreter/plugin startup tax,
-    measured separately as a median-of-3 floor with the same env."""
+    measured separately as a median-of-3 floor with the same env.
+
+    ``warm_spares`` > 0 measures the warm path: parked pre-imported
+    interpreters (``launcher/park.py``) serve the restart round, removing the
+    interpreter floor from the critical path."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     floors = []
@@ -181,6 +185,8 @@ def bench_injob() -> dict:
                 "--nproc-per-node", "2", "--max-restarts", "2",
                 "--monitor-interval", "0.1",
                 "--events-file", events,
+                "--warm-spares", str(warm_spares),
+                "--warm-spare-preload", "json",
                 worker, stamps,
             ],
             env=env,
@@ -223,12 +229,16 @@ def main() -> None:
     print(json.dumps({"layer": "in-process", **inproc}))
     injob = bench_injob()
     print(json.dumps({"layer": "in-job", **injob}))
+    injob_warm = bench_injob(warm_spares=2)
+    print(json.dumps({"layer": "in-job-warm", **injob_warm}))
 
     speedup = injob["respawn_ms"] / inproc["faulting_rank_ms"]["median"]
     summary = {
         "in_process": inproc,
         "in_job": injob,
+        "in_job_warm_spares": injob_warm,
         "speedup_in_process_vs_in_job": speedup,
+        "warm_spare_respawn_speedup": injob["respawn_ms"] / injob_warm["respawn_ms"],
     }
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2)
@@ -236,6 +246,7 @@ def main() -> None:
         "metric": "recovery latency: in-process engine (median, faulting rank) vs in-job respawn",
         "in_process_ms": round(inproc["faulting_rank_ms"]["median"], 1),
         "in_job_ms": round(injob["respawn_ms"], 1),
+        "in_job_warm_ms": round(injob_warm["respawn_ms"], 1),
         "speedup": round(speedup, 1),
     }))
 

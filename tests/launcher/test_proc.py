@@ -9,7 +9,8 @@ from tpu_resiliency.launcher.errors import WorkerError, write_error_file
 from tpu_resiliency.launcher.proc import GroupState, WorkerGroup
 
 
-def wait_state(group, want, timeout=30.0):
+def wait_state(group, want, timeout=60.0):  # generous: interpreter startup is
+    # multi-second here and stretches further under suite/soak load
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         state = group.poll()

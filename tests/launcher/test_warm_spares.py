@@ -1,0 +1,212 @@
+"""Warm-spare promotion: parked pre-imported interpreters serve restart rounds
+without paying interpreter+import startup (the BENCH_restart respawn tax the
+reference's cold ``start_processes`` path pays on every round)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+from tpu_resiliency.launcher.park import (
+    PROMOTED_ENV,
+    WarmSparePool,
+    spawn_spare,
+)
+
+
+class TestShim:
+    def _spawn(self, tmp_path, preload="json"):
+        return spawn_spare(str(tmp_path), 0, preload=preload)
+
+    def _wait_warm(self, spare, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if spare.warm:
+                return
+            assert spare.alive, "spare died while parking"
+            time.sleep(0.02)
+        raise AssertionError("spare never became warm")
+
+    def test_unpark_runs_script_with_env_argv_and_logs(self, tmp_path, monkeypatch):
+        script = tmp_path / "w.py"
+        out = tmp_path / "out.json"
+        script.write_text(
+            textwrap.dedent(
+                f"""
+                import json, os, sys
+                print("hello-from-worker")
+                with open({str(out)!r}, "w") as f:
+                    json.dump({{"rank": os.environ["RANK"],
+                               "promoted": os.environ.get({PROMOTED_ENV!r}),
+                               "stale": os.environ.get("TPU_TEST_STALE_VAR"),
+                               "argv": sys.argv[1:]}}, f)
+                """
+            )
+        )
+        # Present in the launcher env at park time but ABSENT from the round
+        # env: must not leak into the promoted worker (Popen(env=...) parity).
+        monkeypatch.setenv("TPU_TEST_STALE_VAR", "leaky")
+        spare = self._spawn(tmp_path)
+        try:
+            self._wait_warm(spare)
+            stdout_path = str(tmp_path / "stdout.log")
+            round_env = {
+                k: v for k, v in os.environ.items() if k != "TPU_TEST_STALE_VAR"
+            }
+            proc = spare.unpark(
+                [str(script), "--flag", "v"],
+                {**round_env, "RANK": "3"},
+                stdout=stdout_path,
+            )
+            assert proc.wait(timeout=30) == 0
+            got = json.loads(out.read_text())
+            assert got == {
+                "rank": "3", "promoted": "1", "stale": None, "argv": ["--flag", "v"],
+            }
+            assert "hello-from-worker" in open(stdout_path).read()
+        finally:
+            spare.kill()
+
+    def test_launcher_death_releases_parked_spare(self, tmp_path):
+        """The pipe EOF tether: a launcher that dies without close() — even
+        while the spare is still importing — must not leak a parked
+        interpreter."""
+        import tpu_resiliency
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(tpu_resiliency.__file__)))
+        parent = tmp_path / "parent.py"
+        parent.write_text(
+            textwrap.dedent(
+                f"""
+                import os, sys
+                sys.path.insert(0, {repo_root!r})
+                from tpu_resiliency.launcher.park import spawn_spare
+                s = spawn_spare({str(tmp_path / "spares")!r}, 0, preload="json")
+                print(s.proc.pid, flush=True)
+                os._exit(1)  # crash without any cleanup
+                """
+            )
+        )
+        r = subprocess.run(
+            [sys.executable, str(parent)], capture_output=True, text=True,
+            timeout=60, env=dict(os.environ), cwd=repo_root,
+        )
+        pid = int(r.stdout.strip())
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return  # spare exited cleanly on EOF
+            time.sleep(0.1)
+        os.kill(pid, 9)
+        raise AssertionError(f"orphaned spare pid {pid} still parked after 30s")
+
+    def test_unpark_module_mode_and_failure_exit(self, tmp_path):
+        spare = self._spawn(tmp_path)
+        try:
+            self._wait_warm(spare)
+            # `-m platform` prints the platform string and exits 0.
+            proc = spare.unpark(["-m", "platform"], dict(os.environ))
+            assert proc.wait(timeout=30) == 0
+        finally:
+            spare.kill()
+        bad = tmp_path / "bad.py"
+        bad.write_text("import sys\nsys.exit(7)\n")
+        spare = self._spawn(tmp_path)
+        try:
+            self._wait_warm(spare)
+            proc = spare.unpark([str(bad)], dict(os.environ))
+            assert proc.wait(timeout=30) == 7
+        finally:
+            spare.kill()
+
+    def test_pool_tops_up_after_reap_plus_promotion(self, tmp_path):
+        """A dead spare reaped in the same acquire() that promotes a warm one
+        must not shrink the pool below size."""
+        pool = WarmSparePool(2, str(tmp_path), preload="json")
+        try:
+            deadline = time.monotonic() + 30
+            while pool.warm_count < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.warm_count == 2
+            # One spare "dies" (warm, so it's not a startup death).
+            pool._spares[0].proc.kill()
+            pool._spares[0].proc.wait(timeout=10)
+            got = pool.acquire()
+            assert got is not None
+            assert len(pool._spares) == 2  # reap + promotion both replaced
+            got.kill()
+        finally:
+            pool.close()
+
+    def test_pool_disables_after_systematic_startup_failure(self, tmp_path):
+        """Doomed preloads (typo'd module) must not respawn dying interpreters
+        forever: the pool notices consecutive startup deaths and disables."""
+        pool = WarmSparePool(1, str(tmp_path), preload="definitely_not_a_module")
+        try:
+            deadline = time.monotonic() + 60
+            while pool.size > 0 and time.monotonic() < deadline:
+                assert pool.acquire() is None
+                time.sleep(0.2)
+            assert pool.size == 0
+            assert pool.acquire() is None
+            assert pool._spares == []
+        finally:
+            pool.close()
+
+    def test_pool_acquire_replenishes_and_closes(self, tmp_path):
+        pool = WarmSparePool(2, str(tmp_path), preload="json")
+        try:
+            deadline = time.monotonic() + 30
+            while pool.warm_count < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.warm_count == 2
+            s1 = pool.acquire()
+            assert s1 is not None
+            s1.kill()
+            # Replenished: back to 2 eventually.
+            deadline = time.monotonic() + 30
+            while pool.warm_count < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.warm_count == 2
+        finally:
+            pool.close()
+        assert pool.warm_count == 0
+
+
+def test_restart_round_promoted_from_warm_spare(tmp_path):
+    """E2E through the real CLI: worker fails once, the restart round's worker
+    is a PROMOTED spare (it sees $TPU_FT_WARM_SPARE), and the job succeeds."""
+    script = tmp_path / "crash_once.py"
+    marker = tmp_path / "crashed"
+    result = tmp_path / "result.json"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import json, os, sys
+            if not os.path.exists({str(marker)!r}):
+                open({str(marker)!r}, "w").close()
+                sys.exit(1)
+            with open({str(result)!r}, "w") as f:
+                json.dump({{"promoted": os.environ.get({PROMOTED_ENV!r}),
+                           "restart": os.environ["TPU_FT_RESTART_COUNT"]}}, f)
+            """
+        )
+    )
+    env = dict(os.environ)
+    env.setdefault("TPU_RESILIENCY_LOG_LEVEL", "INFO")
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+         "--standalone", "--nproc-per-node", "1", "--max-restarts", "2",
+         "--warm-spares", "1", "--warm-spare-preload", "json",
+         "--no-ft-monitors",
+         "--run-dir", str(tmp_path / "run"), str(script)],
+        capture_output=True, text=True, timeout=180, env=env, cwd=str(tmp_path),
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    got = json.loads(result.read_text())
+    assert got["promoted"] == "1", (got, r.stderr[-2000:])
+    assert int(got["restart"]) >= 1

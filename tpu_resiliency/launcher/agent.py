@@ -66,6 +66,11 @@ class AgentConfig:
     enable_ft_monitors: bool = True
     store_host: str = "127.0.0.1"
     store_port: int = 0
+    #: parked pre-imported interpreters kept warm per node: restart rounds
+    #: promote one instead of paying the measured multi-second spawn+import
+    #: serialization (BENCH_restart.json decomposition). 0 disables.
+    warm_spares: int = 0
+    warm_spare_preload: str = "jax"
 
     def __post_init__(self):
         if not self.node_id:
@@ -108,6 +113,7 @@ class ElasticAgent:
         self._launcher_socket = os.path.join(self.cfg.run_dir, "launcher.sock")
         self._restarts_used = 0
         self._last_exitcodes: dict[int, int] = {}
+        self._spare_pool = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -120,6 +126,17 @@ class ElasticAgent:
         self.restarter.initialize()
         prev_round = -1
         try:
+            # Inside the try: an exception anywhere past this point must run
+            # the finally's pool.close() (spares also self-release on the
+            # pipe-EOF tether if this process dies outright).
+            if self.cfg.warm_spares > 0 and self.cfg.use_python:
+                from tpu_resiliency.launcher.park import WarmSparePool
+
+                self._spare_pool = WarmSparePool(
+                    self.cfg.warm_spares,
+                    self.cfg.run_dir,
+                    preload=self.cfg.warm_spare_preload,
+                )
             while True:
                 outcome = self.rdzv.next_round(prev_round)
                 # The restart budget is charged once per restart *round*, whoever
@@ -166,6 +183,8 @@ class ElasticAgent:
             self.rdzv.stop_keepalive()
             if self._ipc is not None:
                 self._ipc.stop()
+            if self._spare_pool is not None:
+                self._spare_pool.close()
 
     # -- spare path --------------------------------------------------------
 
@@ -238,6 +257,7 @@ class ElasticAgent:
             run_dir=cfg.run_dir,
             log_dir=cfg.log_dir,
             use_python=cfg.use_python,
+            spare_pool=self._spare_pool,
         )
         self._start_monitors(outcome.round)
         if self._monitor_sockets:

@@ -102,6 +102,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="agents with keep-alives staler than this are treated as dead",
     )
     p.add_argument("--upscaling-enabled", action="store_true")
+    p.add_argument(
+        "--warm-spares",
+        type=int,
+        default=0,
+        help="parked pre-imported interpreters kept warm per node; restart "
+        "rounds promote one instead of paying interpreter+import startup "
+        "(beats the reference's cold start_processes respawn path)",
+    )
+    p.add_argument(
+        "--warm-spare-preload",
+        default="jax",
+        help="comma-separated modules each warm spare imports while parked",
+    )
     p.add_argument("--term-grace", type=float, default=15.0)
     p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
     p.add_argument(
@@ -353,6 +366,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         enable_ft_monitors=not args.no_ft_monitors,
         store_host=worker_store_host,
         store_port=store_port,
+        warm_spares=args.warm_spares,
+        warm_spare_preload=args.warm_spare_preload,
     )
     agent = ElasticAgent(cfg, ft_cfg, store)
     try:

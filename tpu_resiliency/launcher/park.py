@@ -1,0 +1,301 @@
+"""Warm spare workers: pre-paid interpreter+import cost for restart rounds.
+
+``BENCH_restart.json`` decomposes the in-job respawn tax: of ~4-6 s, nearly all
+is process spawn + interpreter startup, with a measured multi-second
+bare-interpreter floor that *serializes* across concurrent spawns. The
+reference pays the same tax on every restart round (its ``start_processes``
+spawn path, ``_torch_elastic_compat/multiprocessing/api.py``) — this module
+removes it:
+
+- A :class:`WarmSparePool` keeps N **parked interpreters** that have already
+  imported the expensive modules (``jax`` by default) but have NOT initialized
+  any platform/backend state — parking happens strictly before rank assignment,
+  rendezvous, or device use, so a promoted spare is indistinguishable from a
+  fresh interpreter to the workload.
+- On a restart round, ``WorkerGroup.start`` *promotes* a warm spare instead of
+  paying the spawn: the per-round spec (argv, env, log paths) is written down
+  an inherited pipe, and the shim in this module applies it and runs the user
+  script as ``__main__``.
+
+The pipe is also the lifetime tether: a parked shim blocks in ``readline`` (no
+polling, zero CPU while parked) and EOF — the launcher exiting or crashing at
+ANY point, including while the spare is still importing — unparks it straight
+into a clean exit. No leaked interpreters, no ppid watching.
+
+No fork anywhere: each spare is a fresh ``exec``'d interpreter (a forked JAX
+runtime is unusable), merely one that did its imports early.
+
+Promotion parity contract: the shim REPLACES ``os.environ`` with the round env
+(matching ``Popen(env=...)`` semantics of the cold path), points ``sys.argv``
+and ``sys.path[0]`` at the script exactly as ``python script.py`` would (for
+``-m`` workers ``sys.path[0]`` stays the working directory, as
+``python -m`` does), and splices round-env ``PYTHONPATH`` entries that were
+not present at park time into ``sys.path``. One caveat remains by design: an
+env var that a *preloaded* module reads at import time must already be present
+in the launcher's environment (true for ``JAX_PLATFORMS`` workflows here:
+workers re-select platforms at runtime via
+``platform.device.apply_platform_env``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+from typing import Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: exported into a promoted spare's env so workloads/tests can observe promotion
+PROMOTED_ENV = "TPU_FT_WARM_SPARE"
+
+
+# ------------------------------------------------------------------ the shim --
+
+
+def _apply_spec_and_run(spec: dict) -> None:
+    # Replace — not merge — the environment: a var the launcher dropped since
+    # the spare was parked must not survive into the worker (cold workers get
+    # Popen(env=...) replacement semantics; promoted workers must match).
+    os.environ.clear()
+    os.environ.update(spec.get("env", {}))
+    for stream_name, fd in (("stdout", 1), ("stderr", 2)):
+        path = spec.get(stream_name)
+        if path:
+            f = open(path, "ab")
+            os.dup2(f.fileno(), fd)
+
+    import runpy
+
+    # Round-env PYTHONPATH entries the parked interpreter never saw: splice
+    # them in where the cold interpreter would have put them (right after the
+    # argv[0] slot, ahead of site-packages).
+    for p in reversed(
+        [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep) if p]
+    ):
+        if p not in sys.path:
+            sys.path.insert(1, p)
+
+    argv = spec["argv"]
+    if argv and argv[0] == "-m":
+        # `python -m mod`: sys.path[0] is the working directory — which is
+        # exactly what this shim (itself launched via -m) already has there.
+        sys.argv = [argv[1]] + argv[2:]
+        runpy.run_module(argv[1], run_name="__main__", alter_sys=True)
+    else:
+        sys.argv = list(argv)
+        # `python script.py`: sys.path[0] is the script's directory, REPLACING
+        # the -m working-directory entry this interpreter booted with.
+        sys.path[0] = os.path.dirname(os.path.abspath(argv[0]))
+        runpy.run_path(argv[0], run_name="__main__")
+
+
+def _serve_parked(go_fd: int, ready_file: str, preload: str) -> None:
+    """Import the expensive modules, announce readiness, then block on the
+    launcher's pipe until a round spec arrives (or EOF: launcher gone)."""
+    for mod in filter(None, preload.split(",")):
+        __import__(mod)
+    tmp = ready_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(os.getpid()))
+    os.replace(tmp, ready_file)
+
+    with os.fdopen(go_fd, "r") as go:
+        line = go.readline()  # blocks; zero CPU while parked
+    if not line.strip():
+        sys.exit(0)  # EOF/blank: the launcher is gone or released us
+    _apply_spec_and_run(json.loads(line))
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="parked warm-spare worker shim")
+    ap.add_argument("--go-fd", type=int, required=True)
+    ap.add_argument("--ready-file", required=True)
+    ap.add_argument("--preload", default="jax")
+    args = ap.parse_args(argv)
+    _serve_parked(args.go_fd, args.ready_file, args.preload)
+    return 0
+
+
+# ------------------------------------------------------------------ the pool --
+
+
+class ParkedSpare:
+    """One parked interpreter. ``warm`` once its preloads finished; ``unpark``
+    hands it the round spec and it becomes a regular worker process."""
+
+    def __init__(self, proc: subprocess.Popen, go_wfd: int, ready_file: str):
+        self.proc = proc
+        self._go_wfd: Optional[int] = go_wfd
+        self.ready_file = ready_file
+
+    @property
+    def warm(self) -> bool:
+        return self.proc.poll() is None and os.path.exists(self.ready_file)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def unpark(
+        self,
+        argv: list[str],
+        env: dict[str, str],
+        stdout: Optional[str] = None,
+        stderr: Optional[str] = None,
+    ) -> subprocess.Popen:
+        env = dict(env)
+        env[PROMOTED_ENV] = "1"
+        spec = {"argv": list(argv), "env": env, "stdout": stdout, "stderr": stderr}
+        payload = memoryview((json.dumps(spec) + "\n").encode())
+        while payload:
+            n = os.write(self._go_wfd, payload)
+            payload = payload[n:]
+        os.close(self._go_wfd)
+        self._go_wfd = None
+        self._cleanup_files()
+        return self.proc
+
+    def _cleanup_files(self) -> None:
+        try:
+            os.unlink(self.ready_file)
+        except OSError:
+            pass
+
+    def kill(self, grace: float = 2.0) -> None:
+        """Release (EOF → clean exit) with a SIGKILL backstop, and reap."""
+        if self._go_wfd is not None:
+            try:
+                os.close(self._go_wfd)
+            except OSError:
+                pass
+            self._go_wfd = None
+        try:
+            self.proc.wait(timeout=grace if self.proc.poll() is None else 0.1)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(self.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                try:
+                    self.proc.kill()
+                except (ProcessLookupError, PermissionError):
+                    pass
+            try:
+                self.proc.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                log.error(f"parked spare pid {self.proc.pid} unreapable")
+        self._cleanup_files()
+
+
+def spawn_spare(run_dir: str, spare_id: int, preload: str = "jax") -> ParkedSpare:
+    """Spawn one parked shim; the returned spare's pipe write-end is the only
+    handle the launcher needs (spec on promote, close on release)."""
+    os.makedirs(run_dir, exist_ok=True)
+    ready = os.path.join(run_dir, f"ready_{spare_id}")
+    try:
+        os.unlink(ready)
+    except OSError:
+        pass
+    rfd, wfd = os.pipe()
+    try:
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "tpu_resiliency.launcher.park",
+                "--go-fd",
+                str(rfd),
+                "--ready-file",
+                ready,
+                "--preload",
+                preload,
+            ],
+            env=dict(os.environ),
+            start_new_session=True,
+            pass_fds=(rfd,),
+        )
+    except BaseException:
+        os.close(wfd)
+        raise
+    finally:
+        os.close(rfd)
+    return ParkedSpare(proc, wfd, ready)
+
+
+class WarmSparePool:
+    """Keeps ``size`` parked interpreters ready; replenishes on acquire.
+
+    Spawning a spare is a non-blocking ``Popen`` (~ms for the parent); the
+    spare pays its import bill in the background while the current round runs,
+    so by the time a restart needs it the interpreter floor is already paid.
+    """
+
+    def __init__(self, size: int, run_dir: str, preload: str = "jax"):
+        self.size = size
+        self.run_dir = os.path.join(run_dir, "spares")
+        self.preload = preload
+        self._spares: list[ParkedSpare] = []
+        self._next_id = 0
+        self._startup_deaths = 0  # consecutive died-before-warm spares
+        for _ in range(size):
+            self._spawn()
+
+    def _spawn(self) -> None:
+        sid = self._next_id
+        self._next_id += 1
+        self._spares.append(spawn_spare(self.run_dir, sid, self.preload))
+
+    def acquire(self) -> Optional[ParkedSpare]:
+        """A warm spare (removed from the pool), or None — callers fall back to
+        a cold spawn, so a dead/cold pool degrades to exactly the poolless
+        behavior. The pool is topped back up to ``size`` on every call,
+        whatever was reaped or promoted."""
+        live: list[ParkedSpare] = []
+        for s in self._spares:
+            if s.alive:
+                live.append(s)
+                continue
+            # Died before ever becoming warm = its preload/startup failed
+            # (traceback went to the launcher's stderr). A systematic startup
+            # failure (e.g. a typo'd --warm-spare-preload) must not respawn
+            # doomed interpreters on every round forever.
+            died_cold = not os.path.exists(s.ready_file) and s.proc.poll() != 0
+            self._startup_deaths = self._startup_deaths + 1 if died_cold else 0
+            s.kill()  # reap the zombie + remove its ready file
+        self._spares = live
+        if self.size > 0 and self._startup_deaths >= 2 * self.size:
+            log.error(
+                f"warm-spare pool disabled: {self._startup_deaths} spares died "
+                f"during startup (bad --warm-spare-preload={self.preload!r}? "
+                "see the launcher's stderr for their tracebacks); restart "
+                "rounds will cold-spawn"
+            )
+            self.size = 0
+        found: Optional[ParkedSpare] = None
+        for i, spare in enumerate(self._spares):
+            if spare.warm:
+                found = spare
+                del self._spares[i]
+                break
+        while len(self._spares) < self.size:
+            self._spawn()
+        return found
+
+    @property
+    def warm_count(self) -> int:
+        return sum(1 for s in self._spares if s.warm)
+
+    def close(self) -> None:
+        for s in self._spares:
+            s.kill()
+        self._spares = []
+
+
+if __name__ == "__main__":
+    sys.exit(main())

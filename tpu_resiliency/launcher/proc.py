@@ -5,7 +5,10 @@ The lean re-design of the reference's vendored torchelastic multiprocessing laye
 std redirection/tee, ~2000 LoC): one ``subprocess.Popen`` per rank with per-rank
 log files and error files, a non-blocking group poll, and graceful→forceful stop.
 No fork-server indirection — TPU workers are always fresh interpreters (a forked JAX
-runtime is unusable anyway), so plain exec is both simpler and correct.
+runtime is unusable anyway), so plain exec is both simpler and correct. The
+spawn+import tax that exec'ing fresh interpreters costs on *restart* rounds is
+removed by ``park.WarmSparePool`` (pre-imported parked interpreters, promoted
+by ``start`` when available) rather than by forking.
 """
 
 from __future__ import annotations
@@ -78,6 +81,7 @@ class WorkerGroup:
         run_dir: str,
         log_dir: Optional[str] = None,
         use_python: bool = True,
+        spare_pool=None,
     ):
         self.argv = argv
         self.nproc = nproc
@@ -85,6 +89,11 @@ class WorkerGroup:
         self.run_dir = run_dir
         self.log_dir = log_dir
         self.use_python = use_python
+        #: optional launcher-owned ``park.WarmSparePool``: ranks are served by
+        #: promoting parked pre-imported interpreters when one is warm,
+        #: removing the measured multi-second spawn+import tax from restart
+        #: rounds; cold spawn remains the fallback per rank.
+        self.spare_pool = spare_pool if use_python else None
         self.workers: list[Worker] = []
         #: optional callable local_rank -> extra env (e.g. the per-rank monitor socket)
         self.per_rank_env = None
@@ -114,22 +123,44 @@ class WorkerGroup:
                 }
             )
             stdout = stderr = None
+            stdout_path = stderr_path = None
             wlog_dir = None
             if self.log_dir:
                 wlog_dir = os.path.join(self.log_dir, f"round_{round_no}", f"rank_{grank}")
                 os.makedirs(wlog_dir, exist_ok=True)
-                stdout = open(os.path.join(wlog_dir, "stdout.log"), "ab")
-                stderr = open(os.path.join(wlog_dir, "stderr.log"), "ab")
-            # Each worker leads its own session/process group so stop() can signal
-            # the whole tree — a worker's own subprocesses (dataloaders, shell
-            # wrappers) must not outlive it into the next restart round.
-            proc = subprocess.Popen(
-                cmd,
-                env=env,
-                stdout=stdout,
-                stderr=stderr,
-                start_new_session=True,
-            )
+                stdout_path = os.path.join(wlog_dir, "stdout.log")
+                stderr_path = os.path.join(wlog_dir, "stderr.log")
+            spare = self.spare_pool.acquire() if self.spare_pool is not None else None
+            proc = None
+            if spare is not None:
+                # Promote a parked pre-imported interpreter: it applies env and
+                # redirection itself (dup2 on the given paths) and runs the
+                # script as __main__ — no spawn, no import bill.
+                try:
+                    proc = spare.unpark(
+                        self.argv, env, stdout=stdout_path, stderr=stderr_path
+                    )
+                    log.info(f"rank {grank}: promoted warm spare pid {proc.pid}")
+                except OSError:
+                    # The spare died between acquire() and the pipe write
+                    # (EPIPE); fall through to a cold spawn.
+                    spare.kill()
+                    log.warning(f"rank {grank}: warm spare died at promotion; cold spawn")
+            if proc is None:
+                if stdout_path is not None:
+                    stdout = open(stdout_path, "ab")
+                    stderr = open(stderr_path, "ab")
+                # Each worker leads its own session/process group so stop() can
+                # signal the whole tree — a worker's own subprocesses
+                # (dataloaders, shell wrappers) must not outlive it into the
+                # next restart round.
+                proc = subprocess.Popen(
+                    cmd,
+                    env=env,
+                    stdout=stdout,
+                    stderr=stderr,
+                    start_new_session=True,
+                )
             self.workers.append(
                 Worker(
                     local_rank=local,
