@@ -69,6 +69,37 @@ class TestShim:
         finally:
             spare.kill()
 
+    def test_promoted_script_is_registered_main(self, tmp_path):
+        """Pickle parity: a script-level class in a promoted worker must
+        resolve as __main__.<name> (runpy.run_path would leave the shim bound
+        to __main__ and break pickling / multiprocessing-spawn)."""
+        script = tmp_path / "w.py"
+        out = tmp_path / "ok"
+        script.write_text(
+            textwrap.dedent(
+                f"""
+                import pickle, sys
+
+                class Payload:
+                    x = 41
+
+                if __name__ == "__main__":
+                    blob = pickle.dumps(Payload())
+                    assert type(pickle.loads(blob)).x == 41
+                    assert sys.modules["__main__"].__file__ == {str(script)!r}
+                    open({str(out)!r}, "w").close()
+                """
+            )
+        )
+        spare = self._spawn(tmp_path)
+        try:
+            self._wait_warm(spare)
+            proc = spare.unpark([str(script)], dict(os.environ))
+            assert proc.wait(timeout=30) == 0
+            assert out.exists()
+        finally:
+            spare.kill()
+
     def test_launcher_death_releases_parked_spare(self, tmp_path):
         """The pipe EOF tether: a launcher that dies without close() — even
         while the spare is still importing — must not leak a parked

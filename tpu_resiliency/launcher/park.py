@@ -69,8 +69,14 @@ def _apply_spec_and_run(spec: dict) -> None:
             f = open(path, "ab")
             os.dup2(f.fileno(), fd)
 
-    import runpy
-
+    argv = spec["argv"]
+    module_mode = bool(argv) and argv[0] == "-m"
+    if not module_mode:
+        # `python script.py`: sys.path[0] is the script's directory, REPLACING
+        # the -m working-directory entry this interpreter booted with. Done
+        # BEFORE the PYTHONPATH splice so a round entry equal to the launcher
+        # cwd isn't wrongly deduped against that about-to-vanish slot.
+        sys.path[0] = os.path.dirname(os.path.abspath(argv[0]))
     # Round-env PYTHONPATH entries the parked interpreter never saw: splice
     # them in where the cold interpreter would have put them (right after the
     # argv[0] slot, ahead of site-packages).
@@ -80,18 +86,29 @@ def _apply_spec_and_run(spec: dict) -> None:
         if p not in sys.path:
             sys.path.insert(1, p)
 
-    argv = spec["argv"]
-    if argv and argv[0] == "-m":
+    if module_mode:
+        import runpy
+
         # `python -m mod`: sys.path[0] is the working directory — which is
         # exactly what this shim (itself launched via -m) already has there.
         sys.argv = [argv[1]] + argv[2:]
         runpy.run_module(argv[1], run_name="__main__", alter_sys=True)
     else:
+        import types
+
+        # Execute the script in a module REGISTERED as __main__ (runpy.run_path
+        # runs in a throwaway namespace): pickling of script-level classes and
+        # multiprocessing-spawn children resolve __main__ to the user's script,
+        # exactly as under `python script.py`.
+        script = argv[0]
         sys.argv = list(argv)
-        # `python script.py`: sys.path[0] is the script's directory, REPLACING
-        # the -m working-directory entry this interpreter booted with.
-        sys.path[0] = os.path.dirname(os.path.abspath(argv[0]))
-        runpy.run_path(argv[0], run_name="__main__")
+        mod = types.ModuleType("__main__")
+        mod.__file__ = script
+        mod.__dict__["__builtins__"] = __builtins__
+        sys.modules["__main__"] = mod
+        with open(script, "rb") as f:
+            code = compile(f.read(), script, "exec")
+        exec(code, mod.__dict__)
 
 
 def _serve_parked(go_fd: int, ready_file: str, preload: str) -> None:

@@ -28,6 +28,19 @@ from tpu_resiliency.utils.logging import get_logger
 log = get_logger(__name__)
 
 
+def signal_tree(pid: int, sig: int) -> None:
+    """Signal a session-leader's whole process group, falling back to the
+    single pid if the group is already gone. Shared by worker stop and
+    warm-spare teardown (both spawn session leaders)."""
+    try:
+        os.killpg(pid, sig)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            os.kill(pid, sig)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
 class GroupState(enum.Enum):
     RUNNING = "running"
     SUCCEEDED = "succeeded"
@@ -202,15 +215,7 @@ class WorkerGroup:
 
     @staticmethod
     def _signal_tree(pid: int, sig: int) -> None:
-        """Signal the worker's whole process group (it leads one), falling back to
-        the single pid if the group is already gone."""
-        try:
-            os.killpg(pid, sig)
-        except (ProcessLookupError, PermissionError, OSError):
-            try:
-                os.kill(pid, sig)
-            except (ProcessLookupError, PermissionError):
-                pass
+        signal_tree(pid, sig)
 
     def stop(self, grace: float = 15.0, sig: int = int(signal.SIGTERM)) -> None:
         """Graceful stop: `sig` (after SIGCONT, in case a worker is stopped), then
