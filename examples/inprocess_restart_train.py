@@ -44,6 +44,17 @@ def rank_main(rank: int, world: int, port: int, args, result_q) -> None:
     )
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    # Each rank process is a world of exactly --devices-per-rank devices (>1
+    # certifies a surviving MULTI-device world re-entering, not just a lone
+    # device). Must be pinned before the jax import below — and pinned even
+    # for 1, since the caller's own XLA_FLAGS may force a different count.
+    # Only the force-count flag is replaced; other inherited flags survive.
+    kept = [
+        t for t in os.environ.get("XLA_FLAGS", "").split()
+        if not t.startswith("--xla_force_host_platform_device_count")
+    ]
+    kept.append(f"--xla_force_host_platform_device_count={args.devices_per_rank}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
     import jax
 
     if args.cpu:
@@ -95,6 +106,16 @@ def rank_main(rank: int, world: int, port: int, args, result_q) -> None:
             start_step = int(meta["iteration"]) + 1
             print(f"[rank {fs.initial_rank}] resumed from step {start_step}", flush=True)
 
+        batch_sharding = None
+        if args.devices_per_rank > 1:
+            # Shard the batch over this rank's own device mesh: every step the
+            # surviving world completes is a genuinely multi-device program
+            # (XLA partitions the matmuls and inserts the loss reduction).
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            local_mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+            batch_sharding = NamedSharding(local_mesh, P("dp"))
+
         @jax.jit
         def step_fn(params, x, y):
             def loss_fn(p):
@@ -118,6 +139,9 @@ def rank_main(rank: int, world: int, port: int, args, result_q) -> None:
                 os._exit(9)
             x = jnp.asarray(rng.standard_normal((8, 16)), dtype=jnp.float32)
             y = jnp.asarray(rng.standard_normal((8, 1)), dtype=jnp.float32)
+            if batch_sharding is not None:
+                x = jax.device_put(x, batch_sharding)
+                y = jax.device_put(y, batch_sharding)
             params, loss = step_fn(params, x, y)
             call.ping()
             import time as _time
@@ -132,6 +156,7 @@ def rank_main(rank: int, world: int, port: int, args, result_q) -> None:
             "rank": fs.initial_rank,
             "iteration": fs.iteration,
             "active_world": active_world,
+            "local_devices": jax.local_device_count(),
             "final_loss": float(loss) if loss is not None else None,
             "resumed_from": start_step,
         }
@@ -154,6 +179,11 @@ def main() -> int:
     ap.add_argument("--step-time", type=float, default=0.25)
     ap.add_argument("--cpu", action="store_true", default=True)
     ap.add_argument("--ckpt-root", default=None)
+    ap.add_argument(
+        "--devices-per-rank", type=int, default=1,
+        help="virtual devices per rank process: >1 certifies a surviving "
+        "MULTI-device world re-entering after the restart",
+    )
     args = ap.parse_args()
     if args.ckpt_root is None:
         args.ckpt_root = tempfile.mkdtemp(prefix="inproc-example-")
@@ -191,9 +221,18 @@ def main() -> int:
     }
     print("results:", results, flush=True)
     ok = bool(survivors) and all(
-        v["iteration"] >= 1 and v["resumed_from"] > 0 for v in survivors.values()
+        v["iteration"] >= 1
+        and v["resumed_from"] > 0
+        and v["local_devices"] == args.devices_per_rank
+        for v in survivors.values()
     )
-    print("RESTART-RESUME", "OK" if ok else "FAILED", flush=True)
+    n_surv = len(survivors)
+    print(
+        f"RESTART-RESUME {'OK' if ok else 'FAILED'} "
+        f"devices {args.world}x{args.devices_per_rank} -> "
+        f"{n_surv}x{args.devices_per_rank}",
+        flush=True,
+    )
     return 0 if ok else 1
 
 

@@ -54,7 +54,9 @@ CHILD = textwrap.dedent(
         f"127.0.0.1:{jd_port}", num_processes=world, process_id=rank,
         heartbeat_timeout=10.0,
     )
+    import numpy as np
     import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from tpu_resiliency.checkpoint import LocalCheckpointManager, PyTreeStateDict
     from tpu_resiliency.integrations import PreemptionCheckpointCallback
@@ -63,22 +65,32 @@ CHILD = textwrap.dedent(
     print(f"READY {rank}", flush=True)
     mgr = LocalCheckpointManager(ckpt_root, rank=rank)
 
+    # The train state lives SHARDED on this rank's device mesh (the parent
+    # exports 2 virtual devices per rank): the synchronized save captures a
+    # mesh-sharded array, not a host scalar.
+    local_mesh = Mesh(np.asarray(jax.local_devices()), ("dp",))
+    shard = NamedSharding(local_mesh, P("dp"))
+
     def save(state, step):
         mgr.save(step, PyTreeStateDict({"w": state["w"]}), is_async=False)
         print(f"[rank {rank}] preemption save @ step {step}", flush=True)
 
     cb = PreemptionCheckpointCallback(on_preemption=save)
 
+    @jax.jit
+    def advance(w):
+        return w + 1.0
+
     def step_fn(state, step):
         time.sleep(0.05)  # stand-in for a real train step
-        return {"w": state["w"] + 1.0}
+        return {"w": advance(state["w"])}
 
     ctx = LoopContext(rank=rank, world_size=world)
-    ctx.state = {"w": jnp.zeros(())}
+    ctx.state = {"w": jax.device_put(jnp.zeros((4, 2)), shard)}
     latest = mgr.find_latest()
     if latest >= 0:
         hollow, tensors, meta = mgr.load(latest)
-        ctx.state = {"w": jnp.asarray(tensors[0])}
+        ctx.state = {"w": jax.device_put(jnp.asarray(tensors[0]), shard)}
         ctx.start_step = latest + 1
         print(f"[rank {rank}] resumed from step {ctx.start_step}", flush=True)
     ctx = run_training(step_fn, ctx.state, num_steps=400, callbacks=[cb], ctx=ctx)
