@@ -183,6 +183,9 @@ def bench_injob(warm_spares: int = 0) -> dict:
             [
                 sys.executable, "-m", "tpu_resiliency.launcher.launch",
                 "--nproc-per-node", "2", "--max-restarts", "2",
+                # Private ephemeral store: the default endpoint port may be
+                # transiently occupied by unrelated jobs/tests on this host.
+                "--rdzv-endpoint", "127.0.0.1:0",
                 "--monitor-interval", "0.1",
                 "--events-file", events,
                 "--warm-spares", str(warm_spares),

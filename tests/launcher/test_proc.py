@@ -125,3 +125,26 @@ def test_error_file_roundtrip(tmp_path):
     err = WorkerError.from_file(path)
     assert err.message == "direct write" and err.exception_type == "RuntimeError"
     assert err.pid == os.getpid() and err.timestamp > 0
+
+
+def test_wait_change_wakes_on_worker_exit(tmp_path):
+    """Event-driven death detection: wait_change returns as soon as a worker
+    exits instead of sleeping out its full timeout (the respawn path's
+    detection segment must not be quantized by the poll interval)."""
+    import time
+
+    script = tmp_path / "w.py"
+    script.write_text("import sys, time\ntime.sleep(0.3)\nsys.exit(3)\n")
+    group = WorkerGroup(
+        argv=[str(script)], nproc=1, base_env={}, run_dir=str(tmp_path / "run")
+    )
+    group.start(round_no=0, first_global_rank=0, world_size=1)
+    t0 = time.monotonic()
+    woke = group.wait_change(timeout=60.0)
+    waited = time.monotonic() - t0
+    assert woke, "no wake despite worker exit"
+    assert waited < 50.0, f"wait_change slept {waited:.1f}s of its 60s timeout"
+    assert group.poll() is GroupState.FAILED
+    # Subsequent waits block again (the event auto-resets).
+    assert not group.wait_change(timeout=0.05)
+    group.stop()

@@ -214,13 +214,26 @@ def test_restart_round_promoted_from_warm_spare(tmp_path):
     script = tmp_path / "crash_once.py"
     marker = tmp_path / "crashed"
     result = tmp_path / "result.json"
+    spares_dir = tmp_path / "run" / "spares"
     script.write_text(
         textwrap.dedent(
             f"""
-            import json, os, sys
+            import glob, json, os, sys, time
             if not os.path.exists({str(marker)!r}):
                 open({str(marker)!r}, "w").close()
-                sys.exit(1)
+                # Deterministic: crash only once a spare is parked-and-warm —
+                # detection+rendezvous are now fast enough that an immediate
+                # first-step crash can legitimately beat the spare's own
+                # interpreter warm-up (the designed cold-spawn fallback).
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline:
+                    ready = [p for p in
+                             glob.glob(os.path.join({str(spares_dir)!r}, "ready_*"))
+                             if not p.endswith(".tmp")]
+                    if ready:
+                        sys.exit(1)
+                    time.sleep(0.05)
+                sys.exit(17)  # never went warm: fail loudly, not flakily
             with open({str(result)!r}, "w") as f:
                 json.dump({{"promoted": os.environ.get({PROMOTED_ENV!r}),
                            "restart": os.environ["TPU_FT_RESTART_COUNT"]}}, f)

@@ -283,7 +283,9 @@ class ElasticAgent:
         i_am_leader = outcome.node_rank == 0
         self.rdzv.set_health(True)
         while True:
-            time.sleep(cfg.monitor_interval)
+            # Event-driven: a worker exit wakes this immediately (ms detection
+            # on the respawn path); the timeout bounds control-plane polling.
+            group.wait_change(cfg.monitor_interval)
             state = group.poll()
             if state is GroupState.SUCCEEDED:
                 group.reap()

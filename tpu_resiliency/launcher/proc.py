@@ -19,6 +19,7 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
 from typing import IO, Optional
 
@@ -110,6 +111,11 @@ class WorkerGroup:
         self.workers: list[Worker] = []
         #: optional callable local_rank -> extra env (e.g. the per-rank monitor socket)
         self.per_rank_env = None
+        #: set by a per-worker reaper thread the instant ANY worker exits, so
+        #: the supervise loop wakes immediately instead of discovering the exit
+        #: at its next poll tick — this takes the detection segment of
+        #: BENCH_restart's respawn decomposition from O(monitor_interval) to ~ms.
+        self._change = threading.Event()
 
     def start(self, round_no: int, first_global_rank: int, world_size: int) -> None:
         if self.workers:
@@ -185,10 +191,32 @@ class WorkerGroup:
                     _stderr=stderr,
                 )
             )
+        for w in self.workers:
+            threading.Thread(
+                target=self._reap_and_signal, args=(w.proc,), daemon=True
+            ).start()
         log.info(
             f"started {self.nproc} workers (global ranks "
             f"{first_global_rank}..{first_global_rank + self.nproc - 1} of {world_size})"
         )
+
+    def _reap_and_signal(self, proc: subprocess.Popen) -> None:
+        try:
+            proc.wait()
+        except Exception:
+            pass
+        self._change.set()
+
+    def wait_change(self, timeout: float) -> bool:
+        """Block up to ``timeout`` for any worker exit since the last call;
+        True if one happened. The event is only a wakeup accelerator — state
+        truth is always re-read via :meth:`poll` — so the clear-after-wake
+        race (a second exit landing between wake and clear) is harmless: the
+        caller's poll sees every exit code regardless."""
+        if self._change.wait(timeout):
+            self._change.clear()
+            return True
+        return False
 
     def poll(self) -> GroupState:
         codes = [w.exitcode for w in self.workers]
