@@ -46,6 +46,10 @@ def main() -> None:
             ).strip()
     os.environ.setdefault("RANK", "0")
     os.environ.setdefault("WORLD_SIZE", "1")
+    # Standalone single-rank run: host the coordination store on an ephemeral
+    # port — the fixed default can be transiently busy on a shared host
+    # (concurrent jobs/CI instances), and this example needs no fixed address.
+    os.environ.setdefault("TPU_RESILIENCY_STORE_PORT", "0")
 
     import jax
 

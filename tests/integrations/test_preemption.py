@@ -80,36 +80,59 @@ def test_one_rank_notice_synchronizes_all_saves(tmp_path):
     script.write_text(CHILD)
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen(
+    import threading
+
+    procs = []
+    bufs: list[list[str]] = []
+    readers = []
+    for r in range(2):
+        p = subprocess.Popen(
             [sys.executable, str(script), str(r), str(port), str(tmp_path)],
             stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
             text=True,
             env=env,
             cwd=str(tmp_path),
         )
-        for r in range(2)
-    ]
+        buf: list[str] = []
+        t = threading.Thread(target=lambda p=p, b=buf: b.extend(p.stdout), daemon=True)
+        t.start()
+        procs.append(p)
+        bufs.append(buf)
+        readers.append(t)
     try:
-        # Let both children initialize and start stepping (jdist init + jit
-        # warmup take a couple of seconds; steps are 0.05 s and the horizon is
-        # 400 steps, so the notice lands mid-run with wide margin either way).
-        time.sleep(6.0)
-        for r, p in enumerate(procs):
-            if p.poll() is not None:
-                out, err = p.communicate(timeout=10)
+        # Deliver the notice only after BOTH ranks printed READY (the handler
+        # exists past that point) — a blind warmup sleep loses under machine
+        # load: a SIGTERM landing while a rank still imports jax just kills it,
+        # and the peer then dies in RegisterTask (observed in the 20x soak).
+        deadline = time.monotonic() + 240.0
+        while time.monotonic() < deadline:
+            if all(any(ln.startswith("READY") for ln in b) for b in bufs):
+                break
+            dead = [r for r, p in enumerate(procs) if p.poll() is not None]
+            if dead:
+                readers[dead[0]].join(5.0)
                 raise AssertionError(
-                    f"rank {r} died during warmup (rc={p.returncode}):\n"
-                    f"{out}\n{err[-3000:]}"
+                    f"rank {dead[0]} died during warmup "
+                    f"(rc={procs[dead[0]].returncode}):\n"
+                    + "".join(bufs[dead[0]])[-3000:]
                 )
+            time.sleep(0.1)
+        else:
+            raise AssertionError(
+                "ranks never became READY:\n"
+                + "\n---\n".join("".join(b)[-1500:] for b in bufs)
+            )
+        time.sleep(1.0)  # both stepping; the notice lands mid-run
         procs[1].send_signal(signal.SIGTERM)  # the preemption notice
         results = {}
         for r, p in enumerate(procs):
-            out, err = p.communicate(timeout=90)
-            assert p.returncode == 0, f"rank {r} failed:\n{out}\n{err[-3000:]}"
+            p.wait(timeout=120)
+            readers[r].join(10.0)
+            out = "".join(bufs[r])
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
             line = [ln for ln in out.splitlines() if ln.startswith("PREEMPT-RESULT ")]
-            assert line, f"rank {r} no result:\n{out}\n{err[-2000:]}"
+            assert line, f"rank {r} no result:\n{out[-2000:]}"
             results[r] = json.loads(line[0][len("PREEMPT-RESULT "):])
     finally:
         for p in procs:

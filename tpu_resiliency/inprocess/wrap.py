@@ -164,7 +164,11 @@ class CallWrapper:
                 self.state.rank, host, port, prefix=prefix
             )
             if self.server is not None:
-                os.environ.setdefault("TPU_RESILIENCY_STORE_PORT", str(self.server.port))
+                # Overwrite, not setdefault: when WE host, the env must carry
+                # the port actually bound — a caller-provided "0" (host on an
+                # ephemeral port) left in place would send any descendant that
+                # resolves store_addr_from_env() to 127.0.0.1:0.
+                os.environ["TPU_RESILIENCY_STORE_PORT"] = str(self.server.port)
         # Resolved coordinator address, for the fresh-connection job_done probe a
         # rank makes when its persistent client hits a dead server mid-restart.
         self._store_addr = (
