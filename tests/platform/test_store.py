@@ -451,3 +451,32 @@ def test_prefix_clear_all_tables(kv_server):
     assert c.barrier_status("iter/0/bar") is None
     assert c.prefix_get("iter/1/") == {"iter/1/flag": True}
     c.close()
+
+
+def test_store_answers_probe(monkeypatch):
+    """The liveness probe behind the launcher's join-vs-host decision: True
+    only for a live server the caller can actually authenticate to."""
+    from tpu_resiliency.platform.store import AUTH_KEY_ENV, store_answers
+
+    # auth_key=None must test the MISSING-key branch, not an env fallback.
+    monkeypatch.delenv(AUTH_KEY_ENV, raising=False)
+
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        assert store_answers("127.0.0.1", server.port)
+    finally:
+        server.close()
+    # Dead server: instant False (connection refused), no stall.
+    t0 = time.monotonic()
+    assert not store_answers("127.0.0.1", server.port, timeout=1.0)
+    assert time.monotonic() - t0 < 1.5
+
+    auth = KVServer(host="127.0.0.1", port=0, auth_key="sekrit")
+    try:
+        assert store_answers("127.0.0.1", auth.port, auth_key="sekrit")
+        # Without (or with the wrong) key the caller could not use the store:
+        # the probe must not claim it is joinable.
+        assert not store_answers("127.0.0.1", auth.port, auth_key=None)
+        assert not store_answers("127.0.0.1", auth.port, auth_key="wrong", timeout=2.0)
+    finally:
+        auth.close()
