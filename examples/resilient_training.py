@@ -13,9 +13,13 @@ The full stack in one script (the analogue of the reference's
 Run::
 
     tpu-ft-launcher --nproc-per-node 1 --max-restarts 2 \\
+        --warm-spares 1 \\
         --ft-param-initial_rank_heartbeat_timeout 60 \\
         --ft-param-rank_heartbeat_timeout 60 \\
         examples/resilient_training.py --steps 30 --ckpt-dir /tmp/resilient_ckpt
+
+(``--warm-spares 1`` parks a pre-imported interpreter so the post-crash
+respawn promotes it in tens of milliseconds instead of paying jax import.)
 """
 
 from __future__ import annotations

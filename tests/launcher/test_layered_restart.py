@@ -183,3 +183,98 @@ def test_layered_inprocess_then_injob_restart(tmp_path):
     # Exactly one worker death was recorded, with its exit code.
     deaths = [e for e in evs if e["kind"] == "worker_failed"]
     assert len(deaths) == 1 and deaths[0]["exitcode"] == 13
+
+
+SPARE_WORKER = """
+import glob, json, os, sys, time
+
+# Captured BEFORE any import of this script's own dependencies: in a promoted
+# spare the pool's preload already put jax in sys.modules; a cold interpreter
+# at this point has not.
+jax_preloaded = "jax" in sys.modules
+
+from tpu_resiliency.inprocess.wrap import CallWrapper, Wrapper
+
+rank = int(os.environ["RANK"])
+launcher_round = int(os.environ["TPU_FT_RESTART_COUNT"])
+outdir = {outdir!r}
+spares_dir = {spares_dir!r}
+
+
+@Wrapper(
+    monitor_interval=0.05,
+    last_call_wait=0.1,
+    soft_timeout=10.0,
+    hard_timeout=20.0,
+    heartbeat_interval=0.2,
+    heartbeat_timeout=10.0,
+    barrier_timeout=45.0,
+    completion_timeout=45.0,
+)
+def train(call: CallWrapper):
+    if launcher_round == 0:
+        if rank == 1:
+            # Die only once a spare is parked-and-warm, so the restart round
+            # deterministically promotes instead of cold-spawning.
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                ready = [p for p in glob.glob(os.path.join(spares_dir, "ready_*"))
+                         if not p.endswith(".tmp")]
+                if len(ready) >= 2:
+                    os._exit(13)
+                time.sleep(0.05)
+            sys.exit(17)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+        sys.exit(9)
+    return "ok"
+
+
+result = train()
+with open(os.path.join(outdir, "result_%d.json" % rank), "w") as f:
+    json.dump({{"rank": rank, "round": launcher_round, "result": result,
+               "promoted": os.environ.get("TPU_FT_WARM_SPARE"),
+               "jax_preloaded": jax_preloaded}}, f)
+"""
+
+
+def test_layered_restart_round_served_by_warm_spares(tmp_path):
+    """Full-stack integration in the PRODUCTION preload shape: the respawned
+    round's workers are promoted warm spares that really did import jax while
+    parked (asserted via sys.modules at script start), and the in-process
+    Wrapper (store scoping, restart world, barriers) works identically inside
+    a promoted interpreter. Deliberately pays the jax-preload cost the other
+    warm-spare tests avoid — this is the one test of the default preload."""
+    outdir = tmp_path / "out"
+    outdir.mkdir()
+    run_dir = tmp_path / "run"
+    script = tmp_path / "spare_layered.py"
+    script.write_text(
+        SPARE_WORKER.format(outdir=str(outdir), spares_dir=str(run_dir / "spares"))
+    )
+    env = dict(os.environ)
+    env["TPU_RESILIENCY_LOG_LEVEL"] = "INFO"
+    cmd = [
+        sys.executable, "-m", "tpu_resiliency.launcher.launch",
+        "--nproc-per-node", "2",
+        "--rdzv-endpoint", f"127.0.0.1:{free_port()}",
+        "--max-restarts", "2",
+        "--warm-spares", "2",
+        "--no-ft-monitors",
+        "--rdzv-last-call", "0.2",
+        "--monitor-interval", "0.1",
+        "--run-dir", str(run_dir),
+        str(script),
+    ]
+    r = subprocess.run(
+        cmd, capture_output=True, text=True, timeout=300, env=env, cwd=str(tmp_path)
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr[-3000:]}"
+    import json
+
+    for rank in (0, 1):
+        got = json.loads((outdir / f"result_{rank}.json").read_text())
+        assert got["round"] == 1 and got["result"] == "ok", got
+        assert got["promoted"] == "1", got
+        assert got["jax_preloaded"] is True, got

@@ -96,3 +96,51 @@ def test_ring_backends_agree(capacity, samples):
         sn, sp = nat.stats(), py.stats()
         for k in sp:
             np.testing.assert_allclose(sn[k], sp[k], rtol=1e-10, atol=1e-9, err_msg=k)
+
+
+@st.composite
+def radix_case(draw):
+    """Like telemetry_case but the window size also samples the LARGE regime
+    (past the quadratic cap) where auto_mode actually selects radix."""
+    r = draw(st.integers(2, 6))
+    s = draw(st.integers(1, 3))
+    w = draw(st.one_of(st.integers(1, 10), st.integers(65, 130)))
+    data = draw(
+        st.lists(
+            st.floats(np.float32(1e-4), np.float32(1e3), allow_nan=False, allow_subnormal=False, width=32),
+            min_size=r * s * w,
+            max_size=r * s * w,
+        )
+    )
+    counts = draw(st.lists(st.integers(0, w), min_size=r * s, max_size=r * s))
+    return (
+        np.asarray(data, np.float32).reshape(r, s, w),
+        np.asarray(counts, np.int32).reshape(r, s),
+    )
+
+
+@given(radix_case())
+def test_radix_kernel_matches_loop_kernel(case):
+    """The O(32*W) radix-select formulation is bit-identical to rank-counting
+    on arbitrary windows/counts (ties, empties, single samples, tiny/huge
+    magnitudes, and windows past the quadratic cap) — the invariant that lets
+    auto-selection switch modes by size without changing results."""
+    import jax.numpy as jnp
+
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    data, counts = case
+    r = data.shape[0]
+    loop = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), rank_tile=r, interpret=True,
+        mode="loop",
+    )
+    radix = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), rank_tile=r, interpret=True,
+        mode="radix",
+    )
+    # Bit-identical, weights included: both kernels share the masked-sum
+    # expression today, and a divergence introduced by a future edit must not
+    # hide behind a tolerance (mode auto-switching relies on identity).
+    np.testing.assert_array_equal(np.asarray(loop[0]), np.asarray(radix[0]))
+    np.testing.assert_array_equal(np.asarray(loop[1]), np.asarray(radix[1]))
