@@ -167,3 +167,35 @@ def test_signals_roundtrip(kv_server):
     assert "n0" not in rdzv.healthy_live_nodes()
     rdzv.stop_keepalive()
     store.close()
+
+
+def test_round_close_detection_is_event_driven(kv_server):
+    """A follower must learn of the leader's round close via the store's
+    wait_changed notification, not at its next poll tick: with a deliberately
+    huge poll interval, both nodes still place within a couple of seconds."""
+    outs = {}
+
+    def join(nid):
+        rdzv, store = make_rdzv(
+            kv_server.port, nid, min_nodes=2, max_nodes=2, poll_interval=30.0,
+            join_timeout=60.0,
+        )
+        try:
+            outs[nid] = rdzv.next_round()
+        finally:
+            rdzv.stop_keepalive()
+            store.close()
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=join, args=(n,)) for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=45.0)
+    elapsed = time.monotonic() - t0
+    assert set(outs) == {"a", "b"}, outs
+    assert {outs["a"].node_rank, outs["b"].node_rank} == {0, 1}
+    assert elapsed < 10.0, (
+        f"placement took {elapsed:.1f}s with poll_interval=30 — close "
+        f"detection fell back to polling"
+    )

@@ -480,3 +480,73 @@ def test_store_answers_probe(monkeypatch):
         assert not store_answers("127.0.0.1", auth.port, auth_key="wrong", timeout=2.0)
     finally:
         auth.close()
+
+
+def test_wait_changed_versions(kv_server):
+    """Per-key mutation versions: every write kind wakes a watcher — including
+    a set to the SAME value and a delete — and timeouts leave the version be."""
+    c = CoordStore("127.0.0.1", kv_server.port, prefix="wc/")
+    c.set("state", {"round": 0})
+    _, v0 = c.get_versioned("state")
+    assert v0 >= 1
+
+    # No mutation: bounded timeout, unchanged.
+    t0 = time.monotonic()
+    changed, _, v = c.wait_changed("state", v0, timeout=0.3)
+    assert not changed and v == v0 and time.monotonic() - t0 >= 0.25
+
+    # A concurrent CAS wakes the parked watcher almost immediately.
+    def mutate():
+        time.sleep(0.15)
+        m = CoordStore("127.0.0.1", kv_server.port, prefix="wc/")
+        ok, _ = m.compare_set("state", {"round": 0}, {"round": 1})
+        assert ok
+        m.close()
+
+    t = threading.Thread(target=mutate)
+    t.start()
+    t0 = time.monotonic()
+    changed, value, v1 = c.wait_changed("state", v0, timeout=10.0)
+    waited = time.monotonic() - t0
+    t.join()
+    assert changed and value == {"round": 1} and v1 > v0
+    assert waited < 5.0, waited
+
+    # Same-value set still counts as a change (watchers need the wake, e.g. a
+    # leader re-asserting state).
+    c.set("state", {"round": 1})
+    changed, value, v2 = c.wait_changed("state", v1, timeout=5.0)
+    assert changed and value == {"round": 1} and v2 > v1
+
+    # Deletion is a change; value comes back None and the version entry drops
+    # to 0 (bounded table: versions exist only for live keys).
+    c.delete("state")
+    changed, value, v3 = c.wait_changed("state", v2, timeout=5.0)
+    assert changed and value is None and v3 == 0
+    assert c.get_versioned("state") == (None, 0)
+
+    # Re-creation lands past every previously observed version (global clock:
+    # no ABA against any old seen_version).
+    c.set("state", {"round": 2})
+    _, v4 = c.get_versioned("state")
+    assert v4 > v2
+
+    # prefix_clear is a visible change too.
+    c.prefix_clear("")
+    changed, value, v5 = c.wait_changed("state", v4, timeout=5.0)
+    assert changed and value is None and v5 == 0
+
+    # touch participates in versioning (event-driven liveness watchers).
+    c.touch("hb")
+    _, vt = c.get_versioned("hb")
+    assert vt > 0
+    c.touch("hb")
+    changed, _, vt2 = c.wait_changed("hb", vt, timeout=5.0)
+    assert changed and vt2 > vt
+
+    # A stale-but-nonzero seen_version returns instantly (no park).
+    c.set("state", {"round": 3})
+    t0 = time.monotonic()
+    changed, _, _ = c.wait_changed("state", 1, timeout=10.0)
+    assert changed and time.monotonic() - t0 < 2.0
+    c.close()

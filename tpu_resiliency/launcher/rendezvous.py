@@ -171,9 +171,10 @@ class StoreRendezvous:
         deadline = time.monotonic() + self.s.join_timeout
         min_reached_at: Optional[float] = None
         me = self.node_id
+        state_ver = 0
         while time.monotonic() < deadline:
             try:
-                cur = self.store.try_get("state")
+                cur, state_ver = self.store.get_versioned("state")
             except StoreError:
                 if prev_round < 0:
                     # Never placed and the control plane is gone: the job completed
@@ -316,7 +317,14 @@ class StoreRendezvous:
                             f"active={active} spares={spares}"
                         )
                     continue
-            time.sleep(self.s.poll_interval)
+            # Event-driven: any peer's CAS on the round state wakes us at once
+            # (a follower learns of the leader's close in ~ms instead of up to
+            # a poll interval later); the timeout keeps the time-based checks
+            # (keep-alive staleness, last-call window) paced as before.
+            try:
+                self.store.wait_changed("state", state_ver, self.s.poll_interval)
+            except StoreError:
+                time.sleep(self.s.poll_interval)
         raise FaultToleranceError(
             f"rendezvous did not complete within {self.s.join_timeout}s "
             f"(node {me}, waiting for round > {prev_round})"

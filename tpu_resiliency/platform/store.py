@@ -167,6 +167,17 @@ class KVServer:
         self.auth_key = auth_key
         self.auth_timeout = auth_timeout
         self._data: dict[str, Any] = {}
+        #: key → value of the GLOBAL mutation clock at the key's last write
+        #: (set/add/cas/touch bump it): ``wait_changed`` parks against it so
+        #: clients can watch a key for ANY change — including back to a
+        #: previously-seen value — without polling. Deletion drops the entry
+        #: (version reverts to 0, itself a visible change, and the global
+        #: clock makes a later re-create differ from every earlier version),
+        #: so the table's size is bounded by live keys. One blind spot by
+        #: design: a create+delete pair completing entirely between a
+        #: watcher's reads looks like "never existed".
+        self._versions: dict[str, int] = {}
+        self._version_clock = 0
         self._lists: dict[str, list] = {}
         self._sets: dict[str, set] = {}
         self._barriers: dict[str, _Barrier] = {}
@@ -524,8 +535,14 @@ class KVServer:
     def _op_ping(self, req: dict) -> dict:
         return self._ok("pong")
 
+    def _bump(self, key: str) -> int:
+        self._version_clock += 1
+        self._versions[key] = self._version_clock
+        return self._version_clock
+
     def _op_set(self, req: dict) -> dict:
         self._data[req["key"]] = req["value"]
+        self._bump(req["key"])
         self._notify(("k", req["key"]))
         return self._ok()
 
@@ -544,12 +561,19 @@ class KVServer:
         return self._ok(all(k in self._data for k in req["keys"]))
 
     def _op_delete(self, req: dict) -> dict:
-        existed = self._data.pop(req["key"], None) is not None
+        existed = req["key"] in self._data
+        self._data.pop(req["key"], None)
+        if existed:
+            # Drop (not bump): version reverts to 0 — different from whatever
+            # any watcher saw — and the table stays bounded by live keys.
+            self._versions.pop(req["key"], None)
+            self._notify(("k", req["key"]))
         return self._ok(existed)
 
     def _op_add(self, req: dict) -> dict:
         new = int(self._data.get(req["key"], 0)) + int(req["amount"])
         self._data[req["key"]] = new
+        self._bump(req["key"])
         self._notify(("k", req["key"]))
         return self._ok(new)
 
@@ -563,9 +587,30 @@ class KVServer:
         current = self._data.get(req["key"])
         if current == req["expected"]:
             self._data[req["key"]] = req["desired"]
+            self._bump(req["key"])
             self._notify(("k", req["key"]))
             return self._ok((True, req["desired"]))
         return self._ok((False, current))
+
+    def _op_getv(self, req: dict) -> dict:
+        key = req["key"]
+        return self._ok((self._data.get(key), self._versions.get(key, 0)))
+
+    def _op_wait_changed(self, req: dict) -> Any:
+        """Park until ``key``'s mutation version differs from ``seen_version``
+        (set/add/cas/delete all count, even back to the same value), then
+        return ``(value, new_version)`` — the event-driven alternative to
+        polling a CAS state blob (rendezvous close detection rides this)."""
+        deadline = time.monotonic() + req.get("timeout", 0.0)
+        key, seen = req["key"], req["seen_version"]
+
+        def ready() -> Optional[dict]:
+            v = self._versions.get(key, 0)
+            if v != seen:
+                return self._ok((self._data.get(key), v))
+            return None
+
+        return _Park(ready=ready, deadline=deadline, wait_key=("k", key))
 
     def _op_prefix_get(self, req: dict) -> dict:
         prefix = req["prefix"]
@@ -713,6 +758,7 @@ class KVServer:
         judged by one clock — comparing a peer host's ``time.time()`` against the local
         one turns NTP offset into false UNRESPONSIVE verdicts."""
         self._data[req["key"]] = time.time()
+        self._bump(req["key"])
         self._notify(("k", req["key"]))
         return self._ok()
 
@@ -752,6 +798,9 @@ class KVServer:
             dead = [k for k in table if k.startswith(prefix)]
             for k in dead:
                 del table[k]
+                if table is self._data:
+                    self._versions.pop(k, None)
+                    self._notify(("k", k))
             removed += len(dead)
         self._stale_cache.clear()
         return self._ok(removed)
@@ -896,6 +945,32 @@ class KVClient:
     def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
         return tuple(self._call({"op": "cas", "key": key, "expected": expected, "desired": desired}))
 
+    def get_versioned(self, key: str) -> tuple[Any, int]:
+        """``(value_or_None, mutation_version)`` — the version feeds
+        :meth:`wait_changed`."""
+        return tuple(self._call({"op": "getv", "key": key}))
+
+    def wait_changed(
+        self, key: str, seen_version: int, timeout: float
+    ) -> tuple[bool, Any, int]:
+        """Block until ``key`` mutates past ``seen_version`` (any set/add/cas/
+        delete) or ``timeout`` elapses. Returns ``(changed, value, version)``;
+        on timeout ``(False, None, seen_version)``. Event-driven replacement
+        for sleep-polling a state key."""
+        try:
+            value, version = self._call(
+                {
+                    "op": "wait_changed",
+                    "key": key,
+                    "seen_version": seen_version,
+                    "timeout": timeout,
+                },
+                op_timeout=timeout,
+            )
+            return True, value, version
+        except StoreTimeoutError:
+            return False, None, seen_version
+
     def prefix_get(self, prefix: str) -> dict[str, Any]:
         return self._call({"op": "prefix_get", "prefix": prefix})
 
@@ -1005,6 +1080,14 @@ class StoreView:
 
     def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
         return self.client.compare_set(self._k(key), expected, desired)
+
+    def get_versioned(self, key: str) -> tuple[Any, int]:
+        return self.client.get_versioned(self._k(key))
+
+    def wait_changed(
+        self, key: str, seen_version: int, timeout: float
+    ) -> tuple[bool, Any, int]:
+        return self.client.wait_changed(self._k(key), seen_version, timeout)
 
     def prefix_get(self, prefix: str = "") -> dict[str, Any]:
         """Scan keys under this view; returned keys are relative to the view."""
