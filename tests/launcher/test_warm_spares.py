@@ -242,11 +242,12 @@ def test_restart_round_promoted_from_warm_spare(tmp_path):
     )
     env = dict(os.environ)
     env.setdefault("TPU_RESILIENCY_LOG_LEVEL", "INFO")
+    events_file = tmp_path / "events.jsonl"
     r = subprocess.run(
         [sys.executable, "-m", "tpu_resiliency.launcher.launch",
          "--standalone", "--nproc-per-node", "1", "--max-restarts", "2",
          "--warm-spares", "1", "--warm-spare-preload", "json",
-         "--no-ft-monitors",
+         "--no-ft-monitors", "--events-file", str(events_file),
          "--run-dir", str(tmp_path / "run"), str(script)],
         capture_output=True, text=True, timeout=180, env=env, cwd=str(tmp_path),
     )
@@ -254,3 +255,15 @@ def test_restart_round_promoted_from_warm_spare(tmp_path):
     got = json.loads(result.read_text())
     assert got["promoted"] == "1", (got, r.stderr[-2000:])
     assert int(got["restart"]) >= 1
+    # The promotion is a first-class structured event for operators. Round 0
+    # may legitimately promote too (a spare can warm before the first round on
+    # a slow host) — the restart round's promotion is the one that must exist.
+    promoted = [
+        json.loads(ln) for ln in events_file.read_text().splitlines()
+        if '"worker_promoted"' in ln
+    ]
+    restart_promos = [e for e in promoted if e["round"] >= 1]
+    assert restart_promos, promoted
+    assert restart_promos[0]["global_rank"] == 0
+    assert restart_promos[0]["worker_pid"] > 0
+    assert restart_promos[0]["worker_pid"] != restart_promos[0]["pid"]

@@ -24,6 +24,7 @@ import time
 from typing import IO, Optional
 
 from tpu_resiliency.launcher.errors import ERROR_FILE_ENV, WorkerError
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -160,6 +161,12 @@ class WorkerGroup:
                         self.argv, env, stdout=stdout_path, stderr=stderr_path
                     )
                     log.info(f"rank {grank}: promoted warm spare pid {proc.pid}")
+                    # worker_pid, not pid: 'pid' is the Event's own identity
+                    # field (the recording process — this launcher).
+                    record_event(
+                        "launcher", "worker_promoted", round=round_no,
+                        global_rank=grank, worker_pid=proc.pid,
+                    )
                 except OSError:
                     # The spare died between acquire() and the pipe write
                     # (EPIPE); fall through to a cold spawn.
