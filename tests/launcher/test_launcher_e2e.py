@@ -359,3 +359,64 @@ def test_resilient_training_example(tmp_path):
         raise AssertionError(f"launcher wedged:\n{out[-2000:]}\n{err[-2000:]}")
     assert p.returncode == 0, f"{out[-2000:]}\n{err[-2000:]}"
     assert "resumed" in out.lower() or "resumed" in err.lower(), (out[-1500:], err[-1500:])
+
+
+def test_remote_restart_propagation_is_event_driven(tmp_path):
+    """A peer node must observe another node's restart request via the store
+    watch, not at its next poll tick: with a deliberately huge monitor
+    interval, node A's worker failure still pulls node B into the next round
+    within a couple of seconds (events-file timestamps, one host clock)."""
+    import json
+
+    port = free_port()
+    script = tmp_path / "w.py"
+    script.write_text(
+        textwrap.dedent(
+            f"""
+            import os, sys, time
+            round_no = int(os.environ["TPU_FT_RESTART_COUNT"])
+            if round_no == 0:
+                if os.environ["NODE_RANK"] == "0":
+                    time.sleep(2.0)  # both nodes settled into supervising
+                    sys.exit(1)
+                time.sleep(600)  # node B's worker parks; launcher must stop it
+            """
+        )
+    )
+    args = ["--nproc-per-node", "1", "--nnodes", "2", "--rdzv-endpoint",
+            f"127.0.0.1:{port}", "--no-ft-monitors", "--rdzv-last-call", "0.3",
+            "--max-restarts", "2", "--monitor-interval", "5.0"]
+    ev_a, ev_b = tmp_path / "ev_a.jsonl", tmp_path / "ev_b.jsonl"
+    p0 = launch_async(args + ["--node-id", "nodeA", "--events-file", str(ev_a)],
+                      script, tmp_path, name="a")
+    p1 = launch_async(args + ["--node-id", "nodeB", "--events-file", str(ev_b)],
+                      script, tmp_path, name="b")
+    out0, err0 = p0.communicate(timeout=240)
+    out1, err1 = p1.communicate(timeout=240)
+    assert p0.returncode == 0, err0[-3000:]
+    assert p1.returncode == 0, err1[-3000:]
+
+    evs_a = [json.loads(ln) for ln in ev_a.read_text().splitlines()]
+    evs_b = [json.loads(ln) for ln in ev_b.read_text().splitlines()]
+    # Node rank 0 (the crasher) is decided by join order — find the requester's
+    # stream dynamically; the PEER's round-1 entry is the propagation endpoint.
+    if any(e.get("kind") == "restart_requested" for e in evs_a):
+        requester, peer = evs_a, evs_b
+    else:
+        requester, peer = evs_b, evs_a
+    kinds = [sorted({e.get("kind") for e in s}) for s in (evs_a, evs_b)]
+    t_restart = next(
+        (e["ts"] for e in requester if e.get("kind") == "restart_requested"), None
+    )
+    assert t_restart is not None, f"no restart_requested in either stream: {kinds}"
+    t_peer_round1 = next(
+        (e["ts"] for e in peer
+         if e.get("kind") == "rendezvous_round" and e.get("round", 0) >= 1),
+        None,
+    )
+    assert t_peer_round1 is not None, f"peer never reached round 1: {kinds}"
+    delta = t_peer_round1 - t_restart
+    assert delta < 4.0, (
+        f"peer reached round 1 only {delta:.1f}s after the restart request "
+        f"(monitor interval was 5s — propagation fell back to polling)"
+    )

@@ -22,6 +22,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import socket as socketmod
+import threading
 import time
 import uuid
 from typing import Optional
@@ -114,6 +115,13 @@ class ElasticAgent:
         self._restarts_used = 0
         self._last_exitcodes: dict[int, int] = {}
         self._spare_pool = None
+        #: set by restart watchers so spare/completion waits wake on a peer's
+        #: restart request instead of sleeping out their poll tick
+        self._wake = threading.Event()
+
+    def _pause(self, timeout: float) -> None:
+        if self._wake.wait(timeout):
+            self._wake.clear()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -194,8 +202,19 @@ class ElasticAgent:
         ``_ft_rendezvous.py:302-338``)."""
         log.info(f"[{self.cfg.node_id}] spare for round {outcome.round}; standing by")
         epoch0 = outcome.epoch
+        try:
+            watcher = self.rdzv.watch_restart(self._wake.set)
+        except Exception:
+            watcher = None  # accelerator only; polling still covers it
+        try:
+            return self._spare_loop(outcome, epoch0)
+        finally:
+            if watcher is not None:
+                watcher.stop()
+
+    def _spare_loop(self, outcome: RendezvousOutcome, epoch0: int) -> str:
         while True:
-            time.sleep(self.cfg.monitor_interval)
+            self._pause(self.cfg.monitor_interval)
             try:
                 if self.rdzv.shutdown_reason() is not None:
                     self._last_exitcodes = {}
@@ -263,14 +282,26 @@ class ElasticAgent:
         if self._monitor_sockets:
             sockets = list(self._monitor_sockets)
             group.per_rank_env = lambda local: {ipc.MONITOR_SOCKET_ENV: sockets[local]}
+        watcher = None
         try:
             group.start(outcome.round, first_rank, world_size)
+            # A peer's restart request wakes the supervise loop through the
+            # same event as a local worker death: multi-node respawn is then
+            # notification-bound on every surviving node, not poll-bound.
+            try:
+                watcher = self.rdzv.watch_restart(
+                    lambda: (group.notify_change(), self._wake.set())
+                )
+            except Exception:
+                watcher = None  # accelerator only; polling still covers it
             self.restarter.handling_start(f"round={outcome.round}")
             self.restarter.handling_processing()
             result = self._supervise(group, outcome)
             self.restarter.handling_completed()
             return result
         finally:
+            if watcher is not None:
+                watcher.stop()
             if group.workers and group.poll() is GroupState.RUNNING:
                 # Unwinding on an exception (e.g. store loss) must not orphan the
                 # round's workers — they'd keep holding the TPU devices.
@@ -338,7 +369,8 @@ class ElasticAgent:
             except StoreError:
                 # Store host gone after our own success ⇒ treat the round as done.
                 return "done"
-            time.sleep(self.cfg.monitor_interval)
+            # The round watcher (still active here) wakes this on a restart.
+            self._pause(self.cfg.monitor_interval)
 
     def _handle_failure(self, group: WorkerGroup, outcome: RendezvousOutcome) -> str:
         cfg = self.cfg

@@ -225,6 +225,11 @@ class WorkerGroup:
             return True
         return False
 
+    def notify_change(self) -> None:
+        """External wake for :meth:`wait_change` — e.g. the agent's restart-key
+        watcher folding store events into the same supervise wakeup."""
+        self._change.set()
+
     def poll(self) -> GroupState:
         codes = [w.exitcode for w in self.workers]
         if any(c not in (0, None) for c in codes):

@@ -20,7 +20,7 @@ import time
 from typing import Callable, Optional
 
 from tpu_resiliency.exceptions import FaultToleranceError, StoreError
-from tpu_resiliency.platform.store import StoreView
+from tpu_resiliency.platform.store import CoordStore, StoreView
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -120,6 +120,14 @@ class StoreRendezvous:
     def restart_epoch(self) -> int:
         return int(self.store.try_get("restart", 0))
 
+    def watch_restart(self, wake_fn) -> "RestartWatcher":
+        """A started watcher thread that calls ``wake_fn()`` whenever the
+        restart epoch mutates — folds the store's ``wait_changed`` event into a
+        caller-side wakeup (the agent's supervise loop), so a peer's restart
+        request propagates in ~ms instead of at the next poll tick. Purely an
+        accelerator: callers keep their polling checks for correctness."""
+        return RestartWatcher(self.store, wake_fn)
+
     def request_restart(self, reason: str) -> None:
         log.info(f"[{self.node_id}] requesting restart round: {reason}")
         self.store.list_append("restart_reasons", (self.node_id, reason, time.time()))
@@ -185,6 +193,16 @@ class StoreRendezvous:
                 )
             # Case 1: no state yet, or the last closed round is stale → open anew.
             if cur is None or (cur["status"] == "closed" and cur["round"] <= prev_round):
+                # A REOPENED round expects the previous round's whole cast
+                # (actives, spares, waiting): whoever reopens first must not
+                # close a splinter world at last-call while a still-live peer
+                # is merely finishing its worker teardown — that splits the
+                # fleet and thrashes restart rounds (each charging budget).
+                prev_known = sorted(
+                    set(cur.get("active", []))
+                    | set(cur.get("spares", []))
+                    | set(cur.get("waiting", {}))
+                ) if cur else []
                 nxt = {
                     "round": (cur["round"] + 1) if cur else 0,
                     "status": "open",
@@ -193,6 +211,7 @@ class StoreRendezvous:
                     "waiting": {},
                     "active": [],
                     "spares": [],
+                    "expected": prev_known,
                 }
                 min_reached_at = None
                 self._cas(cur, nxt)
@@ -295,8 +314,28 @@ class StoreRendezvous:
                 # ones advertise for the next round. This takes the last-call hold
                 # off the restart critical path for fixed-size jobs.
                 full = len(live_parts) >= self.s.max_nodes
+                waited = time.monotonic() - min_reached_at
+                # Previous-round members that are live (fresh keep-alive), did
+                # not exit, and have not re-registered yet: they are mid-
+                # teardown on their way here — hold the close for them past
+                # last-call, bounded by the keep-alive timeout (a peer that
+                # stops renewing gets pruned as dead and stops blocking).
+                expected_missing = set()
+                if i_am_leader and not full and cur.get("expected"):
+                    # Leader-only: the exit/ scan feeds only the leader's close
+                    # decision — N-1 followers issuing it each tick would tax
+                    # the control plane at exactly the restart-storm moment.
+                    exited = {
+                        k.rsplit("/", 1)[1]
+                        for k in self.store.prefix_get("exit/")
+                    }
+                    expected_missing = (
+                        set(cur["expected"]) - set(live_parts) - dead - exited
+                    )
                 last_call_over = full or (
-                    time.monotonic() - min_reached_at >= self.s.last_call_timeout
+                    waited >= self.s.last_call_timeout and not expected_missing
+                ) or (
+                    waited >= self.s.last_call_timeout + self.s.keep_alive_timeout
                 )
                 if i_am_leader and last_call_over:
                     active = order[: self.s.max_nodes]
@@ -369,3 +408,59 @@ class StoreRendezvous:
                 self._cas(cur, nxt)
         except Exception:
             pass
+
+
+class RestartWatcher:
+    """Daemon thread parking on the restart key's version; calls ``wake_fn``
+    on every mutation. Purely an accelerator: it must never be able to delay
+    or fail its owner, so the connection is built INSIDE the thread with
+    minimal retries (a wedged store at round start must not stall the agent's
+    supervision), every wait runs on a one-shot connection (never holding a
+    client lock the owner could contend on), and ``stop`` does not block —
+    the daemon thread parks out its current wait (≤ its timeout) and exits."""
+
+    #: long enough to amortize the one-shot reconnect, and past the store
+    #: client's blocking threshold so the wait never rides (and locks) a
+    #: persistent socket.
+    _WAIT_S = 6.0
+
+    def __init__(self, rdzv_store, wake_fn):
+        client = rdzv_store.client
+        self._host, self._port = client.host, client.port
+        self._prefix = rdzv_store.prefix
+        self._auth_key = client.auth_key
+        self._wake = wake_fn
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="restart-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        store = None
+        try:
+            store = CoordStore(
+                self._host, self._port, prefix=self._prefix,
+                auth_key=self._auth_key, connect_retries=2,
+            )
+            _, ver = store.get_versioned("restart")
+            while not self._stop.is_set():
+                changed, _, ver = store.wait_changed("restart", ver, self._WAIT_S)
+                if changed and not self._stop.is_set():
+                    self._wake()
+        except Exception:
+            # On any store hiccup the owner's polling still observes the
+            # epoch; don't let a watcher crash take the agent.
+            pass
+        finally:
+            if store is not None:
+                try:
+                    store.close()
+                except Exception:
+                    pass
+
+    def stop(self) -> None:
+        """Non-blocking: flag the thread down; it exits after its current
+        parked wait (daemon — it cannot outlive the process)."""
+        self._stop.set()
+        self._thread.join(timeout=0.1)
