@@ -28,6 +28,12 @@ from __future__ import annotations
 import argparse
 import os
 
+# Allow running this file directly from a repo checkout (no pip install).
+import os as _os, sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax

@@ -26,6 +26,12 @@ import argparse
 import os
 import time
 
+# Allow running this file directly from a repo checkout (no pip install).
+import os as _os, sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 # Default to the CPU simulation; a site plugin may have pre-set JAX_PLATFORMS to a
 # platform workers can't initialize (e.g. a single-tenant TPU tunnel), so only an
 # explicit TPU_MESH_EXAMPLE_PLATFORM wins over cpu here.

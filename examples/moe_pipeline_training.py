@@ -21,6 +21,12 @@ import argparse
 import os
 import tempfile
 
+# Allow running this file directly from a repo checkout (no pip install).
+import os as _os, sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 
 def main() -> None:
     p = argparse.ArgumentParser()

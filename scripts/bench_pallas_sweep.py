@@ -23,6 +23,12 @@ import argparse
 import json
 import sys
 
+# Allow running this file directly from a repo checkout (no pip install).
+import os as _os, sys as _sys
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)
+
 S = 64
 ITERS = 20
 

@@ -111,26 +111,29 @@ def _radix_select(x, key, cand0, k):
     count-and-narrow passes, O(32·W) — the O(W·log) formulation that keeps the
     Pallas path winning where rank-counting's O(W²) would hand large windows
     back to the XLA sort. All remaining candidates after 32 bits share the
-    selected value bit-for-bit, so extraction is a masked min."""
+    selected value bit-for-bit, so extraction is a masked min.
+
+    Mosaic constraint (hit on real v5e, invisible in interpret mode): ``i1``
+    vectors cannot be reshaped (``tpu.reshape vector<...xi1>`` is rejected), so
+    the candidate mask and the branch predicate are carried as int32 0/1 and
+    only compared elementwise — never broadcast with ``[..., None]`` as bools."""
     def body(i, carry):
-        cand, k = carry
+        cand, k = carry  # cand: int32 0/1 mask [.., W]; k: int32 [..]
         bit = 31 - i
         # Bits of the UNSIGNED order key u = key ^ 0x80000000: bit 31 is the
-        # inverted sign of the signed key; bits 30..0 coincide with key's.
-        bitval = jnp.where(
-            bit == 31,
-            (key >= 0).astype(jnp.int32),
-            jax.lax.shift_right_logical(key, bit) & 1,
-        )
-        zero = cand & (bitval == 0)
-        c0 = jnp.sum(zero.astype(jnp.int32), axis=-1)
-        go_zero = k < c0
-        cand = cand & jnp.where(go_zero[..., None], bitval == 0, bitval == 1)
-        k = jnp.where(go_zero, k, k - c0)
+        # inverted sign of the signed key (XOR with 1 exactly when bit == 31);
+        # bits 30..0 coincide with key's.
+        raw = jax.lax.shift_right_logical(key, bit) & 1
+        bitval = raw ^ (bit == 31).astype(jnp.int32)
+        c0 = jnp.sum(cand * (1 - bitval), axis=-1)
+        go_zero = (k < c0).astype(jnp.int32)
+        want = 1 - go_zero[..., None]  # desired bit value in the kept branch
+        cand = cand * (bitval == want).astype(jnp.int32)
+        k = k - (1 - go_zero) * c0
         return cand, k
 
-    cand, _ = jax.lax.fori_loop(0, 32, body, (cand0, k))
-    return jnp.min(jnp.where(cand, x, jnp.inf), axis=-1)
+    cand, _ = jax.lax.fori_loop(0, 32, body, (cand0.astype(jnp.int32), k))
+    return jnp.min(jnp.where(cand == 1, x, jnp.inf), axis=-1)
 
 
 def _median_weights_radix_kernel(data_ref, counts_ref, med_ref, weight_ref):
@@ -283,6 +286,25 @@ def fused_median_weights(
     rank_tile = min(rank_tile, r)
     if r % rank_tile != 0:
         raise ValueError(f"ranks {r} not divisible by rank_tile {rank_tile}")
+
+    # Mosaic rejects pairwise's 4-D all-pairs block once S reaches 64 (fine at
+    # S≤32, measured on v5e). The kernel is independent per (rank, signal), so
+    # large-S inputs are folded — signal groups moved onto the rank axis with
+    # plain XLA reshapes outside the kernel — and each block sees S'≤32.
+    # (Tiling S inside the grid instead is illegal: 2-D operand blocks must
+    # keep their last dim full or 128-divisible.)
+    if mode == "pairwise" and s > 32:
+        if s % 32 != 0:
+            raise ValueError(f"pairwise mode needs signals {s} divisible by 32")
+        fold = s // 32
+        med, wt = fused_median_weights(
+            data.reshape(r * fold, 32, w),
+            counts.reshape(r * fold, 32),
+            rank_tile=rank_tile,
+            interpret=interpret,
+            mode=mode,
+        )
+        return med.reshape(r, s), wt.reshape(r, s)
 
     grid = (r // rank_tile,)
     return pl.pallas_call(
