@@ -177,6 +177,47 @@ def test_pallas_pairwise_mode_matches_loop_mode():
     np.testing.assert_allclose(np.asarray(loop[1]), np.asarray(pair[1]), rtol=1e-6)
 
 
+def test_pallas_pairwise_large_s_fold_matches_numpy():
+    """S>32 routes pairwise through the signal→rank fold (Mosaic rejects the 4-D
+    all-pairs block past S=32); the double reshape must keep every (rank, signal)
+    group's median in place — for the production S=64 and a non-divisible S=48
+    (folded at the largest divisor ≤32, here 24)."""
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(11)
+    for s in (64, 48):
+        r, w = 8, 16
+        data, counts = _mk_windows(rng, r, s, w)
+        counts[0, 0] = 3
+        counts[1, s - 1] = 0
+        med, wt = fused_median_weights(
+            jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="pairwise"
+        )
+        exp_med = np.full((r, s), np.inf, np.float32)
+        exp_wt = np.zeros((r, s), np.float32)
+        for i in range(r):
+            for j in range(s):
+                n = counts[i, j]
+                exp_wt[i, j] = data[i, j, :n].sum()
+                if n > 0:
+                    exp_med[i, j] = np.median(data[i, j, :n])
+        np.testing.assert_allclose(np.asarray(med), exp_med, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(wt), exp_wt, rtol=1e-5)
+
+
+def test_pallas_pairwise_prime_s_rejected():
+    """A near-prime S>32 would fold to single-signal blocks — rejected loudly
+    rather than silently running a pathological grid."""
+    import pytest
+
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    data = jnp.ones((8, 37, 8), jnp.float32)
+    counts = jnp.full((8, 37), 8, jnp.int32)
+    with pytest.raises(ValueError, match="divisor"):
+        fused_median_weights(data, counts, interpret=True, mode="pairwise")
+
+
 def test_pallas_radix_mode_matches_loop_mode():
     """The radix-select formulation is the same function as the rank-counting
     loop — including empty windows, single samples, and whole-window ties."""

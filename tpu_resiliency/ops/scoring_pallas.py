@@ -294,12 +294,18 @@ def fused_median_weights(
     # (Tiling S inside the grid instead is illegal: 2-D operand blocks must
     # keep their last dim full or 128-divisible.)
     if mode == "pairwise" and s > 32:
-        if s % 32 != 0:
-            raise ValueError(f"pairwise mode needs signals {s} divisible by 32")
-        fold = s // 32
+        st = next(d for d in range(32, 0, -1) if s % d == 0)
+        if st < 8:
+            # A near-prime S would degenerate to single-signal blocks — a
+            # pathological grid far slower than the XLA sort. Fail loudly.
+            raise ValueError(
+                f"pairwise mode needs a signal count with a divisor in [8, 32] "
+                f"to fold S={s} under Mosaic's S<=32 limit (best divisor: {st})"
+            )
+        fold = s // st
         med, wt = fused_median_weights(
-            data.reshape(r * fold, 32, w),
-            counts.reshape(r * fold, 32),
+            data.reshape(r * fold, st, w),
+            counts.reshape(r * fold, st),
             rank_tile=rank_tile,
             interpret=interpret,
             mode=mode,
