@@ -163,9 +163,7 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: MoEConfig, **kw) -> jax.Array:
     logits, aux = forward(params, tokens, cfg, **kw)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean() + cfg.router_aux_weight * aux
+    return tfm.token_nll(logits, targets).mean() + cfg.router_aux_weight * aux
 
 
 def make_train_step(cfg: MoEConfig, optimizer=None, attn_fn=None):

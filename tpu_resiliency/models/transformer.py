@@ -187,6 +187,18 @@ def forward(
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
+def token_nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-position next-token NLL, ``logsumexp(logits) - logits[target]``.
+
+    Equivalent to gathering from ``log_softmax`` but never materializes the
+    ``[B, T, V]`` log-prob tensor — at vocab scale that array dominates the
+    step's HBM traffic (B8 x T1024 x V32000 f32 is ~1 GB each way); logsumexp
+    reduces to ``[B, T]`` and the backward pass recomputes softmax fused."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return lse - picked
+
+
 def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig, **kw) -> jax.Array:
     """Next-token cross-entropy over tokens [B, T].
 
@@ -197,9 +209,7 @@ def loss_fn(params: dict, tokens: jax.Array, cfg: TransformerConfig, **kw) -> ja
     """
     logits = forward(params, tokens, cfg, **kw)[:, :-1]
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return token_nll(logits, targets).mean()
 
 
 def make_train_step_from_loss(bound_loss_fn, optimizer=None):

@@ -206,9 +206,7 @@ def make_pipelined_loss_fn(cfg, mesh, n_micro: int, family: str = "dense"):
 
         logits = logits[:, :-1]
         targets = tokens[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        loss = nll.mean()
+        loss = tfm.token_nll(logits, targets).mean()
         if family == "moe":
             loss = loss + cfg.router_aux_weight * out[1].mean() / cfg.n_layers
         return loss
