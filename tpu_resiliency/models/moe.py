@@ -23,7 +23,6 @@ dense model (``transformer._attn_block``), so ring attention over ``sp`` compose
 from __future__ import annotations
 
 import dataclasses
-import functools
 import math
 
 import jax
@@ -139,12 +138,7 @@ def forward(
     position_offset: int = 0,
 ):
     """tokens [B, T] → (logits [B, T, V] float32, aux loss scalar)."""
-    if attn_fn is not None and position_offset:
-        raise ValueError(
-            "position_offset is only applied to the default dense attention; "
-            "a custom attn_fn must handle positions itself"
-        )
-    attn_fn = attn_fn or functools.partial(tfm._attention, causal_offset=position_offset)
+    attn_fn = tfm.adapt_attn_fn(attn_fn, position_offset)
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = tfm.rope_tables(cfg, tokens.shape[1], position_offset)
 

@@ -116,16 +116,51 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 def _attention(q, k, v, causal_offset: int = 0):
-    """Plain causal attention. q: [B, T, H, dh], k/v: [B, T, H, dh] (kv pre-repeated)."""
+    """Plain causal attention. q: [B, T, H, dh]; k/v: [B, T, Hkv, dh] with
+    H % Hkv == 0 (GQA) — query heads are grouped per KV head in the einsum
+    itself, so repeated K/V are never materialized in HBM."""
     dh = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / np.sqrt(dh)
-    tq, tk = q.shape[1], k.shape[1]
+    b, tq, h, _ = q.shape
+    tk, hkv = k.shape[1], k.shape[2]
+    qg = q.reshape(b, tq, hkv, h // hkv, dh)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(jnp.float32) / np.sqrt(dh)
     qpos = jnp.arange(tq)[:, None] + causal_offset
     kpos = jnp.arange(tk)[None, :]
     mask = qpos >= kpos
-    scores = jnp.where(mask[None, None], scores, -1e30)
+    scores = jnp.where(mask[None, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, tq, h, dh)
+
+
+def adapt_attn_fn(attn_fn, causal_offset: int = 0):
+    """Resolve the layer-level attention callable from a user override.
+
+    The attention blocks hand ``attn_fn`` GQA-shaped tensors (q ``[B, T, H, dh]``,
+    k/v ``[B, T, Hkv, dh]``). The default :func:`_attention` consumes those
+    directly — grouped in the einsum, repeated K/V never hit HBM. Custom fns
+    (e.g. ring attention) keep their documented pre-repeated-full-heads
+    contract, so they are wrapped with the repeat here, at the seam, where the
+    repeat happens before any sharding decisions the custom fn makes.
+
+    ``causal_offset`` only applies to the default dense attention; a custom fn
+    owns its own position bookkeeping, so combining the two is rejected here
+    rather than silently producing a mask anchored at 0."""
+    if attn_fn is not None and causal_offset:
+        raise ValueError(
+            "position_offset is only applied to the default dense attention; "
+            "a custom attn_fn must handle positions itself"
+        )
+    if attn_fn is None:
+        return functools.partial(_attention, causal_offset=causal_offset)
+
+    def repeated(q, k, v):
+        reps = q.shape[2] // k.shape[2]
+        if reps > 1:
+            k = jnp.repeat(k, reps, axis=2)
+            v = jnp.repeat(v, reps, axis=2)
+        return attn_fn(q, k, v)
+
+    return repeated
 
 
 def _attn_block(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_fn) -> jax.Array:
@@ -138,9 +173,6 @@ def _attn_block(cfg: TransformerConfig, x: jax.Array, lp: dict, cos, sin, attn_f
     v = (y @ lp["wv"].astype(y.dtype)).reshape(b, t, hkv, dh)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    reps = h // hkv
-    k = jnp.repeat(k, reps, axis=2)
-    v = jnp.repeat(v, reps, axis=2)
     attn = attn_fn(q, k, v).reshape(b, t, h * dh)
     return x + attn @ lp["wo"].astype(attn.dtype)
 
@@ -168,14 +200,10 @@ def forward(
 
     ``position_offset`` is applied to RoPE and to the DEFAULT dense attention's
     causal mask only; a custom ``attn_fn`` (e.g. ring attention) owns its own
-    position bookkeeping, so combining the two is rejected rather than silently
-    producing a mask anchored at 0."""
-    if attn_fn is not None and position_offset:
-        raise ValueError(
-            "position_offset is only applied to the default dense attention; "
-            "a custom attn_fn must handle positions itself"
-        )
-    attn_fn = attn_fn or functools.partial(_attention, causal_offset=position_offset)
+    position bookkeeping, so combining the two is rejected (in
+    :func:`adapt_attn_fn`) rather than silently producing a mask anchored
+    at 0."""
+    attn_fn = adapt_attn_fn(attn_fn, position_offset)
     x = params["embed"].astype(cfg.dtype)[tokens]
     cos, sin = rope_tables(cfg, tokens.shape[1], position_offset)
 

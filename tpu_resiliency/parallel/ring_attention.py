@@ -97,8 +97,9 @@ def make_ring_attn_fn(mesh, *, causal: bool = True, axis_name: str = SP,
                       batch_axis: str = DP, head_axis: str = TP):
     """Build an ``attn_fn`` for ``models.transformer.forward``: q/k/v enter as
     [B, T, H, dh] logically; physically sharded (batch over ``dp``, sequence over
-    ``sp``, heads over ``tp``). KV must be pre-repeated to full heads (the
-    transformer layer does this), so head counts divide over ``tp``."""
+    ``sp``, heads over ``tp``). KV must be pre-repeated to full heads
+    (``transformer.adapt_attn_fn`` wraps custom fns with exactly that repeat),
+    so head counts divide over ``tp``."""
     kernel = _cached_sharded_kernel(mesh, axis_name, causal, batch_axis, head_axis)
 
     def attn_fn(q, k, v):
