@@ -87,3 +87,30 @@ def test_graft_entry():
     shape = jax.eval_shape(fn, *args)
     assert shape.shape == (2, 32, 256)
     mod.dryrun_multichip(8)
+
+
+def test_adapt_attn_fn_contract(tiny):
+    """Custom attn fns get pre-repeated full-head K/V (their documented
+    contract) and cannot be combined with position_offset."""
+    import pytest
+
+    cfg, params = tiny
+    seen = {}
+
+    def spy(q, k, v):
+        seen["shapes"] = (q.shape, k.shape, v.shape)
+        return tfm._attention(q, k, v)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (1, 8), 0, cfg.vocab_size)
+    tfm.forward(params, tokens, cfg, attn_fn=spy)
+    qs, ks, vs = seen["shapes"]
+    assert qs[2] == cfg.n_heads
+    assert ks[2] == vs[2] == cfg.n_heads, "custom fn must see repeated K/V"
+
+    with pytest.raises(ValueError, match="position_offset"):
+        tfm.forward(params, tokens, cfg, attn_fn=spy, position_offset=2)
+
+    # default path: offset shifts RoPE, so logits must differ from offset=0
+    base = tfm.forward(params, tokens, cfg)
+    off = tfm.forward(params, tokens, cfg, position_offset=3)
+    assert not np.allclose(np.asarray(base), np.asarray(off))
