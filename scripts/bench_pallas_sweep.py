@@ -113,7 +113,7 @@ def main():
                     row[variant] = measure(r, w, variant)
                 except Exception as e:
                     row[variant] = None
-                    print(f"R={r} W={w} {variant}: FAILED {e!r}"[:200], file=sys.stderr)
+                    print(f"R={r} W={w} {variant}: FAILED {e!r}"[:4000], file=sys.stderr)
             results[f"{r}x{w}"] = row
             pallas_times = {
                 k: v for k, v in row.items() if k != "xla" and v is not None

@@ -35,13 +35,28 @@ run_example() {
   fi
 }
 # Single-process examples run against the device directly (--tpu / platform
-# env); multi-process examples keep CPU simulation for their ranks (the
-# single-tenant tunnel cannot host N concurrent jax clients) but still prove
-# the user-facing surface executes in this environment.
+# env); multi-process examples MUST force JAX_PLATFORMS=cpu for their ranks:
+# the ambient environment pins JAX_PLATFORMS to the device platform, the
+# single-tenant tunnel cannot host N concurrent jax clients, and workers that
+# inherit the device platform wedge at backend init until the monitor's hard
+# timeout kills them. CPU ranks still prove the user-facing surface executes
+# in this environment. PYTHONPATH covers spawned workers, which don't inherit
+# the parent's sys.path bootstrap.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 run_example moe_pipeline_TPU    python examples/moe_pipeline_training.py --tpu
 run_example mesh_telemetry      python examples/mesh_telemetry_training.py
-run_example inprocess_restart   python examples/inprocess_restart_train.py --world 2 --steps 8 --ckpt-every 2 --kill-rank 1 --kill-step 4 --step-time 0.05
-run_example preemption          python examples/preemption_train.py --world 2
-run_example layered_restart     python examples/layered_restart.py
-run_example resilient_training  python examples/resilient_training.py
+run_example inprocess_restart   env JAX_PLATFORMS=cpu python examples/inprocess_restart_train.py --world 2 --steps 8 --ckpt-every 2 --kill-rank 1 --kill-step 4 --step-time 0.05
+run_example preemption          env JAX_PLATFORMS=cpu python examples/preemption_train.py --world 2
+# The last two are launcher-driven by design (their docstrings); bare
+# invocation has no monitor sockets and no in-job restart layer.
+run_example layered_restart     env JAX_PLATFORMS=cpu python -m tpu_resiliency.launcher.launch \
+  --nproc-per-node 2 --max-restarts 2 --no-ft-monitors \
+  --rdzv-endpoint 127.0.0.1:0 --rdzv-last-call 0.2 --monitor-interval 0.1 \
+  examples/layered_restart.py --steps 20
+run_example resilient_training  env JAX_PLATFORMS=cpu python -m tpu_resiliency.launcher.launch \
+  --nproc-per-node 1 --max-restarts 2 \
+  --rdzv-endpoint 127.0.0.1:0 --rdzv-last-call 0.2 --monitor-interval 0.1 \
+  --ft-param-initial_rank_heartbeat_timeout 60 \
+  --ft-param-rank_heartbeat_timeout 60 \
+  examples/resilient_training.py --ckpt-dir "$(mktemp -d)"
 echo "== done; encode the sweep exports in BASELINE.md and flip the radix default if pallas_beats_xla_at says so"
