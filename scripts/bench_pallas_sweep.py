@@ -10,8 +10,10 @@ auto-select boundary from the measurements:
 - ``loop_max_window``: largest W where the loop kernel is the best variant at
   every tested R → export as ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW`` (beyond it
   auto-select runs radix).
-- ``pallas_beats_xla_at``: per-W verdict of best-Pallas vs XLA (the
-  use_pallas gate justification).
+- ``pallas_beats_xla_at``: per-W verdict of best-Pallas vs XLA under the
+  same noise tolerance as the cap (``TOL``), so the two exports cannot
+  contradict each other on a sub-noise tie (the use_pallas gate
+  justification).
 
 Run on a real TPU (device-true per-program times via the framework's own
 DeviceTimeProfiler; wall clocks lie on remote-dispatch runtimes):
@@ -31,6 +33,14 @@ if _REPO_ROOT not in _sys.path:
 
 S = 64
 ITERS = 20
+
+#: Measurement-noise tolerance for BOTH exported decisions: a variant keeps
+#: its "win" on a cell unless it is more than 2% slower than the alternative
+#: (ties and sub-2% deficits count as wins — deliberately asymmetric toward
+#: the Pallas path). On v5e, W=64 reads as an XLA "win" by 0.3-0.8% at small
+#: R while loop wins 25% at R=4096 — a sub-noise tie must not flip either
+#: export.
+TOL = 1.02
 
 
 def measure(r, w, variant):
@@ -120,11 +130,13 @@ def main():
             }
             best_pallas = min(pallas_times.values(), default=None)
             # THIS row's verdict; the *_by_w flags separately accumulate the
-            # every-R requirement for the exported defaults.
+            # every-R requirement for the exported defaults. Same TOL as the
+            # loop cap so the two exports cannot contradict each other on a
+            # sub-noise tie.
             row_pallas_wins = (
                 best_pallas is not None
                 and row.get("xla") is not None
-                and best_pallas < row["xla"]
+                and best_pallas <= TOL * row["xla"]
             )
             if not row_pallas_wins:
                 pallas_wins_by_w[w] = False
@@ -133,8 +145,8 @@ def main():
             loop_t = row.get("pallas-loop")
             loop_ok = (
                 loop_t is not None
-                and (row.get("pallas-radix") is None or loop_t <= row["pallas-radix"])
-                and (row.get("xla") is None or loop_t < row["xla"])
+                and (row.get("pallas-radix") is None or loop_t <= TOL * row["pallas-radix"])
+                and (row.get("xla") is None or loop_t <= TOL * row["xla"])
             )
             if not loop_ok:
                 loop_best_by_w[w] = False
@@ -160,10 +172,15 @@ def main():
                 "signals": S,
                 "results_ms": results,
                 "loop_max_window": loop_max_window,
+                "loop_tolerance": TOL,
                 "pallas_beats_xla_at": {
                     str(w): pallas_wins_by_w[w] for w in sorted(ws)
                 },
                 "export": f"TPU_RESILIENCY_PALLAS_MAX_WINDOW={loop_max_window}",
+                # Stable schema with the merge flow that annotates a wedged
+                # run's artifact (BASELINE.md references these fields).
+                "carried_cells": [],
+                "note": "",
             }
         )
     )

@@ -104,7 +104,7 @@ def radix_case(draw):
     (past the quadratic cap) where auto_mode actually selects radix."""
     r = draw(st.integers(2, 6))
     s = draw(st.integers(1, 3))
-    w = draw(st.one_of(st.integers(1, 10), st.integers(65, 130)))
+    w = draw(st.one_of(st.integers(1, 10), st.integers(129, 260)))
     data = draw(
         st.lists(
             st.floats(np.float32(1e-4), np.float32(1e3), allow_nan=False, allow_subnormal=False, width=32),

@@ -280,26 +280,32 @@ def test_pallas_window_gate(monkeypatch):
     # Shape gating alone (no window): unchanged behavior.
     assert sp.pallas_supported(32)
     assert not sp.pallas_supported(33)
-    # Mode-auto: past the cap the mode would be radix, but auto-selection
-    # requires the device-measured opt-in; explicit radix always works.
+    # Mode-auto: past the cap (measured at 128 on v5e) the mode would be
+    # radix, but auto-selection requires the device-measured opt-in;
+    # explicit radix always works.
     assert sp.pallas_supported(32, window=32)
-    assert not sp.pallas_supported(32, window=128)
-    assert sp.auto_mode(64) == "loop"
-    assert sp.auto_mode(128) == "radix"
+    assert not sp.pallas_supported(32, window=256)
+    assert sp.auto_mode(128) == "loop"
+    assert sp.auto_mode(256) == "radix"
     monkeypatch.setenv(sp.RADIX_ENV, "on")
-    assert sp.pallas_supported(32, window=128)
     assert sp.pallas_supported(32, window=256)
+    assert sp.pallas_supported(32, window=512)
     monkeypatch.delenv(sp.RADIX_ENV)
     # Explicit quadratic modes stay capped.
-    assert sp.pallas_supported(32, mode="loop", window=64)
-    assert not sp.pallas_supported(32, mode="loop", window=128)
-    assert not sp.pallas_supported(32, mode="pairwise", window=128)
-    assert sp.pallas_supported(32, mode="radix", window=256)
-    # Operator encoded a measured crossover: the loop kernel reaches further.
-    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "128")
-    assert sp.auto_mode(128) == "loop"
     assert sp.pallas_supported(32, mode="loop", window=128)
     assert not sp.pallas_supported(32, mode="loop", window=256)
+    # Pairwise carries its own measured bound (compiles only at W=32 on v5e),
+    # independent of the loop cap.
+    assert sp.pallas_supported(32, mode="pairwise", window=32)
+    assert not sp.pallas_supported(32, mode="pairwise", window=64)
+    assert not sp.pallas_supported(32, mode="pairwise", window=256)
+    assert sp.pallas_supported(32, mode="radix", window=256)
+    # Operator encoded a smaller measured crossover for their device: the
+    # loop kernel's reach shrinks and auto-select hands W=64 to radix.
+    monkeypatch.setenv(sp.MAX_WINDOW_ENV, "32")
+    assert sp.auto_mode(64) == "radix"
+    assert sp.pallas_supported(32, mode="loop", window=32)
+    assert not sp.pallas_supported(32, mode="loop", window=64)
     monkeypatch.setenv(sp.MAX_WINDOW_ENV, "junk")
     assert sp.max_auto_window() == sp.DEFAULT_MAX_WINDOW
 
@@ -318,9 +324,9 @@ def test_mesh_telemetry_autoselect_large_window(monkeypatch):
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("rank",))
     try:
         mt_small = MeshTelemetry(mesh, "rank", n_ranks=32, window=32)
-        mt_large = MeshTelemetry(mesh, "rank", n_ranks=32, window=128)
+        mt_large = MeshTelemetry(mesh, "rank", n_ranks=32, window=256)
         monkeypatch.setenv(sp.RADIX_ENV, "on")
-        mt_large_opted = MeshTelemetry(mesh, "rank", n_ranks=32, window=128)
+        mt_large_opted = MeshTelemetry(mesh, "rank", n_ranks=32, window=256)
     finally:
         monkeypatch.undo()
     assert mt_small.use_pallas is True

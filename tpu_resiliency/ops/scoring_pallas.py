@@ -165,27 +165,36 @@ def _median_weights_radix_kernel(data_ref, counts_ref, med_ref, weight_ref):
 
 #: Largest window the O(W²) kernels (loop / pairwise) are auto-selected for;
 #: beyond it auto-selection switches to the radix kernel (O(32·W), no cap)
-#: instead of falling back to the XLA sort. From the measured W=32 point
-#: (4.31 ms loop vs 8.43 ms XLA, device-true) the T∝W² model puts loop's
-#: crossover between 64 and 128 — the default cap is 64, the largest
-#: predicted-winning size. ``scripts/bench_pallas_sweep.py`` measures the real
-#: per-device crossover; operators encode its result via
-#: ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
-DEFAULT_MAX_WINDOW = 64
+#: instead of falling back to the XLA sort. MEASURED on v5e
+#: (``BENCH_pallas_sweep.json``, device-true, W∈{32..256} × R∈{256..4096}):
+#: the loop kernel beats both the XLA sort and the radix kernel at every
+#: tested R for W≤128 (up to 2.0×; the only counter-reads are ≤0.8%
+#: small-R ties at W=64, within noise, against a 25% loop win at R=4096),
+#: and loses hard at W=256 (XLA 2.2–3.7× faster) — so the measured cap
+#: is 128. Operators re-derive it per device via
+#: ``scripts/bench_pallas_sweep.py`` → ``$TPU_RESILIENCY_PALLAS_MAX_WINDOW``.
+DEFAULT_MAX_WINDOW = 128
 MAX_WINDOW_ENV = "TPU_RESILIENCY_PALLAS_MAX_WINDOW"
 
 #: Opt-in for AUTO-selecting the radix kernel past the loop cap (explicit
-#: ``mode="radix"`` always works). Default off: the kernel is CPU-interpret
-#: validated but has no on-device measurement yet — until the sweep artifact
-#: shows it beating the XLA sort at large W, auto-selection must not swap a
-#: user's proven XLA path for an unmeasured kernel. ``run_tpu_artifacts.sh``
-#: runs the sweep; its JSON (``pallas_beats_xla_at``) is the basis for
-#: setting this to "on" (or flipping the in-tree default).
+#: ``mode="radix"`` always works). Default off, now on measurement rather
+#: than absence of it (``BENCH_pallas_sweep.json``): radix's pass cost is
+#: flat in W but loses to the loop kernel at every W≤128 (where the loop is
+#: auto-selected anyway) and to the XLA sort at W=128 (19.7 vs 18.0 ms at
+#: R=4096); at W=256 — the one regime it could win (projected ~20 vs
+#: 22.8 ms) — it currently fails to Mosaic-compile on v5e. Flip only once a
+#: sweep shows it compiling AND beating the sort past the loop cap.
 RADIX_ENV = "TPU_RESILIENCY_PALLAS_RADIX"
 DEFAULT_RADIX_AUTO = False
 
 #: Modes whose work grows quadratically with the window (subject to the cap).
 _QUADRATIC_MODES = ("loop", "pairwise")
+
+#: Pairwise has its own, smaller bound: the sweep measured it compiling only
+#: at W=32 on v5e (S-folded; Mosaic rejects its 4-D blocks at W=64 even
+#: folded) and losing to the loop kernel 4-5x where it runs — the shared
+#: loop cap must not re-open a gate the measurement closed.
+PAIRWISE_MAX_WINDOW = 32
 
 
 def max_auto_window() -> int:
@@ -240,8 +249,10 @@ def pallas_supported(
         mode = auto_mode(window) if window is not None else "loop"
         if mode == "radix" and not radix_auto_enabled():
             return False
-    elif window is not None and mode in _QUADRATIC_MODES and window > max_auto_window():
-        return False
+    elif window is not None and mode in _QUADRATIC_MODES:
+        cap = PAIRWISE_MAX_WINDOW if mode == "pairwise" else max_auto_window()
+        if window > cap:
+            return False
     if rank_tile is None:
         rank_tile = default_rank_tile(mode)
     tile = min(rank_tile, n_ranks)
