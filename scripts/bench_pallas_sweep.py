@@ -3,8 +3,12 @@ the measured data behind ``scoring_pallas`` auto-selection (VERDICT r3 item 5 /
 r4 item 3).
 
 Three kernel formulations are measured: ``loop`` (rank-counting, O(W²)),
-``pairwise`` (all-pairs block, O(W²) VMEM-heavy, W≤64 only), and ``radix``
-(bit-select, O(32·W) — the scaling-safe mode). The JSON tail derives the
+``pairwise`` (all-pairs block, O(W²) VMEM-heavy; the product gate caps it at
+the measured ``PAIRWISE_MAX_WINDOW`` = 32, but the sweep deliberately probes
+up to W=64 so a different device generation that can compile it gets
+measured rather than assumed — W>64 is skipped outright for its quadratic
+VMEM temporaries), and ``radix`` (bit-select, O(32·W) — the scaling-safe
+mode). The JSON tail derives the
 auto-select boundary from the measurements:
 
 - ``loop_max_window``: largest W where the loop kernel is the best variant at
@@ -125,8 +129,12 @@ def main():
                     row[variant] = None
                     print(f"R={r} W={w} {variant}: FAILED {e!r}"[:4000], file=sys.stderr)
             results[f"{r}x{w}"] = row
+            # Pairwise never auto-selects, so it votes in neither export —
+            # a pairwise-only win would certify a path use_pallas can't run.
             pallas_times = {
-                k: v for k, v in row.items() if k != "xla" and v is not None
+                k: v
+                for k, v in row.items()
+                if k not in ("xla", "pallas-pairwise") and v is not None
             }
             best_pallas = min(pallas_times.values(), default=None)
             # THIS row's verdict; the *_by_w flags separately accumulate the

@@ -270,6 +270,152 @@ def test_pallas_radix_large_window_matches_numpy():
                     np.testing.assert_allclose(wt[i, j], data[i, j, :n].sum(), rtol=1e-5)
 
 
+def test_radix_block_budget_shrinks_default_tile():
+    """The radix default rank tile halves until the [RT, S, W] block fits the
+    proven element budget (v5e compile fails at 32x64x256 blocks; 32x64x128 is
+    proven), and the shrunk tile preserves the caller-checked divisibility.
+    Explicit rank_tile is honored unchanged."""
+    from tpu_resiliency.ops import scoring_pallas as sp
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    assert sp.mode_rank_tile("radix", 64, 128) == 32  # largest proven block: no shrink
+    assert sp.mode_rank_tile("radix", 64, 256) == 16  # one halving
+    assert sp.mode_rank_tile("radix", 64, 512) == 8
+    assert sp.mode_rank_tile("radix", 1, 32) == 32  # tiny shapes never shrink
+    assert sp.mode_rank_tile("radix", 64, 2**20) == 1  # halving helper floors at 1...
+    # ...but a single rank-row over budget is rejected outright: no tile fits.
+    assert sp._snap_tile("radix", 32, 64, 8192) is None
+    assert not sp.pallas_supported(32, mode="radix", window=8192, signals=64)
+    with pytest.raises(ValueError, match="radix mode at window 8192"):
+        fused_median_weights(
+            jnp.zeros((2, 64, 8192), jnp.float32),
+            jnp.zeros((2, 64), jnp.int32),
+            interpret=True,
+            mode="radix",
+        )
+
+    # A rank count the gate admits (R % min(32, R) == 0) but the shrunk tile
+    # does not divide: the default path snaps to the largest dividing tile
+    # instead of raising at score time (gate has no S to mirror the shrink
+    # unless told the signal count).
+    r, s, w = 24, 64, 256
+    assert sp.pallas_supported(r, mode="radix", window=w)
+    assert sp.pallas_supported(r, mode="radix", window=w, signals=s)
+    rng24 = np.random.default_rng(5)
+    d24 = rng24.uniform(0.5, 2.0, (r, s, w)).astype(np.float32)
+    c24 = rng24.integers(0, w + 1, (r, s)).astype(np.int32)
+    m24, _ = fused_median_weights(
+        jnp.asarray(d24), jnp.asarray(c24), interpret=True, mode="radix",
+    )
+    n = c24[17, 33]
+    v = np.sort(d24[17, 33, :n])
+    assert np.asarray(m24)[17, 33] == np.float32(0.5 * (v[(n - 1) // 2] + v[n // 2]))
+
+    # Near-prime R past the budget: the snap would shatter the grid into
+    # [1, S, W] blocks — both gate (when it knows S) and kernel reject loudly
+    # instead of silently running far slower than the XLA sort.
+    assert sp._snap_tile("radix", 31, 64, 256) is None
+    assert not sp.pallas_supported(31, mode="radix", window=256, signals=64)
+    assert sp.pallas_supported(31, mode="radix", window=256)  # S unknown: permissive
+    with pytest.raises(ValueError, match="radix mode at window 256"):
+        fused_median_weights(
+            jnp.zeros((31, 64, 256), jnp.float32),
+            jnp.zeros((31, 64), jnp.int32),
+            interpret=True,
+            mode="radix",
+        )
+    # Small worlds are NOT degenerate: one whole-R block is a single grid step.
+    assert sp._snap_tile("radix", 4, 64, 256) == 4
+    assert sp.pallas_supported(4, mode="radix", window=256, signals=64)
+
+    # Explicit rank_tile is honored unchanged through the budget path: the
+    # caller asked for 32-rank blocks at W=256 and must get them.
+    r32 = 32
+    d32 = rng24.uniform(0.5, 2.0, (r32, s, w)).astype(np.float32)
+    c32 = rng24.integers(0, w + 1, (r32, s)).astype(np.int32)
+    m_def, _ = fused_median_weights(
+        jnp.asarray(d32), jnp.asarray(c32), interpret=True, mode="radix",
+    )
+    m_exp, _ = fused_median_weights(
+        jnp.asarray(d32), jnp.asarray(c32), interpret=True, mode="radix",
+        rank_tile=32,
+    )
+    np.testing.assert_array_equal(np.asarray(m_def), np.asarray(m_exp))
+    # ...and an explicit non-dividing tile hits the divisibility error — the
+    # snap (which would have repaired 16 -> 12 at R=24) must not touch it.
+    with pytest.raises(ValueError, match="not divisible"):
+        fused_median_weights(
+            jnp.asarray(d24), jnp.asarray(c24), interpret=True, mode="radix",
+            rank_tile=16,
+        )
+
+
+def test_loop_block_budget_mirrors_radix_guard():
+    """The loop kernel's proven block is 32x64x256; a many-signal config at the
+    raised W=128 cap would exceed it at the default tile, so the same shrink /
+    loud-reject machinery applies (the cap raise must not re-open an unproven
+    VMEM regime)."""
+    from tpu_resiliency.ops import scoring_pallas as sp
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    # 32*256*128 = 2x the proven loop block: tile halves to 16.
+    assert sp._snap_tile("loop", 32, 256, 128) == 16
+    assert sp.pallas_supported(32, mode="loop", window=128, signals=256)
+    rng = np.random.default_rng(21)
+    r, s, w = 32, 256, 128
+    data = rng.uniform(0.5, 2.0, (r, s, w)).astype(np.float32)
+    counts = rng.integers(0, w + 1, (r, s)).astype(np.int32)
+    med, _ = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="loop"
+    )
+    med = np.asarray(med)
+    for i in range(0, r, 11):
+        for j in range(0, s, 37):
+            n = counts[i, j]
+            if n:
+                v = np.sort(data[i, j, :n])
+                assert med[i, j] == np.float32(0.5 * (v[(n - 1) // 2] + v[n // 2]))
+            else:
+                assert med[i, j] == np.inf
+
+    # A single rank-row past the loop budget (S*W > 32*64*256): gate rejects
+    # (with signals), kernel raises loudly.
+    assert sp._snap_tile("loop", 8, 4100, 128) is None
+    assert not sp.pallas_supported(8, mode="loop", window=128, signals=4100)
+    with pytest.raises(ValueError, match="loop mode at window"):
+        fused_median_weights(
+            jnp.zeros((2, 4100, 128), jnp.float32),
+            jnp.zeros((2, 4100), jnp.int32),
+            interpret=True,
+            mode="loop",
+        )
+
+
+def test_radix_default_tile_end_to_end_at_failing_shape():
+    """End-to-end at the shape whose compile failed on-device (32x64x256):
+    the default radix tile must shrink to 16 and the kernel must still match
+    numpy (interpret mode)."""
+    from tpu_resiliency.ops.scoring_pallas import fused_median_weights
+
+    rng = np.random.default_rng(3)
+    r, s, w = 32, 64, 256
+    data = rng.uniform(0.5, 2.0, (r, s, w)).astype(np.float32)
+    counts = rng.integers(0, w + 1, (r, s)).astype(np.int32)
+    med, wt = fused_median_weights(
+        jnp.asarray(data), jnp.asarray(counts), interpret=True, mode="radix",
+    )
+    med = np.asarray(med)
+    for i in range(0, r, 7):
+        for j in range(0, s, 13):
+            n = counts[i, j]
+            if n == 0:
+                assert med[i, j] == np.inf
+            else:
+                valid = np.sort(data[i, j, :n])
+                expect = 0.5 * (valid[(n - 1) // 2] + valid[n // 2])
+                assert med[i, j] == np.float32(expect), (i, j, n)
+
+
 def test_pallas_window_gate(monkeypatch):
     """Auto-selection must not hand a large-window user an O(W^2) kernel: the
     quadratic modes cap at the measured crossover (env-overridable once the
@@ -299,6 +445,10 @@ def test_pallas_window_gate(monkeypatch):
     assert sp.pallas_supported(32, mode="pairwise", window=32)
     assert not sp.pallas_supported(32, mode="pairwise", window=64)
     assert not sp.pallas_supported(32, mode="pairwise", window=256)
+    # ...and, when the gate knows S, the kernel's near-prime S-fold rejection
+    # too (S=37 has no fold divisor in [8, 32]; S=48 folds at 24).
+    assert not sp.pallas_supported(32, mode="pairwise", window=32, signals=37)
+    assert sp.pallas_supported(32, mode="pairwise", window=32, signals=48)
     assert sp.pallas_supported(32, mode="radix", window=256)
     # Operator encoded a smaller measured crossover for their device: the
     # loop kernel's reach shrinks and auto-select hands W=64 to radix.

@@ -228,17 +228,71 @@ def default_rank_tile(mode: str) -> int:
     return 8 if mode == "pairwise" else 32
 
 
+#: Largest [RT, S, W] element count a default block may hold, per mode —
+#: each set to the largest block PROVEN to Mosaic-compile on v5e by the live
+#: sweep. The radix kernel carries more concurrent W-sized temporaries than
+#: the loop kernel (x, int32 key, candidate mask, plus the selection
+#: carries): its compile fails at 32·64·256-element blocks (≈2 MB/array, ~6
+#: live arrays brushes VMEM) while every 32·64·128 block is proven. The loop
+#: kernel compiled and ran at 32·64·256 (the W=256 sweep column), so its
+#: budget is 2× radix's. Default tiles halve until the block fits the
+#: budget. Halving preserves the gate-checked divisibility only when 32 | R;
+#: for other admitted rank counts :func:`_snap_tile` snaps to the largest
+#: divisor of R within budget (and both the gate and the kernel reject the
+#: degenerate near-prime-R grids that snap produces, as well as single
+#: rank-rows that already exceed the budget).
+MODE_BLOCK_ELEMS = {
+    "loop": 32 * 64 * 256,
+    "radix": 32 * 64 * 128,
+}
+
+#: Snapped tiles more than this factor below the budget tile mean a
+#: near-prime rank count shattered the grid into many tiny blocks — a
+#: pathological launch far slower than the XLA sort, rejected loudly like
+#: pairwise's near-prime S fold. Relative (not an absolute tile floor): a
+#: snapped tile of 7 on a budget of 8 is a fine 2-block grid at R=14, while
+#: a snapped tile of 1 on a budget of 16 is a 31-block shatter at R=31.
+SNAP_SHATTER_FACTOR = 4
+
+
+def mode_rank_tile(mode: str, s: int, w: int, base: int = 32) -> int:
+    tile = base
+    budget = MODE_BLOCK_ELEMS[mode]
+    while tile > 1 and tile * s * w > budget:
+        tile //= 2
+    return tile
+
+
+def _snap_tile(mode: str, r: int, s: int, w: int, base: int = 32) -> int | None:
+    """Default tile for ``[r, s, w]`` in a budgeted mode: the largest divisor
+    of ``r`` within the VMEM budget. ``None`` marks the shapes callers must
+    reject: a single rank-row already over budget (no tile can fit), or a
+    degenerate divisor far below the budget tile (shattered grid)."""
+    if s * w > MODE_BLOCK_ELEMS[mode]:
+        return None
+    shrunk = min(mode_rank_tile(mode, s, w, base), r)
+    snapped = next(d for d in range(shrunk, 0, -1) if r % d == 0)
+    if snapped * SNAP_SHATTER_FACTOR < shrunk:
+        return None
+    return snapped
+
+
 def pallas_supported(
     n_ranks: int,
     rank_tile: int | None = None,
     mode: str | None = None,
     window: int | None = None,
+    signals: int | None = None,
 ) -> bool:
     """Shape gate for auto-selection: the kernel tiles the rank axis, so the
     per-shard rank count must be a whole number of tiles (or fit in one). Pass
     the same ``mode``/``rank_tile`` that will be given to
     :func:`fused_median_weights`; ``mode=None`` means :func:`auto_mode` (which
-    needs ``window``).
+    needs ``window``). Pass ``signals`` too when known: the budgeted modes'
+    (loop/radix) VMEM block budget can shrink their default tile, and only
+    with the signal count can the gate mirror that shrink (and reject the
+    near-prime rank counts whose snapped tile degenerates, or single
+    rank-rows that exceed the budget outright).
 
     An explicitly quadratic ``mode`` is rejected past the measured window cap —
     auto-selection must not hand a W=128 user a silent O(W²) blowup. With mode
@@ -253,8 +307,18 @@ def pallas_supported(
         cap = PAIRWISE_MAX_WINDOW if mode == "pairwise" else max_auto_window()
         if window > cap:
             return False
+    if signals is not None and mode == "pairwise" and signals > 32:
+        # Mirror the kernel's S-fold rejection (Mosaic caps its 4-D block at
+        # S<=32; a near-prime S has no usable fold divisor and raises there).
+        if next((d for d in range(32, 0, -1) if signals % d == 0), 0) < 8:
+            return False
     if rank_tile is None:
         rank_tile = default_rank_tile(mode)
+        if mode in MODE_BLOCK_ELEMS and window is not None and signals is not None:
+            snapped = _snap_tile(mode, n_ranks, signals, window, rank_tile)
+            if snapped is None:
+                return False
+            rank_tile = snapped
     tile = min(rank_tile, n_ranks)
     return tile > 0 and n_ranks % tile == 0
 
@@ -292,6 +356,25 @@ def fused_median_weights(
     kernel = _KERNELS[mode]
     if rank_tile is None:
         rank_tile = default_rank_tile(mode)
+        if mode in MODE_BLOCK_ELEMS:
+            snapped = _snap_tile(mode, r, s, w, rank_tile)
+            if snapped is None:
+                # Mirror the pairwise near-prime-S rejection: over-budget
+                # blocks fail Mosaic, shattered grids silently run far
+                # slower than the XLA sort — both fail loudly here.
+                detail = (
+                    f"a single rank-row ({s}x{w} elements) exceeds the VMEM "
+                    f"block budget ({MODE_BLOCK_ELEMS[mode]})"
+                    if s * w > MODE_BLOCK_ELEMS[mode]
+                    else f"rank count {r} has no divisor near the budget "
+                    f"tile {mode_rank_tile(mode, s, w)} (within "
+                    f"{SNAP_SHATTER_FACTOR}x) — the grid would shatter"
+                )
+                raise ValueError(
+                    f"{mode} mode at window {w}: {detail}; pass rank_tile "
+                    f"explicitly or use the XLA path"
+                )
+            rank_tile = snapped
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     rank_tile = min(rank_tile, r)

@@ -136,7 +136,11 @@ class MeshTelemetry:
 
             use_pallas = (
                 jax.default_backend() == "tpu"
-                and pallas_supported(self.n_ranks // axis_size, window=self.window)
+                and pallas_supported(
+                    self.n_ranks // axis_size,
+                    window=self.window,
+                    signals=self.n_signals,
+                )
             )
         self.use_pallas = use_pallas
         self._row_sharding = NamedSharding(mesh, P(axis))
