@@ -240,6 +240,11 @@ def probe_backend_alive(timeout: float | None = None, attempts: int | None = Non
     if attempts is None:
         attempts = int(os.environ.get("TPU_BENCH_PROBE_ATTEMPTS", "3"))
     for attempt in range(attempts):
+        # Every attempt gets the FULL window: a retry that lands just after
+        # the tunnel slot frees is a fresh subprocess paying the same
+        # cold-compile + handshake cost as attempt 1 — shortchanging it
+        # reproduces the round-3 "official artifact became a CPU number"
+        # incident this function exists to prevent.
         try:
             r = subprocess.run(
                 [

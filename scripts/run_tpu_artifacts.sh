@@ -59,4 +59,7 @@ run_example resilient_training  env JAX_PLATFORMS=cpu python -m tpu_resiliency.l
   --ft-param-initial_rank_heartbeat_timeout 60 \
   --ft-param-rank_heartbeat_timeout 60 \
   examples/resilient_training.py --ckpt-dir "$(mktemp -d)"
-echo "== done; encode the sweep exports in BASELINE.md and flip the radix default if pallas_beats_xla_at says so"
+echo "== done. Sweep exports are already encoded in-tree (DEFAULT_MAX_WINDOW=128 measured)."
+echo "== Remaining decision: if this run's sweep shows pallas-radix compiling at W=256"
+echo "== (VMEM tile shrink fix) AND beating xla there, flip DEFAULT_RADIX_AUTO in"
+echo "== ops/scoring_pallas.py from the artifact; otherwise leave it off (measured-losing)."

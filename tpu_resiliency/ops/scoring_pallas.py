@@ -263,6 +263,14 @@ def mode_rank_tile(mode: str, s: int, w: int, base: int = 32) -> int:
     return tile
 
 
+def _pairwise_fold_divisor(s: int) -> int:
+    """Largest signal-group size ≤32 that divides ``s`` — the S-fold unit the
+    pairwise kernel uses to stay under Mosaic's 4-D block limit. Shared by the
+    kernel's fold path and the shape gate so both always agree on which
+    near-prime signal counts are rejected (< 8 degenerates the grid)."""
+    return next(d for d in range(32, 0, -1) if s % d == 0)
+
+
 def _snap_tile(mode: str, r: int, s: int, w: int, base: int = 32) -> int | None:
     """Default tile for ``[r, s, w]`` in a budgeted mode: the largest divisor
     of ``r`` within the VMEM budget. ``None`` marks the shapes callers must
@@ -310,7 +318,7 @@ def pallas_supported(
     if signals is not None and mode == "pairwise" and signals > 32:
         # Mirror the kernel's S-fold rejection (Mosaic caps its 4-D block at
         # S<=32; a near-prime S has no usable fold divisor and raises there).
-        if next((d for d in range(32, 0, -1) if signals % d == 0), 0) < 8:
+        if _pairwise_fold_divisor(signals) < 8:
             return False
     if rank_tile is None:
         rank_tile = default_rank_tile(mode)
@@ -388,7 +396,7 @@ def fused_median_weights(
     # (Tiling S inside the grid instead is illegal: 2-D operand blocks must
     # keep their last dim full or 128-divisible.)
     if mode == "pairwise" and s > 32:
-        st = next(d for d in range(32, 0, -1) if s % d == 0)
+        st = _pairwise_fold_divisor(s)
         if st < 8:
             # A near-prime S would degenerate to single-signal blocks — a
             # pathological grid far slower than the XLA sort. Fail loudly.
