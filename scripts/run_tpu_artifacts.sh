@@ -44,7 +44,18 @@ run_example() {
 # the parent's sys.path bootstrap.
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 run_example moe_pipeline_TPU    python examples/moe_pipeline_training.py --tpu
-run_example mesh_telemetry      python examples/mesh_telemetry_training.py
+# mesh_telemetry is a jax.distributed multi-process example: launcher-driven
+# with a coordinator port, per its docstring (the script itself forces the CPU
+# simulation for its workers unless TPU_MESH_EXAMPLE_PLATFORM overrides).
+# Allocated-then-released just before use: the reuse window spans only the
+# launcher's bring-up (a couple of ephemeral binds vs the ~28k-port range);
+# a collision merely fails this one example line, visibly, on a rerunnable
+# script — accepted over plumbing the port through the launcher store.
+COORD_PORT=$(python -c "import socket;s=socket.socket();s.bind(('127.0.0.1',0));print(s.getsockname()[1]);s.close()")
+run_example mesh_telemetry      python -m tpu_resiliency.launcher.launch \
+  --nproc-per-node 2 --no-ft-monitors \
+  --rdzv-endpoint 127.0.0.1:0 --rdzv-last-call 0.2 --monitor-interval 0.1 \
+  examples/mesh_telemetry_training.py --coord-port "$COORD_PORT" --steps 150
 run_example inprocess_restart   env JAX_PLATFORMS=cpu python examples/inprocess_restart_train.py --world 2 --steps 8 --ckpt-every 2 --kill-rank 1 --kill-step 4 --step-time 0.05
 run_example preemption          env JAX_PLATFORMS=cpu python examples/preemption_train.py --world 2
 # The last two are launcher-driven by design (their docstrings); bare
