@@ -204,6 +204,35 @@ def test_straggler_callback_profiles_programs():
     assert cb._program_profiler is not None and not cb._program_profiler.active
 
 
+def test_straggler_callback_profiles_ops():
+    """profile_ops adds the per-op/scope granularity from the same windows:
+    op/... signals join the scored matrix alongside prog/... (PjRt client
+    per-op line on the CPU backend)."""
+    import jax
+    import jax.numpy as jnp
+
+    if Detector.initialized:
+        Detector.shutdown()
+    cb = StragglerDetectionCallback(
+        report_time_interval=0.0, profile_programs_every=2, profile_ops=True
+    )
+
+    @jax.jit
+    def work(x):
+        return jnp.tanh(x @ x).sum()
+
+    def step(state, i):
+        jax.block_until_ready(work(jnp.full((64, 64), float(i))))
+        return state + 1
+
+    ctx = run_training(step, 0, 24, callbacks=[cb])
+    assert ctx.state == 24
+    assert cb.last_report is not None
+    names = cb.last_report.section_names
+    assert any(n.startswith("prog/") for n in names), names
+    assert any(n.startswith("op/") for n in names), names
+
+
 def test_hierarchical_checkpoint_callback(tmp_path):
     from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
 

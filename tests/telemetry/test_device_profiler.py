@@ -133,3 +133,104 @@ def test_program_samples_join_the_scored_matrix():
         assert report.relative_section_scores["prog/jit_train_step"] == 1.0
     finally:
         Detector.shutdown()
+
+
+# --- per-op/scope granularity (the per-kernel-stream analogue) ----------------
+
+def test_op_scope_key_mapping():
+    """The pure event→key mapping both plane layouts share: tf_op scope paths
+    win (jit wrappers dropped, trailing op dropped), hlo_op/event names fall
+    back with compile-order instruction ids stripped, bookkeeping dies."""
+    from tpu_resiliency.telemetry.device_profiler import op_scope_key
+
+    # tf_op scope attribution (TPU "XLA Ops" events).
+    assert op_scope_key("%fusion.3", {"tf_op": "jit(step)/attn/dot_general"}) == "attn"
+    assert (
+        op_scope_key("%fusion.9", {"tf_op": "jit(step)/decoder/mlp/dot_general"})
+        == "decoder/mlp"
+    )
+    # Unscoped op: keys by its own de-numbered base name.
+    assert op_scope_key("%reduce.1", {"tf_op": "jit(step)/reduce.1"}) == "reduce"
+    assert op_scope_key("x", {"tf_op": "jit(step)"}) is None
+    # hlo_op fallback (CPU client line events).
+    assert op_scope_key("dot_general.2", {"hlo_op": "dot_general.2"}) == "dot_general"
+    assert op_scope_key("wrapped_tanh", {}) == "wrapped_tanh"
+    # Bookkeeping events are dropped.
+    assert op_scope_key("end: dot_general.2", {}) is None
+    assert op_scope_key("ThreadpoolListener::StartRegion", {}) is None
+
+
+def test_extract_op_times_prefers_device_ops_line():
+    from tpu_resiliency.telemetry.device_profiler import extract_op_times
+
+    @dataclasses.dataclass
+    class _EvS:
+        name: str
+        duration_ns: float
+        stats: list
+
+    pd = _PD(
+        planes=[
+            _Plane(
+                "/device:TPU:0",
+                [
+                    _Line("XLA Modules", [_Ev("jit_step(1)", 9e9)]),  # not ops
+                    _Line(
+                        "XLA Ops",
+                        [
+                            _EvS("%fusion.3", 1_000_000.0, [("tf_op", "jit(step)/attn/dot_general")]),
+                            _EvS("%fusion.3", 1_200_000.0, [("tf_op", "jit(step)/attn/dot_general")]),
+                            _EvS("%copy.1", 50_000.0, [("tf_op", "jit(step)/mlp/copy")]),
+                        ],
+                    ),
+                ],
+            ),
+            # Host client line must NOT be mixed in when a device ops line exists.
+            _Plane(
+                "/host:CPU",
+                [_Line("tf_XLAPjRtCpuClient/1", [_EvS("dot_general.2", 7e9, [])])],
+            ),
+        ]
+    )
+    times = extract_op_times(pd)
+    assert set(times) == {"attn", "mlp"}
+    np.testing.assert_allclose(times["attn"], [1e-3, 1.2e-3])
+
+
+def test_op_capture_window_end_to_end(tmp_path):
+    """collect_ops=True on a real CPU trace: the PjRt client per-op line feeds
+    op/scope rings through the same window contract (drain_ops/get_op_stats),
+    and the Detector turns them into scored op/... signals."""
+    prof = DeviceTimeProfiler(trace_root=str(tmp_path), collect_ops=True)
+
+    @jax.jit
+    def work(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((128, 128))
+    work(x)  # compile outside the window
+    with prof:
+        for _ in range(3):
+            jax.block_until_ready(work(x))
+
+    progs = prof.drain()
+    assert progs, "program samples must still be captured alongside ops"
+    ops = prof.drain_ops()
+    assert ops, "no op samples captured from the client per-op line"
+    assert all(all(s > 0 for s in v) for v in ops.values())
+    # The matmul appears under its de-numbered hlo base name on CPU.
+    assert any("dot" in k for k in ops), sorted(ops)
+    assert prof.drain_ops() == {}
+    st = prof.get_op_stats()
+    k = next(iter(st))
+    assert st[k]["count"] >= 1 and st[k]["min"] <= st[k]["max"]
+
+    Detector.initialize(rank=0, world_size=1, report_time_interval=3600.0)
+    try:
+        Detector.record_op_samples({k: [1.0e-3, 1.1e-3]})
+        report = Detector.generate_report()
+        assert f"op/{k}" in report.section_names
+    finally:
+        Detector.shutdown()
+    prof.reset()
+    assert prof.get_op_stats() == {}

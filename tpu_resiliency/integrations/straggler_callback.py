@@ -35,6 +35,7 @@ class StragglerDetectionCallback(Callback):
         use_device_mesh: bool = False,
         mesh_signal_capacity: int = 16,
         profile_programs_every: Optional[int] = None,
+        profile_ops: bool = False,
     ):
         """``health_policy``: an optional
         :class:`~tpu_resiliency.telemetry.policy.HealthVectorPolicy` fed every
@@ -45,6 +46,17 @@ class StragglerDetectionCallback(Callback):
         profiler window and feed per-compiled-program device times into the scored
         matrix as ``prog/...`` signals (the CUPTI capture-every-Nth-entry analogue,
         reference ``profiling_interval``). Tracing is not free — use O(100).
+
+        ``profile_ops``: with ``profile_programs_every``, additionally feed
+        per-op/scope device times from the same windows as ``op/...`` signals
+        (``jax.named_scope`` paths when XLA carries them) — one granularity
+        below programs, the closest XLA analogue of the reference's per-kernel
+        CUPTI stream. Parse cost only; no extra tracing overhead. With
+        ``use_device_mesh`` the op signals count against
+        ``mesh_signal_capacity`` like every other column — size it for
+        sec/ + dev/ + prog/ + one op/<scope> per named scope, or the first
+        over-capacity report permanently drops the mesh path for the run and
+        falls back to the store gather (logged, training never interrupted).
 
         ``use_device_mesh``: route report rounds through the mesh-sharded scoring
         path (:class:`~tpu_resiliency.telemetry.sharded.MeshTelemetry`) instead of
@@ -61,6 +73,7 @@ class StragglerDetectionCallback(Callback):
         self.use_device_mesh = use_device_mesh
         self.mesh_signal_capacity = mesh_signal_capacity
         self.profile_programs_every = profile_programs_every
+        self.profile_ops = profile_ops
         self._program_profiler = None
         self._step_count = 0
         self._init_kwargs = dict(
@@ -121,7 +134,9 @@ class StragglerDetectionCallback(Callback):
             if self._program_profiler is None:
                 from tpu_resiliency.telemetry.device_profiler import DeviceTimeProfiler
 
-                self._program_profiler = DeviceTimeProfiler()
+                self._program_profiler = DeviceTimeProfiler(
+                    collect_ops=self.profile_ops
+                )
             if self._step_count % self.profile_programs_every == 0:
                 self._program_profiler.start()
         self._section = Detector.detection_section(self.section_name)
@@ -135,6 +150,8 @@ class StragglerDetectionCallback(Callback):
         if self._program_profiler is not None and self._program_profiler.active:
             self._program_profiler.stop()
             Detector.record_program_samples(self._program_profiler.drain())
+            if self.profile_ops:
+                Detector.record_op_samples(self._program_profiler.drain_ops())
         report = Detector.generate_report_if_interval_elapsed()
         if report is not None:
             self._handle_report(ctx, report)

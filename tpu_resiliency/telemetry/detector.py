@@ -40,6 +40,7 @@ log = get_logger(__name__)
 SECTION_PREFIX = "sec/"
 DEVICE_PREFIX = "dev/"
 PROGRAM_PREFIX = "prog/"
+OP_PREFIX = "op/"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -233,10 +234,23 @@ class Detector:
         """Feed per-compiled-program device times (``DeviceTimeProfiler.drain()``)
         into the scored matrix as ``prog/...`` signals — the CUPTI-kernel-summaries
         analogue (reference ``straggler.py:198-226`` kernel summaries)."""
+        cls._record_samples(PROGRAM_PREFIX, samples)
+
+    @classmethod
+    def record_op_samples(cls, samples: dict[str, list[float]]) -> None:
+        """Feed per-op/scope device times (``DeviceTimeProfiler.drain_ops()``,
+        ``collect_ops=True``) into the scored matrix as ``op/...`` signals —
+        one granularity below ``prog/...``, the closest XLA analogue of the
+        reference's per-kernel CUPTI stream (``CuptiProfiler.cpp:168-203``;
+        kernels themselves are fused away under XLA)."""
+        cls._record_samples(OP_PREFIX, samples)
+
+    @classmethod
+    def _record_samples(cls, prefix: str, samples: dict[str, list[float]]) -> None:
         if not cls.initialized:
             raise ResiliencyError("Detector.initialize() must be called first")
         for name, secs in samples.items():
-            ring = cls._ring(PROGRAM_PREFIX + name)
+            ring = cls._ring(prefix + name)
             for sec in secs:
                 ring.push(sec)
 

@@ -45,12 +45,47 @@ log = get_logger(__name__)
 
 _HASH_SUFFIX = re.compile(r"\(\d+\)$")
 _PJIT = re.compile(r"^PjitFunction\((.+)\)$")
+_OP_ID_SUFFIX = re.compile(r"\.\d+$")
+_JIT_COMPONENT = re.compile(r"^(jit|pjit)\(.*\)$")
 
 MAX_SAMPLES_PER_PROGRAM = 8192  # reference statsMaxLenPerKernel ring bound
 
 
 def normalize_program_name(name: str) -> str:
     return _HASH_SUFFIX.sub("", name)
+
+
+def op_scope_key(name: str, stats: dict) -> Optional[str]:
+    """Aggregation key for one per-op trace event, or ``None`` for bookkeeping
+    events. Pure so the TPU-plane mapping is testable without a TPU trace.
+
+    Preference order:
+
+    1. The ``tf_op`` stat — the framework op path XLA propagates from HLO
+       metadata (``jax.named_scope`` contributes components). The key is the
+       *scope* path: leading ``jit(...)``/``pjit(...)`` wrappers dropped, the
+       trailing op component dropped, e.g. ``jit(step)/attn/dot_general`` →
+       ``attn``. An unscoped op keys by its own base name.
+    2. The ``hlo_op`` stat (or the event name), numeric instruction id
+       stripped (``dot_general.2`` → ``dot_general``) — instruction ids are
+       compile-order artifacts that would fragment signals across recompiles.
+    """
+    if name.startswith("end: ") or "::" in name:
+        return None
+    tf_op = stats.get("tf_op")
+    if tf_op:
+        parts = [p for p in str(tf_op).split("/") if p]
+        while parts and _JIT_COMPONENT.match(parts[0]):
+            parts = parts[1:]
+        if len(parts) >= 2:
+            return "/".join(parts[:-1])
+        if parts:
+            return _OP_ID_SUFFIX.sub("", parts[0])
+        return None
+    base = _OP_ID_SUFFIX.sub("", str(stats.get("hlo_op") or name))
+    if not base or base.startswith("_"):
+        return None
+    return base
 
 
 def extract_program_times(profile_data) -> dict[str, list[float]]:
@@ -88,14 +123,62 @@ def extract_program_times(profile_data) -> dict[str, list[float]]:
     return out
 
 
+def _event_stats(ev) -> dict:
+    try:
+        return dict(ev.stats)
+    except Exception:
+        return {}
+
+
+def extract_op_times(profile_data) -> dict[str, list[float]]:
+    """Per-op/scope device durations (seconds) from one xplane ProfileData —
+    one granularity below :func:`extract_program_times`, the closest XLA gets
+    to CUPTI's per-kernel stream (kernels themselves are fused away).
+
+    Primary source: device planes' ``XLA Ops`` line (true device time, one
+    event per HLO op execution, ``tf_op`` scope attribution when XLA carries
+    it). Fallback when no device plane exists (CPU simulation): the PjRt CPU
+    client's per-op thread line (host-inclusive op durations — a different
+    clock, same pipeline mechanics)."""
+    out: dict[str, list[float]] = {}
+    saw_device_ops = False
+    for plane in profile_data.planes:
+        if "/device:" not in plane.name or "CUSTOM" in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            saw_device_ops = True
+            for ev in line.events:
+                key = op_scope_key(ev.name, _event_stats(ev))
+                if key is not None:
+                    out.setdefault(key, []).append(float(ev.duration_ns) * 1e-9)
+    if saw_device_ops:
+        return out
+    for plane in profile_data.planes:
+        for line in plane.lines:
+            if "XLAPjRt" not in line.name:
+                continue
+            for ev in line.events:
+                key = op_scope_key(ev.name, _event_stats(ev))
+                if key is not None:
+                    out.setdefault(key, []).append(float(ev.duration_ns) * 1e-9)
+    return out
+
+
 class DeviceTimeProfiler:
     """Windowed per-program device-time capture with the CUPTI manager contract."""
 
-    def __init__(self, trace_root: Optional[str] = None):
+    def __init__(self, trace_root: Optional[str] = None, collect_ops: bool = False):
         self._root = trace_root
         self._window_dir: Optional[str] = None
         self._samples: dict[str, deque] = {}
         self._fresh: dict[str, list[float]] = {}
+        #: opt-in per-op/scope granularity (extract_op_times) alongside the
+        #: per-program default — parse cost only, no extra tracing overhead.
+        self.collect_ops = collect_ops
+        self._op_samples: dict[str, deque] = {}
+        self._op_fresh: dict[str, list[float]] = {}
         self.active = False
 
     # -- capture window ------------------------------------------------------
@@ -132,13 +215,21 @@ class DeviceTimeProfiler:
                 os.path.join(self._window_dir, "**", "*.xplane.pb"), recursive=True
             )
             for f in files:
-                times = extract_program_times(ProfileData.from_file(f))
+                data = ProfileData.from_file(f)
+                times = extract_program_times(data)
                 for name, secs in times.items():
                     ring = self._samples.setdefault(
                         name, deque(maxlen=MAX_SAMPLES_PER_PROGRAM)
                     )
                     ring.extend(secs)
                     self._fresh.setdefault(name, []).extend(secs)
+                if self.collect_ops:
+                    for name, secs in extract_op_times(data).items():
+                        ring = self._op_samples.setdefault(
+                            name, deque(maxlen=MAX_SAMPLES_PER_PROGRAM)
+                        )
+                        ring.extend(secs)
+                        self._op_fresh.setdefault(name, []).extend(secs)
         except Exception:
             log.exception("device profile parse failed; window dropped")
         finally:
@@ -160,10 +251,16 @@ class DeviceTimeProfiler:
         fresh, self._fresh = self._fresh, {}
         return fresh
 
-    def get_stats(self) -> dict[str, dict[str, float]]:
-        """Per-program stats over retained samples (reference ``computeStats``)."""
+    def drain_ops(self) -> dict[str, list[float]]:
+        """New per-op/scope samples since the last drain (collect_ops only);
+        feed to ``Detector.record_op_samples``."""
+        fresh, self._op_fresh = self._op_fresh, {}
+        return fresh
+
+    @staticmethod
+    def _stats_over(samples: dict[str, deque]) -> dict[str, dict[str, float]]:
         out = {}
-        for name, ring in self._samples.items():
+        for name, ring in samples.items():
             if not ring:
                 continue
             arr = np.asarray(ring, dtype=np.float64)
@@ -177,6 +274,16 @@ class DeviceTimeProfiler:
             }
         return out
 
+    def get_stats(self) -> dict[str, dict[str, float]]:
+        """Per-program stats over retained samples (reference ``computeStats``)."""
+        return self._stats_over(self._samples)
+
+    def get_op_stats(self) -> dict[str, dict[str, float]]:
+        """Per-op/scope stats over retained samples (collect_ops only)."""
+        return self._stats_over(self._op_samples)
+
     def reset(self) -> None:
         self._samples.clear()
         self._fresh.clear()
+        self._op_samples.clear()
+        self._op_fresh.clear()
