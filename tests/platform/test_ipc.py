@@ -45,3 +45,40 @@ def test_receiver_callback(tmp_uds_path):
         assert got == ["ping"]
     finally:
         rx.stop()
+
+
+def test_connect_retries_through_bind_listen_gap(tmp_uds_path):
+    """The socket file appears at bind(); a loaded machine can deschedule the
+    server before listen(). connect() must retry through both windows (no file
+    yet, then ECONNREFUSED) instead of dying on a server milliseconds from
+    ready — the 1-in-4 concurrency-soak flake."""
+    path = tmp_uds_path
+
+    def slow_server():
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)  # file exists now; connects get ECONNREFUSED
+        time.sleep(0.4)
+        srv.listen(1)
+        conn, _ = srv.accept()
+        ipc.write_object(conn, {"hello": 1})
+        conn.close()
+        srv.close()
+
+    t = threading.Thread(target=slow_server, daemon=True)
+    t.start()
+    # Enter during the no-file / bound-not-listening windows.
+    sock = ipc.connect(path, timeout=10.0)
+    try:
+        assert ipc.read_object(sock) == {"hello": 1}
+    finally:
+        sock.close()
+    t.join(timeout=5)
+
+    # And a server that never appears still fails, at the deadline.
+    t0 = time.monotonic()
+    try:
+        ipc.connect(str(path) + ".absent", timeout=0.3)
+        raise AssertionError("connect must fail for an absent server")
+    except FileNotFoundError:
+        pass
+    assert 0.25 <= time.monotonic() - t0 < 5.0

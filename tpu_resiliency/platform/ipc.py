@@ -16,6 +16,7 @@ import functools
 import os
 import socket
 import threading
+import time
 from typing import Any, Callable, Optional
 
 from tpu_resiliency.platform import framing
@@ -37,10 +38,30 @@ write_object_stream = framing.write_obj_stream
 
 
 def connect(path: str, timeout: float = 30.0) -> socket.socket:
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    sock.settimeout(timeout)
-    sock.connect(path)
-    return sock
+    """Connect to a UDS server, retrying within ``timeout``.
+
+    Retry matters even when the caller has seen the socket file: the file
+    appears at the server's bind(), and a loaded machine can deschedule the
+    server between bind() and listen() — a one-shot connect then dies on
+    ECONNREFUSED for a server that is milliseconds from ready (observed as a
+    1-in-4 suite flake under 2x concurrency). FileNotFoundError is retried
+    for the same reason one step earlier (file not yet created)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        # Remaining budget, not the full timeout: a blocking connect on the
+        # final attempt must not stretch the caller's deadline to ~2x.
+        sock.settimeout(max(0.001, deadline - time.monotonic()))
+        try:
+            sock.connect(path)
+            return sock
+        except (ConnectionRefusedError, FileNotFoundError, BlockingIOError):
+            # BlockingIOError: Linux AF_UNIX connect returns EAGAIN when the
+            # listener's accept backlog is full — same transient class.
+            sock.close()
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.05)
 
 
 class IpcReceiver:
