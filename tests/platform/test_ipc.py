@@ -82,3 +82,24 @@ def test_connect_retries_through_bind_listen_gap(tmp_uds_path):
     except FileNotFoundError:
         pass
     assert 0.25 <= time.monotonic() - t0 < 5.0
+
+
+def test_connect_restores_full_io_timeout_after_retries(tmp_uds_path):
+    """A connect that lands late in the retry budget must still hand back a
+    socket with the caller's FULL I/O timeout — not the leftover budget."""
+    path = tmp_uds_path
+
+    def slow_server():
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        time.sleep(0.6)
+        srv.listen(1)
+        srv.accept()  # hold the connection open
+
+    t = threading.Thread(target=slow_server, daemon=True)
+    t.start()
+    sock = ipc.connect(path, timeout=1.0)  # ~0.4s of budget left at connect
+    try:
+        assert sock.gettimeout() == 1.0
+    finally:
+        sock.close()

@@ -54,6 +54,10 @@ def connect(path: str, timeout: float = 30.0) -> socket.socket:
         sock.settimeout(max(0.001, deadline - time.monotonic()))
         try:
             sock.connect(path)
+            # The clipped timeout governed only the connect attempt; the
+            # returned socket keeps the caller's full I/O timeout (a late
+            # connect must not bequeath a milliseconds recv budget).
+            sock.settimeout(timeout)
             return sock
         except (ConnectionRefusedError, FileNotFoundError, BlockingIOError):
             # BlockingIOError: Linux AF_UNIX connect returns EAGAIN when the
