@@ -344,3 +344,28 @@ def test_cooperative_stop_does_not_write_finished_flag(monitor, tmp_path):
     cb = FaultToleranceCallback(autoresume=True, finished_flag_path=flag)
     run_training(lambda s, i: s + 1, 0, 100, callbacks=[cb, StopAtTwo()])
     assert not os.path.exists(flag)  # job is NOT finished — must be rescheduled
+
+
+def test_straggler_report_emits_structured_event():
+    """Every report lands on the structured event stream as a machine-readable
+    twin of the log lines (the reference's events/metrics-stream role)."""
+    from tpu_resiliency.utils import events
+
+    if Detector.initialized:
+        Detector.shutdown()
+    seen = []
+    events.add_sink(seen.append)
+    try:
+        cb = StragglerDetectionCallback(report_time_interval=0.0)
+        ctx = run_training(lambda s, i: s + 1, 0, 20, callbacks=[cb])
+        assert ctx.state == 20
+    finally:
+        events.remove_sink(seen.append)
+    reports = [e for e in seen if e.kind == "straggler_report"]
+    assert reports, [e.kind for e in seen]
+    ev = reports[-1]
+    assert ev.source == "telemetry"
+    assert set(ev.payload) >= {"step", "perf_scores", "stragglers_by_perf",
+                               "stragglers_by_section"}
+    assert ev.payload["perf_scores"].get(0) == 1.0  # single healthy rank
+    assert ev.payload["stragglers_by_perf"] == []

@@ -13,6 +13,7 @@ from typing import Optional
 
 from tpu_resiliency.integrations.loop import Callback, LoopContext
 from tpu_resiliency.telemetry.detector import Detector
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -196,5 +197,21 @@ class StragglerDetectionCallback(Callback):
                 ctx.metrics["straggler/detected"] = stragglers
             if self.stop_if_detected:
                 ctx.should_stop = True
+        # The machine-readable twin of the log lines above, on the same
+        # structured JSONL stream the launcher narrates to ($TPU_RESILIENCY_
+        # EVENTS_FILE) — the role the reference fills with its torchelastic
+        # events/metrics streams + PTL logger export
+        # (straggler_det_callback.py enable_ptl_logging, events/ metrics/).
+        record_event(
+            "telemetry",
+            "straggler_report",
+            step=ctx.step,
+            perf_scores=dict(flat),
+            stragglers_by_perf=sorted(s.rank for s in stragglers.by_perf),
+            stragglers_by_section={
+                name: sorted(s.rank for s in ids)
+                for name, ids in stragglers.by_section.items()
+            },
+        )
         if self.health_policy is not None:
             self.health_policy.observe(report)
