@@ -114,17 +114,29 @@ def monitor(tmp_path):
 
 
 def test_ft_callback_heartbeats_and_finished_flag(monitor, tmp_path):
+    from tpu_resiliency.utils import events
+
     flag = str(tmp_path / "finished.flag")
     sd_path = str(tmp_path / "ft_state.pkl")
     cb = FaultToleranceCallback(
         autoresume=True, finished_flag_path=flag, state_dict_path=sd_path
     )
-    ctx = run_training(lambda s, i: s + 1, 0, 5, callbacks=[cb])
+    seen = []
+    events.add_sink(seen.append)
+    try:
+        ctx = run_training(lambda s, i: s + 1, 0, 5, callbacks=[cb])
+    finally:
+        events.remove_sink(seen.append)
     assert ctx.state == 5
     assert cb.machine.heartbeats >= 5
     assert cb.machine.finished
     assert os.path.exists(flag)
     assert os.path.exists(sd_path)  # calculated timeouts persisted
+    # Both FT milestones are on the structured event stream.
+    kinds = {e.kind for e in seen if e.source == "ft"}
+    assert {"timeouts_calculated", "training_finished"} <= kinds, kinds
+    tc = next(e for e in seen if e.kind == "timeouts_calculated")
+    assert tc.payload["initial_s"] > 0 and tc.payload["subsequent_s"] > 0
 
     # Second run: the finished flag short-circuits training (autoresume contract).
     cb2 = FaultToleranceCallback(autoresume=True, finished_flag_path=flag)
@@ -367,5 +379,5 @@ def test_straggler_report_emits_structured_event():
     assert ev.source == "telemetry"
     assert set(ev.payload) >= {"step", "perf_scores", "stragglers_by_perf",
                                "stragglers_by_section"}
-    assert ev.payload["perf_scores"].get(0) == 1.0  # single healthy rank
+    assert ev.payload["perf_scores"].get("0") == 1.0  # single healthy rank (str keys: on-disk schema)
     assert ev.payload["stragglers_by_perf"] == []

@@ -14,6 +14,7 @@ import os
 from typing import Optional
 
 from tpu_resiliency.integrations.loop import Callback, LoopContext
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.watchdog.monitor_client import RankMonitorClient
 
@@ -128,9 +129,19 @@ class FaultToleranceCallback(Callback):
         self.machine.on_train_end(completed)
         if not self._timeouts_updated:
             self._maybe_update_timeouts(ctx)
-        if self.machine.finished and self.autoresume and self.finished_flag_path:
-            with open(self.finished_flag_path, "w") as f:
-                f.write("finished\n")
+        if self.machine.finished:
+            flag_written = bool(self.autoresume and self.finished_flag_path)
+            if flag_written:
+                with open(self.finished_flag_path, "w") as f:
+                    f.write("finished\n")
+            # "finished" is a fact about the run, not about autoresume: emit
+            # it whenever the machine says so; the flag path marks whether an
+            # autoresume scheduler will also see it on disk.
+            record_event(
+                "ft", "training_finished",
+                step=ctx.step,
+                flag_path=self.finished_flag_path if flag_written else None,
+            )
         if self.client.is_initialized:
             self.client.shutdown_workload_monitoring()
 
@@ -146,6 +157,11 @@ class FaultToleranceCallback(Callback):
                 store=self.sync_store, rank=ctx.rank, world_size=ctx.world_size
             )
             self._timeouts_updated = True
+            hb = self.client.hb_timeouts
+            record_event(
+                "ft", "timeouts_calculated",
+                step=ctx.step, initial_s=hb.initial, subsequent_s=hb.subsequent,
+            )
             if self.state_dict_path:
                 import pickle
 

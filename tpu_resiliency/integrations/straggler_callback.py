@@ -206,7 +206,10 @@ class StragglerDetectionCallback(Callback):
             "telemetry",
             "straggler_report",
             step=ctx.step,
-            perf_scores=dict(flat),
+            # String keys: json.dumps would coerce int keys anyway, so use the
+            # on-disk schema everywhere — in-process sinks and JSONL readers
+            # index the same way.
+            perf_scores={str(k): float(v) for k, v in flat.items()},
             stragglers_by_perf=sorted(s.rank for s in stragglers.by_perf),
             stragglers_by_section={
                 name: sorted(s.rank for s in ids)
