@@ -1,0 +1,100 @@
+"""The events-summary CLI: timeline rendering, kind filter, summary counts."""
+
+import io
+import json
+import time
+
+from tpu_resiliency.tools import events_summary
+
+
+def _write_events(path, rows):
+    t0 = time.time()
+    with open(path, "w") as f:
+        for dt, source, kind, payload in rows:
+            f.write(
+                json.dumps(
+                    {"ts": t0 + dt, "source": source, "kind": kind, "pid": 1,
+                     "rank": payload.pop("_rank", None), **payload}
+                )
+                + "\n"
+            )
+
+
+def test_timeline_and_summary(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    _write_events(
+        path,
+        [
+            (0.0, "launcher", "rendezvous_round",
+             {"round": 0, "world_size": 2, "active": ["a"], "spares": []}),
+            (1.0, "telemetry", "straggler_report",
+             {"step": 100, "perf_scores": {"0": 1.0, "1": 0.4},
+              "stragglers_by_perf": [1], "stragglers_by_section": {}}),
+            (2.0, "launcher", "worker_failed",
+             {"global_rank": 1, "exitcode": -9, "detail": "rank 1 exit -9"}),
+            (2.5, "launcher", "worker_promoted",
+             {"round": 1, "global_rank": 1, "worker_pid": 4242}),
+            (3.0, "inprocess", "restart_signalled",
+             {"iteration": 0, "initial_rank": 0, "_rank": 0}),
+            (4.0, "custom", "my_new_kind", {"answer": 42}),
+        ],
+    )
+    out = io.StringIO()
+    events_summary.summarize(events_summary.read_events(path), out=out)
+    text = out.getvalue()
+    # Timeline lines render per-kind phrases with relative timestamps.
+    assert "t+    0.000s [launcher] rendezvous_round: round 0: world=2" in text
+    assert "STRAGGLERS by perf [1]" in text
+    assert "rank 1 failed: rank 1 exit -9" in text
+    assert "warm spare promoted -> rank 1 (round 1, pid 4242)" in text
+    assert "[inprocess r0] restart_signalled: iteration 0 restarting (initial_rank 0)" in text
+    # Unknown kinds still print (raw payload), never crash.
+    assert "my_new_kind: answer=42" in text
+    # Summary footer.
+    assert "6 events over 4.0s" in text
+    assert "worker failures: 1" in text
+    assert "warm-spare promotions: 1" in text
+    assert "straggler reports: 1" in text
+    assert "other: {'my_new_kind': 1}" in text
+
+
+def test_kind_filter_and_no_timeline(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    _write_events(
+        path,
+        [
+            (0.0, "launcher", "rendezvous_round",
+             {"round": 0, "world_size": 1, "active": ["a"], "spares": []}),
+            (1.0, "launcher", "worker_failed",
+             {"global_rank": 0, "exitcode": 1, "detail": "rank 0 exit 1"}),
+        ],
+    )
+    out = io.StringIO()
+    events_summary.summarize(
+        events_summary.read_events(path), out=out, kind="worker_failed"
+    )
+    text = out.getvalue()
+    assert "worker_failed" in text and "rendezvous_round:" not in text
+    # Counts still cover everything (the filter narrows the timeline only).
+    assert "rendezvous rounds: 1" in text
+
+    out2 = io.StringIO()
+    events_summary.summarize(
+        events_summary.read_events(path), out=out2, timeline=False
+    )
+    assert "t+" not in out2.getvalue()
+    assert "worker failures: 1" in out2.getvalue()
+
+
+def test_cli_main(tmp_path, capsys):
+    path = str(tmp_path / "ev.jsonl")
+    _write_events(path, [(0.0, "ft", "training_finished", {"step": 30})])
+    assert events_summary.main([path]) == 0
+    assert "training finished: 1" in capsys.readouterr().out
+    assert events_summary.main([str(tmp_path / "missing.jsonl")]) == 1
+
+
+def test_cli_fails_visibly_on_unreadable_path(tmp_path, capsys):
+    # A directory passes os.path.exists but cannot be read as a stream.
+    assert events_summary.main([str(tmp_path)]) == 1
+    assert "cannot read events file" in capsys.readouterr().err

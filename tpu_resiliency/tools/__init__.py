@@ -1,0 +1,1 @@
+"""Operator-facing CLI tools over the framework's artifacts and streams."""

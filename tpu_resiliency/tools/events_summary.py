@@ -1,0 +1,168 @@
+"""Human timeline over the structured event stream.
+
+The framework narrates every resiliency decision to a JSONL stream
+(``$TPU_RESILIENCY_EVENTS_FILE``, ``utils/events.py``): rendezvous rounds,
+worker failures and warm-spare promotions, in-process restart iterations,
+straggler reports, preemption sync points, FT milestones. This tool is the
+consumer side — it renders one run's stream as a timeline plus a summary, the
+post-mortem view the reference leaves to ad-hoc log grepping (its torchelastic
+events/metrics streams have no bundled reader; its tests grep log lines,
+``tests/straggler/func/check_log.py``).
+
+Usage::
+
+    python -m tpu_resiliency.tools.events_summary run_events.jsonl
+    python -m tpu_resiliency.tools.events_summary run_events.jsonl --kind worker_failed
+    python -m tpu_resiliency.tools.events_summary run_events.jsonl --no-timeline
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from collections import Counter
+from typing import Any, Optional
+
+from tpu_resiliency.utils.events import RESERVED_KEYS, read_events
+
+
+def _payload(rec: dict) -> dict:
+    return {k: v for k, v in rec.items() if k not in RESERVED_KEYS}
+
+
+def _fmt_default(p: dict) -> str:
+    return " ".join(f"{k}={v}" for k, v in p.items())
+
+
+def _fmt_rendezvous_round(p: dict) -> str:
+    spares = f" spares={p['spares']}" if p.get("spares") else ""
+    return (
+        f"round {p.get('round')}: world={p.get('world_size')} "
+        f"active={p.get('active')}{spares}"
+    )
+
+
+def _fmt_worker_failed(p: dict) -> str:
+    return f"rank {p.get('global_rank')} failed: {p.get('detail', p.get('exitcode'))}"
+
+
+def _fmt_worker_promoted(p: dict) -> str:
+    return (
+        f"warm spare promoted -> rank {p.get('global_rank')} "
+        f"(round {p.get('round')}, pid {p.get('worker_pid')})"
+    )
+
+
+def _fmt_straggler_report(p: dict) -> str:
+    flagged = p.get("stragglers_by_perf") or []
+    by_sec = p.get("stragglers_by_section") or {}
+    if not flagged and not by_sec:
+        return f"step {p.get('step')}: clean ({len(p.get('perf_scores') or {})} ranks)"
+    parts = []
+    if flagged:
+        parts.append(f"by perf {flagged}")
+    if by_sec:
+        parts.append(f"by section {by_sec}")
+    return f"step {p.get('step')}: STRAGGLERS " + ", ".join(parts)
+
+
+def _fmt_restart_signalled(p: dict) -> str:
+    return (
+        f"iteration {p.get('iteration')} restarting "
+        f"(initial_rank {p.get('initial_rank')})"
+    )
+
+
+_FORMATTERS = {
+    "rendezvous_round": _fmt_rendezvous_round,
+    "worker_failed": _fmt_worker_failed,
+    "worker_promoted": _fmt_worker_promoted,
+    "straggler_report": _fmt_straggler_report,
+    "restart_signalled": _fmt_restart_signalled,
+}
+
+#: Kinds counted in the footer under friendlier names.
+_SUMMARY_LINES = (
+    ("rendezvous_round", "rendezvous rounds"),
+    ("worker_failed", "worker failures"),
+    ("worker_promoted", "warm-spare promotions"),
+    ("restart_requested", "in-job restart requests"),
+    ("restart_signalled", "in-process restarts"),
+    ("fn_exception", "in-process fn exceptions"),
+    ("rank_terminated", "ranks terminated"),
+    ("straggler_report", "straggler reports"),
+    ("degraded_set", "degraded-set updates"),
+    ("preemption_sync_point", "preemption sync points"),
+    ("timeouts_calculated", "FT timeout calibrations"),
+    ("training_finished", "training finished"),
+    ("budget_exhausted", "restart budget exhausted"),
+)
+
+
+def summarize(
+    records: list[dict[str, Any]],
+    out=None,
+    kind: Optional[str] = None,
+    timeline: bool = True,
+) -> None:
+    out = sys.stdout if out is None else out  # resolved at call time, not import
+    records = [r for r in records if "ts" in r and "kind" in r]
+    if not records:
+        print("no events", file=out)
+        return
+    records.sort(key=lambda r: r["ts"])
+    t0 = records[0]["ts"]
+    shown = [r for r in records if kind is None or r["kind"] == kind]
+    if timeline:
+        for r in shown:
+            p = _payload(r)
+            line = _FORMATTERS.get(r["kind"], _fmt_default)(p)
+            rank = f" r{r['rank']}" if r.get("rank") is not None else ""
+            print(
+                f"t+{r['ts'] - t0:9.3f}s [{r.get('source', '?')}{rank}] "
+                f"{r['kind']}: {line}",
+                file=out,
+            )
+    counts = Counter(r["kind"] for r in records)
+    span = records[-1]["ts"] - t0
+    print(
+        f"\n{len(records)} events over {span:.1f}s from "
+        f"{len({r.get('pid') for r in records})} processes",
+        file=out,
+    )
+    for k, label in _SUMMARY_LINES:
+        if counts.get(k):
+            print(f"  {label}: {counts[k]}", file=out)
+    leftover = {
+        k: n for k, n in counts.items() if k not in {k for k, _ in _SUMMARY_LINES}
+    }
+    if leftover:
+        print(f"  other: {dict(sorted(leftover.items()))}", file=out)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a tpu-resiliency structured event stream as a timeline"
+    )
+    ap.add_argument("events_file")
+    ap.add_argument("--kind", help="show only this event kind in the timeline")
+    ap.add_argument(
+        "--no-timeline", action="store_true", help="print only the summary footer"
+    )
+    args = ap.parse_args(argv)
+    # read_events tolerates unreadable files (shared-stream readers race the
+    # first writer); a CLI invocation on a missing/denied/directory path must
+    # fail visibly, not report an empty-but-successful run.
+    try:
+        with open(args.events_file):
+            pass
+    except OSError as e:
+        print(f"cannot read events file: {e}", file=sys.stderr)
+        return 1
+    records = read_events(args.events_file)
+    summarize(records, kind=args.kind, timeline=not args.no_timeline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,11 @@ log = get_logger(__name__)
 
 EVENTS_FILE_ENV = "TPU_RESILIENCY_EVENTS_FILE"
 
+#: Envelope keys every JSONL record carries; payload keys that collide are
+#: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary) use this to
+#: split envelope from payload — one schema, one place.
+RESERVED_KEYS = ("ts", "source", "kind", "pid", "rank")
+
 
 @dataclasses.dataclass
 class Event:
@@ -57,7 +62,7 @@ class Event:
                 "kind": self.kind,
                 "pid": self.pid,
                 "rank": self.rank,
-                **{f"p_{k}" if k in ("ts", "source", "kind", "pid", "rank") else k: v
+                **{f"p_{k}" if k in RESERVED_KEYS else k: v
                    for k, v in self.payload.items()},
             },
             default=repr,
