@@ -1,0 +1,194 @@
+"""Operator view of a local-checkpoint root: holdings, coverage, health.
+
+The local tier's layout is self-describing (``checkpoint/local_manager.py``:
+``root/s{session}/r{rank}/iter_NNNNNNN_{owner}_local.ckpt`` — the directory
+names the *holder*, the filename the *owner*), so coverage — the property
+``find_latest`` needs (some live holder for every owner's shard) — can be
+audited offline from the filesystem alone, without the job's comm group. This
+is the post-mortem twin of the in-job coverage check: "which iteration could a
+restarted world actually resume from, and what is replication costing me?"
+
+Usage::
+
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --session 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import re
+import sys
+from typing import Optional
+
+from tpu_resiliency.checkpoint.local_manager import _FILE_RE
+
+_SESSION_RE = re.compile(r"^s(\d+)$")
+_RANK_RE = re.compile(r"^r(\d+)$")
+
+
+@dataclasses.dataclass
+class SessionInfo:
+    session: int
+    #: rank dirs present (the world this root has seen)
+    ranks: set
+    #: iteration -> owner -> set of holder ranks
+    holdings: dict
+    #: iteration -> total bytes across all copies
+    bytes_by_iter: dict
+    #: leftover .dirty temp files (crashed mid-save)
+    dirty: list
+
+    @property
+    def owners(self) -> set:
+        out = set()
+        for by_owner in self.holdings.values():
+            out |= set(by_owner)
+        return out
+
+    def covered_iterations(self, world: Optional[set] = None) -> list:
+        """Iterations where every rank of ``world`` finds its shard held
+        somewhere (the offline analogue of ``_covered_iterations``).
+
+        Coverage is **group-relative**: a restarted group resumes from the
+        newest iteration whose owner set covers *that group* — after an
+        elastic shrink the surviving world legitimately resumes from data the
+        full original world could not. Default world: everything the
+        filesystem shows (rank dirs plus every owner ever named), i.e. the
+        original full world."""
+        world = (self.ranks | self.owners) if world is None else set(world)
+        return sorted(
+            it
+            for it, by_owner in self.holdings.items()
+            if world <= set(by_owner)
+        )
+
+
+def scan(root: str, session: Optional[int] = None) -> list[SessionInfo]:
+    """Offline-but-live-safe: a training job's retention pruning can unlink
+    files between listing and stat'ing, so every per-entry touch tolerates
+    disappearance (the audit then simply reflects the post-prune state)."""
+    sessions = []
+    for sname in sorted(os.listdir(root)):
+        sm = _SESSION_RE.match(sname)
+        if not sm or (session is not None and int(sm.group(1)) != session):
+            continue
+        info = SessionInfo(int(sm.group(1)), set(), {}, {}, [])
+        sdir = os.path.join(root, sname)
+        for rname in sorted(os.listdir(sdir)):
+            rm = _RANK_RE.match(rname)
+            if not rm:
+                continue
+            holder = int(rm.group(1))
+            info.ranks.add(holder)
+            rdir = os.path.join(sdir, rname)
+            try:
+                fnames = os.listdir(rdir)
+            except OSError:
+                continue
+            for fname in fnames:
+                if fname.endswith(".dirty"):
+                    info.dirty.append(os.path.join(rdir, fname))
+                    continue
+                fm = _FILE_RE.match(fname)
+                if not fm:
+                    continue
+                try:
+                    size = os.path.getsize(os.path.join(rdir, fname))
+                except OSError:
+                    continue  # pruned mid-scan
+                it, owner = int(fm.group(1)), int(fm.group(2))
+                info.holdings.setdefault(it, {}).setdefault(owner, set()).add(holder)
+                info.bytes_by_iter[it] = info.bytes_by_iter.get(it, 0) + size
+        sessions.append(info)
+    return sorted(sessions, key=lambda s: s.session)
+
+
+def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
+    out = sys.stdout if out is None else out
+    audit_world = sorted((info.ranks | info.owners) if world is None else world)
+    covered = info.covered_iterations(set(audit_world))
+    print(
+        f"session {info.session}: auditing world={audit_world} "
+        f"({len(info.holdings)} iterations on disk)",
+        file=out,
+    )
+    for it in sorted(info.holdings):
+        by_owner = info.holdings[it]
+        missing = sorted(set(audit_world) - set(by_owner))
+        copies = sum(len(h) for h in by_owner.values())
+        mb = info.bytes_by_iter.get(it, 0) / 1e6
+        status = "COVERED" if it in covered else f"missing owners {missing}"
+        mirrors = copies - len(by_owner)
+        print(
+            f"  iter {it:7d}: owners {sorted(by_owner)}, "
+            f"{mirrors} mirror copies, {mb:.1f} MB  [{status}]",
+            file=out,
+        )
+    if covered:
+        print(
+            f"  resumable from: iter {covered[-1]} (newest covered for "
+            f"world {audit_world})",
+            file=out,
+        )
+    else:
+        print(
+            f"  resumable from: NOTHING for world {audit_world}", file=out
+        )
+    if info.holdings:
+        # Coverage is group-relative: after an elastic shrink, the surviving
+        # group resumes from data the full world cannot. Name the group the
+        # newest iteration WOULD serve, so a "NOTHING" verdict isn't misread.
+        newest = max(info.holdings)
+        owners = sorted(info.holdings[newest])
+        if newest not in covered:
+            print(
+                f"  note: iter {newest} covers a (shrunk) world of {owners} — "
+                f"re-audit with --world {','.join(map(str, owners))}",
+                file=out,
+            )
+    for path in info.dirty:
+        print(f"  WARNING torn save temp: {path}", file=out)
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Audit a tpu-resiliency local-checkpoint root offline"
+    )
+    def world_spec(text: str) -> set:
+        try:
+            out = {int(r) for r in text.split(",") if r.strip()}
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"want comma-separated rank ids, got {text!r}"
+            )
+        if not out:
+            raise argparse.ArgumentTypeError("empty world")
+        return out
+
+    ap.add_argument("root")
+    ap.add_argument("--session", type=int, help="only this session id")
+    ap.add_argument(
+        "--world",
+        type=world_spec,
+        help="audit coverage for this comma-separated rank set (default: every "
+        "rank/owner the filesystem shows — the original full world)",
+    )
+    args = ap.parse_args(argv)
+    world = args.world
+    if not os.path.isdir(args.root):
+        print(f"not a checkpoint root: {args.root}", file=sys.stderr)
+        return 1
+    sessions = scan(args.root, session=args.session)
+    if not sessions:
+        print("no sessions found", file=sys.stderr)
+        return 1
+    for info in sessions:
+        render(info, world=world)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
