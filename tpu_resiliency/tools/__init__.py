@@ -1,1 +1,22 @@
 """Operator-facing CLI tools over the framework's artifacts and streams."""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable
+
+
+def pipe_safe(emit: Callable[[], None]) -> None:
+    """Run ``emit`` (stdout-printing CLI body) with ``| head``-citizenship.
+
+    Flushes inside the guard: with block-buffered stdout the writes that die
+    on a closed pipe may be the interpreter-exit flush, after ``main``
+    returned — so the flush must happen where the handler can see it. On a
+    broken pipe, stdout is redirected to devnull so shutdown cannot re-raise.
+    """
+    try:
+        emit()
+        sys.stdout.flush()
+    except BrokenPipeError:
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())

@@ -24,6 +24,7 @@ import sys
 from typing import Optional
 
 from tpu_resiliency.checkpoint.local_manager import _FILE_RE
+from tpu_resiliency.tools import pipe_safe
 
 _SESSION_RE = re.compile(r"^s(\d+)$")
 _RANK_RE = re.compile(r"^r(\d+)$")
@@ -185,8 +186,11 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not sessions:
         print("no sessions found", file=sys.stderr)
         return 1
-    for info in sessions:
-        render(info, world=world)
+    def emit():
+        for info in sessions:
+            render(info, world=world)
+
+    pipe_safe(emit)
     return 0
 
 

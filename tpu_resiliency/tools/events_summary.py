@@ -19,10 +19,12 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from collections import Counter
 from typing import Any, Optional
 
+from tpu_resiliency.tools import pipe_safe
 from tpu_resiliency.utils.events import RESERVED_KEYS, read_events
 
 
@@ -160,7 +162,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"cannot read events file: {e}", file=sys.stderr)
         return 1
     records = read_events(args.events_file)
-    summarize(records, kind=args.kind, timeline=not args.no_timeline)
+    pipe_safe(
+        lambda: summarize(records, kind=args.kind, timeline=not args.no_timeline)
+    )
     return 0
 
 
