@@ -98,3 +98,26 @@ def test_cli_fails_visibly_on_unreadable_path(tmp_path, capsys):
     # A directory passes os.path.exists but cannot be read as a stream.
     assert events_summary.main([str(tmp_path)]) == 1
     assert "cannot read events file" in capsys.readouterr().err
+
+
+def test_big_output_through_closed_pipe_exits_clean(tmp_path):
+    """`tool big.jsonl | head -1` with >8KB of output: the write that dies on
+    the closed pipe is the interpreter-exit flush, which must be absorbed by
+    pipe_safe (rc 0, no 'Exception ignored' noise on stderr)."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "big.jsonl")
+    _write_events(
+        path,
+        [(float(i), "x", f"k{i % 7}", {"data": "y" * 40}) for i in range(2000)],
+    )
+    r = subprocess.run(
+        f"{sys.executable} -m tpu_resiliency.tools.events_summary {path} | head -1",
+        shell=True,
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "BrokenPipe" not in r.stderr and "Exception ignored" not in r.stderr
+    assert r.stdout.startswith("t+")
