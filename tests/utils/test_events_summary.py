@@ -121,3 +121,84 @@ def test_big_output_through_closed_pipe_exits_clean(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "BrokenPipe" not in r.stderr and "Exception ignored" not in r.stderr
     assert r.stdout.startswith("t+")
+
+
+def test_iter_new_records_tails_a_growing_file(tmp_path):
+    """The --follow reader yields records as a writer appends them, survives
+    the file not existing yet, and reassembles torn trailing lines."""
+    import json
+    import threading
+    import time
+
+    path = str(tmp_path / "grow.jsonl")
+    stop = threading.Event()
+    got = []
+
+    def reader():
+        for rec in events_summary.iter_new_records(path, poll=0.02, stop=stop):
+            got.append(rec)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    time.sleep(0.1)  # reader polling a nonexistent file must not crash
+
+    def ev(i):
+        return json.dumps(
+            {"ts": float(i), "source": "x", "kind": "k", "pid": 1, "i": i}
+        )
+
+    with open(path, "a") as f:
+        f.write(ev(0) + "\n")
+        f.flush()
+        time.sleep(0.1)
+        # Torn write: half a line now, the rest (plus another event) later.
+        whole = ev(1) + "\n"
+        f.write(whole[:10])
+        f.flush()
+        time.sleep(0.1)
+        assert [r["i"] for r in got] == [0], "torn line must not be yielded"
+        f.write(whole[10:] + ev(2) + "\n")
+        f.flush()
+
+    deadline = time.time() + 5
+    while len(got) < 3 and time.time() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5)
+    assert [r["i"] for r in got] == [0, 1, 2]
+
+
+def test_follow_fails_visibly_on_unreadable_path(tmp_path, capsys):
+    """A directory (or permission-denied path) must error out, not hang as if
+    waiting for a launcher; only a MISSING file is the wait state."""
+    assert events_summary._follow(str(tmp_path), kind=None) == 1
+    assert "cannot follow events file" in capsys.readouterr().err
+
+
+def test_follow_through_closed_pipe_exits_clean(tmp_path):
+    """`--follow | head -2` on a pre-populated stream: head's exit must end
+    the follower cleanly (rc 0, no BrokenPipe noise), like batch mode."""
+    import json
+    import subprocess
+    import sys
+    import time
+
+    path = str(tmp_path / "f.jsonl")
+    with open(path, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"ts": float(i), "source": "x", "kind": "k", "pid": 1}) + "\n")
+    p = subprocess.Popen(
+        f"{sys.executable} -m tpu_resiliency.tools.events_summary {path} --follow | head -2",
+        shell=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, err = p.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        raise AssertionError("follower did not exit after the pipe closed")
+    assert p.returncode == 0, err
+    assert "BrokenPipe" not in err and "Exception ignored" not in err
+    assert out.count("\n") == 2

@@ -14,6 +14,7 @@ Usage::
     python -m tpu_resiliency.tools.events_summary run_events.jsonl
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --kind worker_failed
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --no-timeline
+    python -m tpu_resiliency.tools.events_summary run_events.jsonl --follow
 """
 
 from __future__ import annotations
@@ -117,14 +118,7 @@ def summarize(
     shown = [r for r in records if kind is None or r["kind"] == kind]
     if timeline:
         for r in shown:
-            p = _payload(r)
-            line = _FORMATTERS.get(r["kind"], _fmt_default)(p)
-            rank = f" r{r['rank']}" if r.get("rank") is not None else ""
-            print(
-                f"t+{r['ts'] - t0:9.3f}s [{r.get('source', '?')}{rank}] "
-                f"{r['kind']}: {line}",
-                file=out,
-            )
+            print(format_line(r, t0), file=out)
     counts = Counter(r["kind"] for r in records)
     span = records[-1]["ts"] - t0
     print(
@@ -142,6 +136,84 @@ def summarize(
         print(f"  other: {dict(sorted(leftover.items()))}", file=out)
 
 
+def format_line(rec: dict, t0: float) -> str:
+    """One timeline line (shared by the batch and --follow paths)."""
+    p = _payload(rec)
+    line = _FORMATTERS.get(rec["kind"], _fmt_default)(p)
+    rank = f" r{rec['rank']}" if rec.get("rank") is not None else ""
+    return (
+        f"t+{rec['ts'] - t0:9.3f}s [{rec.get('source', '?')}{rank}] "
+        f"{rec['kind']}: {line}"
+    )
+
+
+def iter_new_records(path: str, poll: float = 0.5, stop=None):
+    """Yield records as writers append them (tail -f over the JSONL stream).
+
+    Binary-mode reads with byte offsets (a character-count offset would
+    corrupt the resume position on multi-byte content from non-framework
+    producers); torn trailing lines are retried whole on the next poll
+    (JSONL writes are single atomic appends, so a partial line only means we
+    raced the writer mid-write). A missing file is the wait state — the
+    launcher may not have started — but any other OSError (directory,
+    permission) propagates: an unrecoverable path must fail visibly, not
+    hang silently. ``stop``: optional ``threading.Event``-like; checked each
+    poll so tests (and signal handlers) can end the loop."""
+    import json
+    import time as _time
+
+    offset = 0
+    buf = b""
+    while stop is None or not stop.is_set():
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read()
+        except FileNotFoundError:
+            chunk = b""
+        if chunk:
+            offset += len(chunk)
+            buf += chunk
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                try:
+                    yield json.loads(line.decode("utf-8"))
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    continue
+        else:
+            _time.sleep(poll)
+
+
+def _follow(path: str, kind: Optional[str]) -> int:
+    seen: list = []
+    t0: Optional[float] = None
+
+    def emit() -> None:
+        nonlocal t0
+        try:
+            for rec in iter_new_records(path):
+                if "ts" not in rec or "kind" not in rec:
+                    continue
+                seen.append(rec)
+                if t0 is None:
+                    t0 = rec["ts"]
+                if kind is None or rec["kind"] == kind:
+                    print(format_line(rec, t0), flush=True)
+        except KeyboardInterrupt:
+            pass
+        if seen:
+            summarize(seen, kind=kind, timeline=False)
+
+    try:
+        pipe_safe(emit)  # `--follow | head` must exit clean like batch mode
+    except OSError as e:
+        print(f"cannot follow events file: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Render a tpu-resiliency structured event stream as a timeline"
@@ -151,7 +223,15 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument(
         "--no-timeline", action="store_true", help="print only the summary footer"
     )
+    ap.add_argument(
+        "--follow",
+        action="store_true",
+        help="tail the stream live (Ctrl-C prints the summary); the file may "
+        "not exist yet — a launcher that hasn't started still gets watched",
+    )
     args = ap.parse_args(argv)
+    if args.follow:
+        return _follow(args.events_file, args.kind)
     # read_events tolerates unreadable files (shared-stream readers race the
     # first writer); a CLI invocation on a missing/denied/directory path must
     # fail visibly, not report an empty-but-successful run.
