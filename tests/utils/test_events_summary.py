@@ -202,3 +202,40 @@ def test_follow_through_closed_pipe_exits_clean(tmp_path):
     assert p.returncode == 0, err
     assert "BrokenPipe" not in err and "Exception ignored" not in err
     assert out.count("\n") == 2
+
+
+def test_follow_reader_resets_on_truncation(tmp_path):
+    """A recreated/truncated stream (new launcher run reusing the path) must
+    be re-read from the top, tail -f style, not silently stall."""
+    import json
+    import threading
+    import time
+
+    path = str(tmp_path / "t.jsonl")
+
+    def ev(i):
+        return json.dumps({"ts": float(i), "source": "x", "kind": "k", "pid": 1, "i": i}) + "\n"
+
+    with open(path, "w") as f:
+        f.write(ev(0) + ev(1))
+    stop = threading.Event()
+    got = []
+
+    def reader():
+        for rec in events_summary.iter_new_records(path, poll=0.02, stop=stop):
+            got.append(rec["i"])
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while got != [0, 1] and time.time() < deadline:
+        time.sleep(0.02)
+    assert got == [0, 1]
+    with open(path, "w") as f:  # truncating rewrite: shorter than old offset
+        f.write(ev(7))
+    deadline = time.time() + 5
+    while got != [0, 1, 7] and time.time() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5)
+    assert got == [0, 1, 7]

@@ -1,0 +1,80 @@
+"""store_info CLI + the keys/barriers introspection ops against a live server."""
+
+import io
+import threading
+import time
+
+from tpu_resiliency.platform.store import KVClient, KVServer
+from tpu_resiliency.tools import store_info
+
+
+def test_introspection_ops_and_report():
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        c = KVClient("127.0.0.1", server.port)
+        c.set("launcher/jobs/a", 1)
+        c.set("launcher/jobs/b", {"payload": "x" * 1000})
+        c.set("hb/r0", "t")
+        c.touch("hb/r0")
+
+        # keys: names only, prefix-scoped, sorted.
+        assert c.keys("launcher/") == ["launcher/jobs/a", "launcher/jobs/b"]
+        assert len(c.keys()) == 3
+
+        # A rank parked in a world-2 barrier: the report must show who's missing.
+        def late_barrier():
+            c2 = KVClient("127.0.0.1", server.port)
+            try:
+                c2.barrier_join("iter/0/barrier", rank=0, world_size=2, timeout=10.0)
+            except Exception:
+                pass
+            finally:
+                c2.close()
+
+        t = threading.Thread(target=late_barrier, daemon=True)
+        t.start()
+        deadline = time.time() + 5
+        while "iter/0/barrier" not in c.barrier_names() and time.time() < deadline:
+            time.sleep(0.02)
+        assert c.barrier_names() == ["iter/0/barrier"]
+
+        out = io.StringIO()
+        store_info.report(c, prefix="", stale_prefix="hb/", max_age=30.0, out=out)
+        text = out.getvalue()
+        assert "ping: ok" in text
+        assert "keys: 3 total (3 in store)" in text
+        assert "launcher/: 2" in text and "hb/: 1" in text
+        assert "barriers: 1 live" in text
+        assert "iter/0/barrier: 1/2 (waiting on 1; gen 0, arrived [0])" in text
+        assert "stale under 'hb/' (>30s): none" in text
+
+        # Unblock the parked rank so teardown is clean.
+        c.barrier_join("iter/0/barrier", rank=1, world_size=2, timeout=10.0)
+        t.join(timeout=10)
+        c.close()
+    finally:
+        server.close()
+
+
+def test_cli_main_against_live_and_dead_endpoints(capsys):
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        seed = KVClient("127.0.0.1", server.port)
+        seed.set("x/y", 1)
+        seed.close()
+        assert store_info.main([f"127.0.0.1:{server.port}"]) == 0
+        text = capsys.readouterr().out
+        assert "ping: ok" in text and "x/: 1" in text
+    finally:
+        server.close()
+    # Dead endpoint: fail fast with a message, not the 60-retry ladder.
+    t0 = time.monotonic()
+    assert store_info.main([f"127.0.0.1:{server.port}"]) == 1
+    assert time.monotonic() - t0 < 30.0
+    assert "cannot connect" in capsys.readouterr().err
+    # Malformed endpoint exits 2 via argparse.
+    try:
+        store_info.main(["nonsense"])
+        raise AssertionError("argparse must reject a portless endpoint")
+    except SystemExit as e:
+        assert e.code == 2

@@ -619,6 +619,16 @@ class KVServer:
     def _op_num_keys(self, req: dict) -> dict:
         return self._ok(len(self._data))
 
+    def _op_keys(self, req: dict) -> dict:
+        """Key names only under a prefix — introspection without hauling
+        values (prefix_get on a 4096-rank job's store moves megabytes)."""
+        prefix = req.get("prefix", "")
+        return self._ok(sorted(k for k in self._data if k.startswith(prefix)))
+
+    def _op_barriers(self, req: dict) -> dict:
+        """Names of live barriers (states via ``barrier_status``)."""
+        return self._ok(sorted(self._barriers))
+
     def _op_list_append(self, req: dict) -> dict:
         self._lists.setdefault(req["key"], []).append(req["value"])
         return self._ok()
@@ -985,6 +995,13 @@ class KVClient:
 
     def num_keys(self) -> int:
         return self._call({"op": "num_keys"})
+
+    def keys(self, prefix: str = "") -> list[str]:
+        """Key names under ``prefix`` — values stay server-side."""
+        return self._call({"op": "keys", "prefix": prefix})
+
+    def barrier_names(self) -> list[str]:
+        return self._call({"op": "barriers"})
 
     def list_append(self, key: str, value: Any) -> None:
         self._call({"op": "list_append", "key": key, "value": value})

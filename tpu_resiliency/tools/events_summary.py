@@ -119,11 +119,18 @@ def summarize(
     if timeline:
         for r in shown:
             print(format_line(r, t0), file=out)
-    counts = Counter(r["kind"] for r in records)
-    span = records[-1]["ts"] - t0
+    _footer(
+        Counter(r["kind"] for r in records),
+        n_events=len(records),
+        n_pids=len({r.get("pid") for r in records}),
+        span=records[-1]["ts"] - t0,
+        out=out,
+    )
+
+
+def _footer(counts: Counter, n_events: int, n_pids: int, span: float, out) -> None:
     print(
-        f"\n{len(records)} events over {span:.1f}s from "
-        f"{len({r.get('pid') for r in records})} processes",
+        f"\n{n_events} events over {span:.1f}s from {n_pids} processes",
         file=out,
     )
     for k, label in _SUMMARY_LINES:
@@ -167,6 +174,11 @@ def iter_new_records(path: str, poll: float = 0.5, stop=None):
     while stop is None or not stop.is_set():
         try:
             with open(path, "rb") as f:
+                if f.seek(0, 2) < offset:
+                    # Truncated/recreated (a new launcher run reusing the
+                    # path): restart from the top like tail -f on shrink.
+                    offset = 0
+                    buf = b""
                 f.seek(offset)
                 chunk = f.read()
         except FileNotFoundError:
@@ -186,25 +198,68 @@ def iter_new_records(path: str, poll: float = 0.5, stop=None):
             _time.sleep(poll)
 
 
+class _StdoutGone:
+    """Stop-condition for --follow: fires when stdout's consumer disappears.
+
+    A follower writing into a dead pipe exits via EPIPE on its next print —
+    but a follower that is IDLE (quiet stream) never writes again and would
+    linger forever after ``| head`` exits. Polling the stdout fd for
+    POLLERR/POLLHUP catches the closed pipe without writing; on a terminal
+    the poll simply never fires."""
+
+    def __init__(self) -> None:
+        import select
+
+        self._poll = None
+        try:
+            fd = sys.stdout.fileno()
+        except Exception:
+            # Wrapped/captured stdout (pytest, io wrappers) has no fd: no
+            # consumer-death detection, but the follower must still run.
+            return
+        self._poll = select.poll()
+        self._poll.register(fd, select.POLLERR | select.POLLHUP)
+
+    def is_set(self) -> bool:
+        if self._poll is None:
+            return False
+        try:
+            return bool(self._poll.poll(0))
+        except OSError:
+            return True
+
+
 def _follow(path: str, kind: Optional[str]) -> int:
-    seen: list = []
+    # Incremental footer state, not a record list: a multi-day follow on a
+    # chatty job must not grow RSS one dict per event.
+    counts: Counter = Counter()
+    pids: set = set()
     t0: Optional[float] = None
+    last_ts = 0.0
 
     def emit() -> None:
-        nonlocal t0
+        nonlocal t0, last_ts
         try:
-            for rec in iter_new_records(path):
+            for rec in iter_new_records(path, stop=_StdoutGone()):
                 if "ts" not in rec or "kind" not in rec:
                     continue
-                seen.append(rec)
+                counts[rec["kind"]] += 1
+                pids.add(rec.get("pid"))
                 if t0 is None:
                     t0 = rec["ts"]
+                last_ts = max(last_ts, rec["ts"])
                 if kind is None or rec["kind"] == kind:
                     print(format_line(rec, t0), flush=True)
         except KeyboardInterrupt:
             pass
-        if seen:
-            summarize(seen, kind=kind, timeline=False)
+        if counts:
+            _footer(
+                counts,
+                n_events=sum(counts.values()),
+                n_pids=len(pids),
+                span=last_ts - (t0 or last_ts),
+                out=sys.stdout,
+            )
 
     try:
         pipe_safe(emit)  # `--follow | head` must exit clean like batch mode

@@ -1,0 +1,130 @@
+"""Live introspection of a coordination store: what is the job waiting on?
+
+Connects to a running KV server (the launcher-hosted store, or a standalone
+one) and reports the operator-relevant state without disturbing the job:
+round-trip health, key census by top-level prefix, live barrier states
+(who arrived, who is absent — the "why is my rendezvous stuck" question),
+and a staleness scan over heartbeat keys. Everything rides existing store
+ops plus two introspection-only ones (``keys``, ``barriers``) that never
+move values — a census of a 4096-rank job's store costs key *names*, not
+megabytes of payloads. Auth: ``$TPU_RESILIENCY_STORE_KEY``, same as every
+other client.
+
+The reference's TCPStore offers no introspection at all — debugging its
+rendezvous means reading launcher logs.
+
+Usage::
+
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --prefix launcher-jobs/
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stale hb/ --max-age 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from collections import Counter
+from typing import Optional
+
+from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.platform.store import AUTH_KEY_ENV, KVClient
+from tpu_resiliency.tools import pipe_safe
+
+
+def report(client: KVClient, prefix: str, stale_prefix: Optional[str],
+           max_age: float, out=None) -> None:
+    out = sys.stdout if out is None else out
+    t0 = time.perf_counter()
+    alive = client.ping()
+    rtt_ms = (time.perf_counter() - t0) * 1e3
+    print(f"ping: {'ok' if alive else 'FAILED'} ({rtt_ms:.1f} ms)", file=out)
+    total = client.num_keys()
+    names = client.keys(prefix)
+    scope = f"under {prefix!r}" if prefix else "total"
+    print(f"keys: {len(names)} {scope} ({total} in store)", file=out)
+    census = Counter(
+        k[len(prefix):].split("/", 1)[0] if "/" in k[len(prefix):] else "(flat)"
+        for k in names
+    )
+    for part, n in census.most_common(20):
+        print(f"  {part}/: {n}", file=out)
+    barriers = client.barrier_names()
+    print(f"barriers: {len(barriers)} live", file=out)
+    for name in barriers[:20]:
+        st = client.barrier_status(name)
+        if st is None:
+            continue
+        arrived = sorted(st["arrived"])
+        waiting_on = st["world_size"] - len(arrived) - len(st["absent"])
+        detail = f"gen {st['generation']}, arrived {arrived}"
+        if st["absent"]:
+            detail += f", absent {sorted(st['absent'])}"
+        print(
+            f"  {name}: {len(arrived)}/{st['world_size']} "
+            f"({'COMPLETE' if waiting_on <= 0 else f'waiting on {waiting_on}'}; "
+            f"{detail})",
+            file=out,
+        )
+    if stale_prefix is not None:
+        stale = client.stale_keys(stale_prefix, max_age)
+        if stale:
+            print(
+                f"stale under {stale_prefix!r} (>{max_age:.0f}s):", file=out
+            )
+            for k, age in sorted(stale.items(), key=lambda kv: -kv[1]):
+                print(f"  {k}: {age:.1f}s", file=out)
+        else:
+            print(
+                f"stale under {stale_prefix!r} (>{max_age:.0f}s): none", file=out
+            )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Introspect a live tpu-resiliency coordination store"
+    )
+    ap.add_argument("endpoint", help="HOST:PORT of the KV server")
+    ap.add_argument("--prefix", default="", help="census keys under this prefix")
+    ap.add_argument(
+        "--stale", metavar="PREFIX",
+        help="also scan touch-stamps under PREFIX for staleness",
+    )
+    ap.add_argument("--max-age", type=float, default=10.0)
+    args = ap.parse_args(argv)
+    host, _, port_s = args.endpoint.partition(":")
+    try:
+        port = int(port_s)
+    except ValueError:
+        ap.error(f"want HOST:PORT, got {args.endpoint!r}")
+    try:
+        # Fail fast on a dead endpoint: a diagnostics tool must not sit in
+        # the client's default 60-attempt reconnect ladder.
+        client = KVClient(
+            host or "127.0.0.1",
+            port,
+            connect_retries=3,
+            auth_key=os.environ.get(AUTH_KEY_ENV) or None,
+        )
+    except StoreError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    try:
+        pipe_safe(
+            lambda: report(client, args.prefix, args.stale, args.max_age)
+        )
+    except (OSError, StoreError) as e:
+        print(f"store at {args.endpoint} failed mid-report: {e}", file=sys.stderr)
+        return 1
+    finally:
+        try:
+            client.close()
+        except Exception:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
