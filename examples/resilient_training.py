@@ -93,7 +93,12 @@ def main():
     )
     callbacks = [
         FaultToleranceCallback(calc_timeouts=True),
-        StragglerDetectionCallback(report_time_interval=2.0),
+        # Full telemetry stack: section timing every step, plus sampled
+        # profiler windows feeding per-program (prog/...) and per-op/scope
+        # (op/...) device times into the scored matrix.
+        StragglerDetectionCallback(
+            report_time_interval=2.0, profile_programs_every=10, profile_ops=True
+        ),
         ckpt_cb,
     ]
 
