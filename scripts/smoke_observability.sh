@@ -1,0 +1,64 @@
+#!/usr/bin/env bash
+# Smoke-check the observability pipeline end to end on one machine:
+# a tiny standalone launch with $TPU_RESILIENCY_EVENTS_FILE set must yield an
+# events JSONL from which BOTH the Chrome-trace export and the metrics dump
+# produce non-empty, schema-valid output. Exits non-zero on any gap.
+#
+# Usage: scripts/smoke_observability.sh [workdir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+WORKDIR="${1:-$(mktemp -d /tmp/tpu_obs_smoke.XXXXXX)}"
+mkdir -p "$WORKDIR"
+EVENTS="$WORKDIR/events.jsonl"
+export JAX_PLATFORMS=cpu
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "== smoke: standalone launch (1 fault, 1 restart) -> $EVENTS"
+cat > "$WORKDIR/worker.py" <<'PY'
+import os, sys
+round_no = int(os.environ["TPU_FT_RESTART_COUNT"])
+if round_no == 0:
+    sys.exit(3)
+print("recovered in round", round_no)
+PY
+python -m tpu_resiliency.launcher.launch \
+    --standalone --nproc-per-node 1 --max-restarts 2 --no-ft-monitors \
+    --rdzv-last-call 0.2 --monitor-interval 0.1 \
+    --events-file "$EVENTS" --run-dir "$WORKDIR/run" \
+    "$WORKDIR/worker.py"
+
+test -s "$EVENTS" || { echo "FAIL: events file empty"; exit 1; }
+
+echo "== smoke: trace export"
+python -m tpu_resiliency.tools.trace_export "$EVENTS" -o "$WORKDIR/trace.json"
+python - "$WORKDIR/trace.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+evs = doc["traceEvents"]
+assert evs, "empty traceEvents"
+assert all({"name", "ph", "pid"} <= set(e) for e in evs), "malformed trace event"
+slices = {e["name"] for e in evs if e["ph"] == "X"}
+assert "launcher.job" in slices and "launcher.round" in slices, slices
+assert sum(1 for e in evs if e["ph"] == "X" and e["name"] == "launcher.round") >= 2, \
+    "restart chain missing its second round"
+print(f"trace OK: {len(evs)} events, spans: {sorted(slices)}")
+PY
+
+echo "== smoke: metrics dump"
+python -m tpu_resiliency.tools.metrics_dump "$EVENTS" --format json -o "$WORKDIR/metrics.json"
+python - "$WORKDIR/metrics.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+m = doc["metrics"]
+assert m, "empty metrics"
+restarts = sum(e["value"] for e in m.get("tpu_restarts_total", []))
+assert restarts >= 1, f"no restarts aggregated: {sorted(m)}"
+spans = m.get("tpu_span_seconds", [])
+assert any(e["labels"].get("span") == "rendezvous.round" and e["count"] >= 1
+           for e in spans), "no rendezvous duration quantiles"
+print(f"metrics OK: {len(m)} families, restarts={int(restarts)}")
+PY
+python -m tpu_resiliency.tools.metrics_dump "$EVENTS" | sed 's/^/    /'
+
+echo "smoke_observability: PASS ($WORKDIR)"

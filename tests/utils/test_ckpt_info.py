@@ -96,3 +96,33 @@ def test_cli_main(tmp_path, capsys):
     assert ckpt_info.main([str(tmp_path)]) == 0
     assert "resumable from: iter 7" in capsys.readouterr().out  # single-rank world
     assert ckpt_info.main([str(tmp_path / "nope")]) == 1
+
+
+def test_scan_survives_session_dir_unlinked_mid_audit(tmp_path, monkeypatch):
+    """A retention prune (or operator rm) deleting a session directory between
+    the root listing and the per-session listing must skip that session, not
+    abort the whole audit."""
+    import shutil
+
+    root = tmp_path / "root"
+    for s in ("s0", "s1"):
+        d = root / s / "r0"
+        d.mkdir(parents=True)
+        (d / "iter_0000005_0_local.ckpt").write_bytes(b"x" * 10)
+
+    doomed = str(root / "s0")
+    real_listdir = os.listdir
+
+    def racing_listdir(p):
+        # Unlink s0 the moment the scanner descends into it.
+        if str(p) == doomed and os.path.isdir(doomed):
+            shutil.rmtree(doomed)
+        return real_listdir(p)
+
+    monkeypatch.setattr(os, "listdir", racing_listdir)
+    sessions = ckpt_info.scan(str(root))
+    assert [s.session for s in sessions] == [1]  # s0 skipped, audit completed
+
+
+def test_scan_survives_root_unlinked(tmp_path):
+    assert ckpt_info.scan(str(tmp_path / "gone")) == []

@@ -239,3 +239,71 @@ def test_follow_reader_resets_on_truncation(tmp_path):
     stop.set()
     t.join(timeout=5)
     assert got == [0, 1, 7]
+
+
+def test_iter_new_records_detects_recreated_file_by_inode(tmp_path):
+    """tail -F semantics: a NEW events file at the same path (next launcher
+    run) that has already grown PAST the old byte offset must be read from
+    its top — size-shrink detection alone would resume mid-file."""
+    import json
+    import os
+    import threading
+    import time
+
+    path = str(tmp_path / "rotate.jsonl")
+
+    def ev(i, pad=0):
+        return json.dumps(
+            {"ts": float(i), "source": "x", "kind": "k", "pid": 1, "i": i,
+             "pad": "y" * pad}
+        )
+
+    with open(path, "w") as f:
+        f.write(ev(0) + "\n")  # short old file
+    stop = threading.Event()
+    got = []
+
+    def reader():
+        for rec in events_summary.iter_new_records(path, poll=0.02, stop=stop):
+            got.append(rec["i"])
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while got != [0] and time.time() < deadline:
+        time.sleep(0.02)
+    assert got == [0]
+    # Recreate atomically with a BIGGER file (padded records): its size
+    # exceeds the reader's offset, so only the inode change reveals the swap.
+    tmp = path + ".new"
+    with open(tmp, "w") as f:
+        f.write(ev(10, pad=200) + "\n" + ev(11, pad=200) + "\n")
+    os.replace(tmp, path)
+    deadline = time.time() + 5
+    while got != [0, 10, 11] and time.time() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    t.join(timeout=5)
+    assert got == [0, 10, 11], "new run's head was skipped (offset not reset)"
+
+
+def test_truncated_by_head_exits_141(tmp_path):
+    """SIGPIPE convention: a pipe-truncated run exits 141, a complete one 0 —
+    scripts can tell the difference."""
+    import json
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "big.jsonl")
+    _write_events(
+        path,
+        [(float(i), "x", f"k{i % 7}", {"data": "y" * 40}) for i in range(2000)],
+    )
+    r = subprocess.run(
+        ["bash", "-c",
+         f"set -o pipefail; {sys.executable} -m tpu_resiliency.tools.events_summary"
+         f" {path} | head -1"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 141, (r.returncode, r.stderr)
+    assert "Exception ignored" not in r.stderr

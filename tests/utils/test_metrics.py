@@ -1,0 +1,219 @@
+"""Metrics registry: primitives, quantiles, Prometheus exposition, events bridge."""
+
+import json
+import math
+import os
+import re
+import threading
+
+import pytest
+
+from tpu_resiliency.utils import events
+from tpu_resiliency.utils.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsSink,
+    aggregate,
+    observe_record,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    old = os.environ.pop(events.EVENTS_FILE_ENV, None)
+    yield
+    events.clear_sinks()
+    if old is not None:
+        os.environ[events.EVENTS_FILE_ENV] = old
+
+
+def test_counter_and_gauge():
+    c = Counter()
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = Gauge()
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value == 9.0
+
+
+def test_histogram_quantiles_exact_below_reservoir():
+    h = Histogram()
+    for v in range(1, 101):  # 0.01 .. 1.00
+        h.observe(v / 100)
+    assert h.count == 100 and abs(h.sum - 50.5) < 1e-9
+    assert abs(h.quantile(0.5) - 0.50) < 1e-9
+    assert abs(h.quantile(0.95) - 0.95) < 1e-9
+    assert abs(h.quantile(1.0) - 1.00) < 1e-9
+    assert abs(h.quantile(0.0) - 0.01) < 1e-9
+    assert math.isnan(Histogram().quantile(0.5))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_buckets_are_cumulative_in_exposition():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "latency", (0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 3' in text
+    assert 'lat_seconds_bucket{le="10"} 4' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 5' in text
+    assert "lat_seconds_count 5" in text
+    assert "# TYPE lat_seconds histogram" in text
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", kind="a")
+    assert reg.counter("x_total", kind="a") is a  # same series
+    assert reg.counter("x_total", kind="b") is not a  # same family, new series
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # one family, one type
+
+
+def test_prometheus_format_is_parseable():
+    """Every sample line must match the exposition grammar (name{labels} value)."""
+    reg = MetricsRegistry()
+    reg.counter("tpu_restarts_total", "restarts", layer="injob").inc(2)
+    reg.gauge("tpu_world_size").set(8)
+    reg.histogram("tpu_span_seconds", span="rendezvous.round").observe(0.25)
+    line_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+        r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? [0-9eE.+-]+$|^\+Inf$"
+    )
+    for line in reg.to_prometheus().splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert line_re.match(line.replace("+Inf", "Inf")), line
+
+
+def test_metric_name_sanitized():
+    reg = MetricsRegistry()
+    reg.counter("weird-name.total").inc()
+    assert "weird_name_total 1" in reg.to_prometheus()
+
+
+def test_snapshot_and_write_json(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(3)
+    reg.histogram("h_seconds").observe(1.0)
+    path = str(tmp_path / "sub" / "m.json")
+    reg.write_json(path)
+    doc = json.load(open(path))
+    m = doc["metrics"]
+    assert m["c_total"][0]["value"] == 3
+    assert m["h_seconds"][0]["count"] == 1
+    assert m["h_seconds"][0]["p95"] == 1.0
+    assert not [f for f in os.listdir(tmp_path / "sub") if ".tmp." in f]
+
+
+def test_counter_thread_safety():
+    c = Counter()
+
+    def work():
+        for _ in range(10_000):
+            c.inc()
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == 80_000
+
+
+def test_observe_record_mapping():
+    reg = MetricsRegistry()
+    recs = [
+        {"kind": "rendezvous_round", "round": 1, "world_size": 4},
+        {"kind": "restart_requested"},
+        {"kind": "restart_signalled"},
+        {"kind": "worker_failed"},
+        {"kind": "hang_detected"},
+        {"kind": "ckpt_saved", "bytes": 1024},
+        {"kind": "timing", "name": "ckpt.save.write", "duration_s": 0.2, "ok": True},
+        {"kind": "timing", "name": "ckpt.save.write", "duration_s": 0.4, "ok": False},
+        {"kind": "span_end", "span": "rendezvous.round", "duration_s": 1.5, "ok": True},
+        {"kind": "unmapped_novelty"},
+        {"no_kind": True},
+    ]
+    aggregate(recs, reg)
+    snap = reg.snapshot()["metrics"]
+    total = sum(e["value"] for e in snap["tpu_events_total"])
+    assert total == 10  # the kindless record is skipped, the novel kind counted
+    by_layer = {
+        tuple(sorted(e["labels"].items())): e["value"]
+        for e in snap["tpu_restarts_total"]
+    }
+    assert by_layer == {(("layer", "injob"),): 1, (("layer", "inprocess"),): 1}
+    assert snap["tpu_worker_failures_total"][0]["value"] == 1
+    assert snap["tpu_rank_terminations_total"][0]["labels"] == {"cause": "hang"}
+    assert snap["tpu_ckpt_saves_total"][0]["value"] == 1
+    h = reg.histograms("tpu_timing_seconds")[(("name", "ckpt.save.write"),)]
+    assert h.count == 2
+    assert snap["tpu_timing_failures_total"][0]["value"] == 1
+    rdzv = reg.histograms("tpu_span_seconds")[(("span", "rendezvous.round"),)]
+    assert rdzv.quantile(0.95) == 1.5
+    assert reg.gauge("tpu_world_size").value == 4
+
+
+def test_metrics_sink_bridges_live_records(tmp_path):
+    """One record() call feeds the JSONL stream AND the registry."""
+    reg = MetricsRegistry()
+    jsonl = str(tmp_path / "ev.jsonl")
+    events.add_sink(events.JsonlSink(jsonl))
+    events.add_sink(MetricsSink(reg, json_path=str(tmp_path / "m.json"),
+                                snapshot_interval=0.0))
+    events.record("launcher", "restart_requested", reason="test")
+    events.record("checkpoint", "timing", name="ckpt.load", duration_s=0.1, ok=True)
+    # payload keys colliding with the envelope get the same p_-rename as JSONL
+    events.record("x", "y", ts=-1, pid=-1)
+    recs = events.read_events(jsonl)
+    assert len(recs) == 3
+    assert recs[2]["p_ts"] == -1 and recs[2]["ts"] != -1
+    snap = reg.snapshot()["metrics"]
+    assert snap["tpu_restarts_total"][0]["value"] == 1
+    kinds = {e["labels"]["kind"] for e in snap["tpu_events_total"]}
+    assert kinds == {"restart_requested", "timing", "y"}
+    # The piggybacked snapshot file landed and parses.
+    doc = json.load(open(tmp_path / "m.json"))
+    assert "tpu_events_total" in doc["metrics"]
+
+
+def test_aggregate_matches_sink(tmp_path):
+    """Live-bridged and post-hoc-aggregated registries agree on the same run."""
+    jsonl = str(tmp_path / "ev.jsonl")
+    live = MetricsRegistry()
+    events.add_sink(events.JsonlSink(jsonl))
+    events.add_sink(MetricsSink(live))
+    for i in range(5):
+        events.record("launcher", "rendezvous_round", round=i, world_size=2)
+    events.record("launcher", "worker_failed", global_rank=0, exitcode=3)
+    post = aggregate(events.read_events(jsonl))
+    for reg in (live, post):
+        snap = reg.snapshot()["metrics"]
+        assert snap["tpu_rendezvous_rounds_total"][0]["value"] == 5
+        assert snap["tpu_worker_failures_total"][0]["value"] == 1
+
+
+def test_env_var_wires_metrics_bridge(tmp_path, monkeypatch):
+    """$TPU_RESILIENCY_METRICS_FILE attaches a MetricsSink lazily, with the
+    pid inserted so sibling processes never clobber each other's snapshot."""
+    mpath = tmp_path / "m.json"
+    monkeypatch.setenv(events.METRICS_FILE_ENV, str(mpath))
+    events.record("launcher", "worker_failed", global_rank=0)
+    expect = tmp_path / f"m.{os.getpid()}.json"
+    assert expect.exists(), os.listdir(tmp_path)
+    doc = json.load(open(expect))
+    vals = [e["value"] for e in doc["metrics"]["tpu_worker_failures_total"]]
+    assert vals and vals[0] >= 1

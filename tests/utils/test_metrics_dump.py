@@ -1,0 +1,89 @@
+"""metrics_dump CLI: JSONL → report / Prometheus / JSON."""
+
+import json
+import os
+
+import pytest
+
+from tpu_resiliency.tools import metrics_dump
+from tpu_resiliency.utils import events, tracing
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    events.clear_sinks()
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in (events.EVENTS_FILE_ENV, tracing.TRACE_ID_ENV, tracing.PARENT_SPAN_ENV)
+    }
+    yield
+    events.clear_sinks()
+    for k, v in saved.items():
+        if v is not None:
+            os.environ[k] = v
+
+
+@pytest.fixture
+def run_jsonl(tmp_path):
+    """A plausible one-fault run, emitted through the real event layer."""
+    path = str(tmp_path / "run.jsonl")
+    events.add_sink(events.JsonlSink(path))
+    for rnd in (0, 1):
+        with tracing.span("rendezvous", "rendezvous.round"):
+            pass
+        events.record("launcher", "rendezvous_round", round=rnd, world_size=2)
+    events.record("launcher", "worker_failed", global_rank=0, exitcode=3)
+    events.record("launcher", "restart_requested", reason="rank 0 exit 3")
+    for d in (0.02, 0.03):
+        events.record("checkpoint", "timing", name="ckpt.save.write",
+                      duration_s=d, ok=True, bytes=2048)
+    events.record("checkpoint", "ckpt_saved", iteration=1, bytes=2048)
+    return path
+
+
+def test_report_answers_the_operator_questions(run_jsonl, capsys):
+    assert metrics_dump.main([run_jsonl]) == 0
+    out = capsys.readouterr().out
+    assert "in-job requested: 1" in out
+    assert "worker failures: 1" in out
+    assert "rendezvous rounds: 2" in out
+    assert "checkpoint saves: 1" in out
+    # The two headline latencies, by name, with quantiles.
+    assert "rendezvous round duration: n=2 p50=" in out
+    assert "p95=" in out
+    assert "checkpoint save/load latency" in out
+    assert "ckpt.save.write" in out
+
+
+def test_prom_output_is_exposition_format(run_jsonl, capsys):
+    assert metrics_dump.main([run_jsonl, "--format", "prom"]) == 0
+    out = capsys.readouterr().out
+    assert "# TYPE tpu_events_total counter" in out
+    assert 'tpu_restarts_total{layer="injob"} 1' in out
+    assert "# TYPE tpu_span_seconds histogram" in out
+    assert 'tpu_span_seconds_count{span="rendezvous.round"} 2' in out
+
+
+def test_json_output_and_file_write(run_jsonl, tmp_path, capsys):
+    assert metrics_dump.main([run_jsonl, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["metrics"]["tpu_worker_failures_total"][0]["value"] == 1
+    out = str(tmp_path / "m.json")
+    assert metrics_dump.main([run_jsonl, "--format", "json", "-o", out]) == 0
+    doc2 = json.load(open(out))
+    assert doc2["metrics"].keys() == doc["metrics"].keys()
+
+
+def test_report_file_write(run_jsonl, tmp_path):
+    out = str(tmp_path / "report.txt")
+    assert metrics_dump.main([run_jsonl, "-o", out]) == 0
+    assert "in-job requested: 1" in open(out).read()
+
+
+def test_fails_visibly_on_missing_or_empty(tmp_path, capsys):
+    assert metrics_dump.main([str(tmp_path / "nope.jsonl")]) == 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert metrics_dump.main([str(empty)]) == 1
+    err = capsys.readouterr().err
+    assert "cannot read" in err and "no events" in err

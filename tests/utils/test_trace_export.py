@@ -1,0 +1,110 @@
+"""Chrome trace export: span pairing → X slices, instants, round trip."""
+
+import json
+import os
+
+import pytest
+
+from tpu_resiliency.tools import trace_export
+from tpu_resiliency.utils import events, tracing
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    events.clear_sinks()
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in (events.EVENTS_FILE_ENV, tracing.TRACE_ID_ENV, tracing.PARENT_SPAN_ENV)
+    }
+    yield
+    events.clear_sinks()
+    for k, v in saved.items():
+        if v is not None:
+            os.environ[k] = v
+
+
+def _synthetic_stream(tmp_path):
+    """A real stream from the real emitters: spans + plain events."""
+    path = str(tmp_path / "ev.jsonl")
+    events.add_sink(events.JsonlSink(path))
+    tracing.ensure_trace_id()
+    with tracing.span("launcher", "launcher.round", round=0):
+        events.record("launcher", "worker_failed", global_rank=1, exitcode=3)
+        with tracing.span("rendezvous", "rendezvous.round"):
+            pass
+    return path
+
+
+def test_matched_span_becomes_complete_slice(tmp_path):
+    path = _synthetic_stream(tmp_path)
+    trace = trace_export.to_chrome_trace(events.read_events(path))
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in slices}
+    assert names == {"launcher.round", "rendezvous.round"}
+    round_slice = next(e for e in slices if e["name"] == "launcher.round")
+    assert round_slice["dur"] >= 0 and round_slice["args"]["round"] == 0
+    assert "span_id" in round_slice["args"]
+    # Instants survive with their payload.
+    instants = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert any(e["name"] == "worker_failed" and e["args"]["exitcode"] == 3
+               for e in instants)
+    # Process metadata rows name the pid.
+    metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert metas and all(e["name"] == "process_name" for e in metas)
+
+
+def test_unmatched_begin_renders_unfinished_to_stream_end(tmp_path):
+    """A process that dies inside a span (the case worth debugging) still
+    shows the span, flagged and extended to the last event."""
+    recs = [
+        {"ts": 10.0, "source": "w", "kind": "span_begin", "pid": 5,
+         "span_id": "aa", "span": "doomed"},
+        {"ts": 12.0, "source": "w", "kind": "worker_failed", "pid": 5},
+    ]
+    trace = trace_export.to_chrome_trace(recs)
+    x = next(e for e in trace["traceEvents"] if e["ph"] == "X")
+    assert x["name"] == "doomed" and x["args"]["unfinished"] is True
+    assert x["dur"] == pytest.approx(2e6)  # microseconds to end of stream
+
+
+def test_orphan_end_degrades_to_instant():
+    recs = [
+        {"ts": 1.0, "source": "w", "kind": "span_end", "pid": 5,
+         "span_id": "zz", "span": "headless", "duration_s": 0.5, "ok": True},
+    ]
+    trace = trace_export.to_chrome_trace(recs)
+    assert [e["ph"] for e in trace["traceEvents"] if e["ph"] != "M"] == ["i"]
+
+
+def test_garbage_and_empty_streams():
+    assert trace_export.to_chrome_trace([]) == {
+        "traceEvents": [], "displayTimeUnit": "ms"
+    }
+    # ts-less / kind-less records are dropped, not crashed on.
+    assert trace_export.to_chrome_trace(
+        [{"kind": "x"}, {"ts": 1.0}, {"ts": "bad", "kind": "y"}]
+    )["traceEvents"] == []
+
+
+def test_cli_round_trip_produces_loadable_json(tmp_path, capsys):
+    path = _synthetic_stream(tmp_path)
+    out = str(tmp_path / "trace.json")
+    assert trace_export.main([path, "-o", out]) == 0
+    assert "perfetto" in capsys.readouterr().out
+    doc = json.load(open(out))  # Perfetto-loadable == valid trace-event JSON
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    phases = {e["ph"] for e in doc["traceEvents"]}
+    assert phases <= {"X", "i", "M"}
+    # Every slice/instant has the required fields.
+    for e in doc["traceEvents"]:
+        assert {"name", "ph", "pid"} <= set(e)
+        if e["ph"] != "M":
+            assert "ts" in e and "tid" in e
+
+
+def test_cli_fails_visibly(tmp_path, capsys):
+    assert trace_export.main([str(tmp_path / "missing.jsonl")]) == 1
+    assert "cannot read" in capsys.readouterr().err
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_export.main([str(empty)]) == 1

@@ -28,9 +28,21 @@ from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
 from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.timers import debug_time
 
 log = get_logger(__name__)
+
+
+def _payload_bytes(writes) -> int:
+    """Total bytes a write set will put on disk (hollow pickles + tensor data)."""
+    total = 0
+    for _, hollow_bytes, tensors, _ in writes:
+        total += len(hollow_bytes)
+        for t in tensors:
+            total += int(getattr(t, "nbytes", 0) or 0)
+    return total
 
 
 def _write_containers(writes, cleanup=()) -> None:
@@ -43,8 +55,24 @@ def _write_containers(writes, cleanup=()) -> None:
     never a loadable generation."""
     import glob as _glob
 
-    for path, hollow_bytes, tensors, meta in writes:
-        ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+    t0 = time.perf_counter()
+    try:
+        for path, hollow_bytes, tensors, meta in writes:
+            ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+    except BaseException as e:
+        record_event(
+            "checkpoint", "timing", name="ckpt.async_write",
+            duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
+            bytes=_payload_bytes(writes), files=len(writes),
+        )
+        raise
+    # The background-half latency + volume: with the foreground
+    # ``ckpt.async_save`` timing this decomposes a save end to end.
+    record_event(
+        "checkpoint", "timing", name="ckpt.async_write",
+        duration_s=time.perf_counter() - t0, ok=True,
+        bytes=_payload_bytes(writes), files=len(writes),
+    )
     for pattern, keep in cleanup:
         for stale in _glob.glob(pattern):
             if stale != keep:
@@ -144,6 +172,20 @@ class AsyncCheckpointer:
         leaves the previous generation's main+hint pair fully loadable — the old
         token-named hint file is pruned only after the new main file committed.
         """
+        # Foreground half (D2H + pickle + conflict serialization + schedule):
+        # the caller-visible stall a train loop pays per save; the background
+        # half is ``ckpt.async_write`` (in ``_write_containers``).
+        with debug_time("ckpt.async_save", source="checkpoint"):
+            return self._async_save(tree, path, meta, rank, separation_hint)
+
+    def _async_save(
+        self,
+        tree: Any,
+        path: str,
+        meta: Optional[dict],
+        rank: Optional[int],
+        separation_hint: Optional[str],
+    ) -> AsyncRequest:
         if isinstance(tree, PyTreeStateDict):
             sd = tree
             if not sd.is_hollow:
@@ -211,6 +253,7 @@ class AsyncCheckpointer:
         self._inflight_paths[idx] = targets
         return req
 
+    @debug_time("ckpt.save_sync", source="checkpoint")
     def save(self, tree: Any, path: str, meta: Optional[dict] = None, rank: Optional[int] = None) -> None:
         sd = PyTreeStateDict(tree)
         sd.pop_tensors()
@@ -271,6 +314,20 @@ class AsyncCheckpointer:
         may be omitted (its file gets default placement), every other key must
         match the main file's tree exactly (the flat per-tensor-sequence form
         cannot be split across two files)."""
+        # Restore latency is half the recovery-time story — record it like save.
+        with debug_time("ckpt.load", source="checkpoint"):
+            return AsyncCheckpointer._load(
+                path, rank, shardings, device, separation_hint
+            )
+
+    @staticmethod
+    def _load(
+        path: str,
+        rank: Optional[int],
+        shardings,
+        device,
+        separation_hint: Optional[str],
+    ) -> tuple[Any, dict]:
         if separation_hint is not None:
             shard_rest = shard_hint = None
             if shardings is not None:

@@ -58,8 +58,27 @@ def _write_blobs(paths_and_blobs: list[tuple[str, bytes]]) -> None:
     Writer parallelism follows the ``$TPU_RESILIENCY_CKPT_STRIPES`` storage-class
     knob (``format.write_blob``); default is single-stream, the measured winner
     on plain host storage."""
-    for path, blob in paths_and_blobs:
-        ckpt_format.write_blob(path, blob)
+    import time as _time
+
+    t0 = _time.perf_counter()
+    total = sum(len(b) for _, b in paths_and_blobs)
+    try:
+        for path, blob in paths_and_blobs:
+            ckpt_format.write_blob(path, blob)
+    except BaseException as e:
+        record_event(
+            "checkpoint", "timing", name="ckpt.save.write",
+            duration_s=_time.perf_counter() - t0, ok=False, error=repr(e),
+            bytes=total, files=len(paths_and_blobs),
+        )
+        raise
+    # Completes the save decomposition (d2h → serialize → replicate → write):
+    # this is the disk-bound half, with the volume that explains its latency.
+    record_event(
+        "checkpoint", "timing", name="ckpt.save.write",
+        duration_s=_time.perf_counter() - t0, ok=True,
+        bytes=total, files=len(paths_and_blobs),
+    )
 
 
 class LocalCheckpointManager:
@@ -149,10 +168,11 @@ class LocalCheckpointManager:
             (self._path(CkptID(iteration, owner, self.session)), b)
             for owner, b in held.items()
         ]
+        total_bytes = sum(len(b) for _, b in writes)
         req = AsyncRequest(
             async_fn=_write_blobs,
             async_fn_args=(writes,),
-            finalize_fns=(lambda: self._finalize_save(iteration),),
+            finalize_fns=(lambda: self._finalize_save(iteration, total_bytes),),
         )
         if is_async:
             self.queue.schedule_async_request(req)
@@ -160,7 +180,7 @@ class LocalCheckpointManager:
         req.execute_sync()
         return None
 
-    def _finalize_save(self, iteration: int) -> None:
+    def _finalize_save(self, iteration: int, total_bytes: Optional[int] = None) -> None:
         """Verify coverage of ``iteration`` across ranks, then prune older iterations."""
         covered = self._covered_iterations()
         if iteration not in covered:
@@ -173,9 +193,12 @@ class LocalCheckpointManager:
                 f"(covered: {sorted(covered)[-3:]})"
             )
         # Only after coverage verification: ckpt_saved is a durability signal.
+        # ``bytes`` = this rank's on-disk volume for the iteration (own shard +
+        # mirrors), the cost side of the replication policy.
         record_event(
             "checkpoint", "ckpt_saved", iteration=iteration, owner_rank=self.rank,
             held=sorted(i.owner for i in self.local_ids() if i.iteration == iteration),
+            **({"bytes": total_bytes} if total_bytes is not None else {}),
         )
         # Keep only the newest fully-covered iteration (the reference's retention
         # policy: local ckpts are a recovery buffer, not an archive).
@@ -266,6 +289,10 @@ class LocalCheckpointManager:
         through clique retrieval when the shard isn't held locally
         (``base_manager.py:205-234``).
         """
+        with debug_time("ckpt.local_load", source="checkpoint"):
+            return self._load(iteration)
+
+    def _load(self, iteration: Optional[int]) -> tuple[Any, list, dict]:
         if iteration is None:
             iteration = self.find_latest()
         if iteration < 0:

@@ -55,6 +55,7 @@ from tpu_resiliency.inprocess.state import Mode, State
 from tpu_resiliency.platform.store import host_store, store_addr_from_env
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
 
@@ -202,10 +203,13 @@ class CallWrapper:
         self.watchdog.start()
 
         # All ranks meet before the first iteration (reference initial_barrier,
-        # ``store.py:293``).
-        self.store.barrier_join(
-            "barrier/initial", self.state.rank, self.state.world_size, wrapper.barrier_timeout
-        )
+        # ``store.py:293``). Span'd: the wait is the cross-rank skew at start
+        # (and a straggling peer shows up as THIS rank's long barrier slice).
+        with span("inprocess", "barrier.initial", rank=self.state.rank):
+            self.store.barrier_join(
+                "barrier/initial", self.state.rank, self.state.world_size,
+                wrapper.barrier_timeout,
+            )
 
     # -- API exposed to the wrapped fn -------------------------------------
 
@@ -375,6 +379,92 @@ class CallWrapper:
 
     # -- the restart loop --------------------------------------------------
 
+    def _restart_transition(self, monitor, abort_fn, state, iteration: int):
+        """Everything between a fault and re-entering the wrapped fn: finalize →
+        health check → iteration barrier → rank reassignment → advance.
+
+        Returns the advanced state, or ``None`` when this rank stood down (the
+        job completed without it); raises ``RestartAbort``/``HealthCheckError``
+        to leave the restart loop."""
+        w, coord = self.w, self.coord
+        if self.monitor_process is not None:
+            self.monitor_process.set_phase("coord")
+        monitor.shutdown()
+        if abort_fn is not None and not monitor.fired:
+            # Local exception path: the monitor thread never ran the abort
+            # chain (we acknowledged before it fired) — run it here so abort
+            # semantics hold on every restart (reference routes local
+            # exceptions through the monitor for the same guarantee).
+            with self._atomic_lock:
+                abort_fn()
+        frozen = state.freeze()
+        self._chain(w.finalize, frozen)
+        self._chain(w.health_check, frozen)  # raises to exclude this rank
+        # Check the terminated set BEFORE joining: a falsely-declared-dead
+        # rank's barriers were already proxy-joined, so a waiting join here
+        # would overflow rather than surface the real condition.
+        try:
+            # Job already completed without us? (We were proxy-completed out
+            # of a finishing round after being starved.) Checking BEFORE the
+            # barrier join is what makes the server_linger rescue work: a
+            # straggler that parks on the next round's barrier would only be
+            # kicked out at teardown, when the job_done probe can no longer
+            # answer.
+            if coord.job_done():
+                self._stand_down(
+                    monitor, iteration, "job completed while this rank restarted"
+                )
+                return None
+            if state.initial_rank in coord.terminated_ranks():
+                raise RestartAbort(
+                    f"rank {state.initial_rank} was declared terminated by peers"
+                )
+            try:
+                # The barrier wait is where a restart stalls when a peer is
+                # slow to unwind — its own slice inside inprocess.restart.
+                with span("inprocess", "barrier.iteration", iteration=iteration):
+                    coord.join_iteration_barrier(
+                        iteration, state.rank, w.barrier_timeout
+                    )
+            except BarrierOverflow as e:
+                # Our slot was proxy-joined between the check and the join.
+                raise RestartAbort(
+                    f"rank {state.initial_rank} was declared terminated by peers"
+                ) from e
+            except BarrierTimeout as e:
+                raise RestartAbort(
+                    f"iteration barrier timed out after {w.barrier_timeout}s: "
+                    f"unproxied dead ranks or store loss"
+                ) from e
+            terminated = coord.terminated_ranks()
+            degraded = coord.degraded_ranks()
+        except StoreError as se:
+            # The coordinator is gone. A rank that was proxy-completed out
+            # of a finishing round (declared dead under load but actually
+            # alive) lands here when rank 0 tears the store down: stand
+            # down if the job completed, abort loudly otherwise.
+            if self._probe_job_done() is True:
+                self._stand_down(
+                    monitor, iteration, "coordinator gone mid-restart; job done"
+                )
+                return None
+            raise RestartAbort(
+                f"coordination store lost mid-restart: {se!r}"
+            ) from se
+        ctx = RankAssignmentCtx(state, terminated, degraded)
+        state = w.rank_assignment(ctx).state
+        if state.mode == Mode.TERMINATED:
+            raise RestartAbort("excluded by rank assignment")
+        state.advance()
+        state.set_distributed_vars()
+        self.state = state
+        if state.rank == 0 and iteration > 0:
+            # The round-(i) resync barrier released, so nothing can touch
+            # round i-1 anymore: reclaim its records/flags/barriers.
+            coord.cleanup_iteration(iteration - 1)
+        gc.collect()
+        return state
+
     def run(self) -> Any:
         w, state, coord = self.w, self.state, self.coord
 
@@ -434,9 +524,12 @@ class CallWrapper:
                         self.monitor_process.set_phase("coord")
                     try:
                         coord.mark_completed(iteration)
-                        coord.join_completion_barrier(
-                            iteration, state.rank, w.completion_timeout
-                        )
+                        with span(
+                            "inprocess", "barrier.completion", iteration=iteration
+                        ):
+                            coord.join_completion_barrier(
+                                iteration, state.rank, w.completion_timeout
+                            )
                     except CompletionInterrupted:
                         # A peer faulted while we were completing; fall back into
                         # the restart path with everyone else immediately — sitting
@@ -532,79 +625,21 @@ class CallWrapper:
                         raise
 
                 # ---- restart path ----
-                if self.monitor_process is not None:
-                    self.monitor_process.set_phase("coord")
-                monitor.shutdown()
-                if abort_fn is not None and not monitor.fired:
-                    # Local exception path: the monitor thread never ran the abort
-                    # chain (we acknowledged before it fired) — run it here so abort
-                    # semantics hold on every restart (reference routes local
-                    # exceptions through the monitor for the same guarantee).
-                    with self._atomic_lock:
-                        abort_fn()
-                frozen = state.freeze()
-                self._chain(w.finalize, frozen)
-                self._chain(w.health_check, frozen)  # raises to exclude this rank
-                # Check the terminated set BEFORE joining: a falsely-declared-dead
-                # rank's barriers were already proxy-joined, so a waiting join here
-                # would overflow rather than surface the real condition.
-                try:
-                    # Job already completed without us? (We were proxy-completed out
-                    # of a finishing round after being starved.) Checking BEFORE the
-                    # barrier join is what makes the server_linger rescue work: a
-                    # straggler that parks on the next round's barrier would only be
-                    # kicked out at teardown, when the job_done probe can no longer
-                    # answer.
-                    if coord.job_done():
-                        self._stand_down(
-                            monitor, iteration, "job completed while this rank restarted"
-                        )
-                        return None
-                    if state.initial_rank in coord.terminated_ranks():
-                        raise RestartAbort(
-                            f"rank {state.initial_rank} was declared terminated by peers"
-                        )
-                    try:
-                        coord.join_iteration_barrier(
-                            iteration, state.rank, w.barrier_timeout
-                        )
-                    except BarrierOverflow as e:
-                        # Our slot was proxy-joined between the check and the join.
-                        raise RestartAbort(
-                            f"rank {state.initial_rank} was declared terminated by peers"
-                        ) from e
-                    except BarrierTimeout as e:
-                        raise RestartAbort(
-                            f"iteration barrier timed out after {w.barrier_timeout}s: "
-                            f"unproxied dead ranks or store loss"
-                        ) from e
-                    terminated = coord.terminated_ranks()
-                    degraded = coord.degraded_ranks()
-                except StoreError as se:
-                    # The coordinator is gone. A rank that was proxy-completed out
-                    # of a finishing round (declared dead under load but actually
-                    # alive) lands here when rank 0 tears the store down: stand
-                    # down if the job completed, abort loudly otherwise.
-                    if self._probe_job_done() is True:
-                        self._stand_down(
-                            monitor, iteration, "coordinator gone mid-restart; job done"
-                        )
-                        return None
-                    raise RestartAbort(
-                        f"coordination store lost mid-restart: {se!r}"
-                    ) from se
-                ctx = RankAssignmentCtx(state, terminated, degraded)
-                state = w.rank_assignment(ctx).state
-                if state.mode == Mode.TERMINATED:
-                    raise RestartAbort("excluded by rank assignment")
-                state.advance()
-                state.set_distributed_vars()
-                self.state = state
-                if state.rank == 0 and iteration > 0:
-                    # The round-(i) resync barrier released, so nothing can touch
-                    # round i-1 anymore: reclaim its records/flags/barriers.
-                    coord.cleanup_iteration(iteration - 1)
-                gc.collect()
+                # One span per restart transition: its duration is the
+                # fault→re-entry recovery time (abort chain ran already in the
+                # monitor; this covers finalize → health check → barrier →
+                # reassignment), the headline the paper's restart benchmarks
+                # decompose.
+                with span(
+                    "inprocess", "inprocess.restart", iteration=iteration,
+                    initial_rank=state.initial_rank,
+                ):
+                    new_state = self._restart_transition(
+                        monitor, abort_fn, state, iteration
+                    )
+                if new_state is None:
+                    return None  # stood down: job completed without us
+                state = new_state
             except (RestartAbort, HealthCheckError) as e:
                 log.error(f"rank {state.rank}: leaving restart loop: {e!r}")
                 self._terminate_and_leave(monitor, state)

@@ -38,6 +38,7 @@ from tpu_resiliency.platform import ipc
 from tpu_resiliency.platform.store import StoreView
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.tracing import child_env, span
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
 from tpu_resiliency.watchdog.data import WorkloadAction, WorkloadControlRequest
 from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
@@ -156,6 +157,11 @@ class ElasticAgent:
                 # alternative lets an epoch-less reopened round slip uncharged).
                 if prev_round >= 0 and outcome.round > prev_round:
                     self._restarts_used += outcome.round - prev_round
+                    record_event(
+                        "launcher", "restart_budget", round=outcome.round,
+                        node_id=self.cfg.node_id, used=self._restarts_used,
+                        max=self.cfg.max_restarts,
+                    )
                 prev_round = outcome.round
                 if self._restarts_used > self.cfg.max_restarts:
                     self.rdzv.request_shutdown(
@@ -207,7 +213,13 @@ class ElasticAgent:
         except Exception:
             watcher = None  # accelerator only; polling still covers it
         try:
-            return self._spare_loop(outcome, epoch0)
+            # Standby time is a first-class phase: in the trace it shows how
+            # long warm capacity sat idle before promotion (or job end).
+            with span(
+                "launcher", "launcher.spare_wait",
+                round=outcome.round, node_id=self.cfg.node_id,
+            ):
+                return self._spare_loop(outcome, epoch0)
         finally:
             if watcher is not None:
                 watcher.stop()
@@ -245,6 +257,17 @@ class ElasticAgent:
     # -- active path -------------------------------------------------------
 
     def _run_round(self, outcome: RendezvousOutcome) -> str:
+        # One span per placed round: workers spawned inside inherit it as their
+        # parent (child_env below), so a restart's causal chain — fault →
+        # restart request → next round → respawn — nests under round spans in
+        # the exported trace.
+        with span(
+            "launcher", "launcher.round", round=outcome.round,
+            node_rank=outcome.node_rank, node_id=self.cfg.node_id,
+        ):
+            return self._run_placed_round(outcome)
+
+    def _run_placed_round(self, outcome: RendezvousOutcome) -> str:
         cfg = self.cfg
         node_rank = outcome.node_rank
         world_size = outcome.num_nodes * cfg.nproc_per_node
@@ -268,6 +291,9 @@ class ElasticAgent:
             # the layered in-job + in-process coupling.
             "TPU_RESILIENCY_STORE_EXTERNAL": "1",
             ipc.LAUNCHER_SOCKET_ENV: self._launcher_socket,
+            # Workers' events/spans parent to THIS round's span, not to
+            # whatever the env held when the launcher started.
+            **child_env(),
         }
         group = WorkerGroup(
             argv=cfg.argv,
@@ -278,13 +304,21 @@ class ElasticAgent:
             use_python=cfg.use_python,
             spare_pool=self._spare_pool,
         )
-        self._start_monitors(outcome.round)
-        if self._monitor_sockets:
-            sockets = list(self._monitor_sockets)
-            group.per_rank_env = lambda local: {ipc.MONITOR_SOCKET_ENV: sockets[local]}
         watcher = None
         try:
-            group.start(outcome.round, first_rank, world_size)
+            # The spawn segment is the restart-latency hot path (BENCH_restart
+            # decomposition) — give it its own slice in the trace.
+            with span(
+                "launcher", "worker.spawn",
+                round=outcome.round, nproc=cfg.nproc_per_node,
+            ):
+                self._start_monitors(outcome.round)
+                if self._monitor_sockets:
+                    sockets = list(self._monitor_sockets)
+                    group.per_rank_env = (
+                        lambda local: {ipc.MONITOR_SOCKET_ENV: sockets[local]}
+                    )
+                group.start(outcome.round, first_rank, world_size)
             # A peer's restart request wakes the supervise loop through the
             # same event as a local worker death: multi-node respawn is then
             # notification-bound on every surviving node, not poll-bound.

@@ -33,8 +33,9 @@ from tpu_resiliency.platform.store import (
     KVServer,
     store_answers,
 )
-from tpu_resiliency.utils.events import EVENTS_FILE_ENV
+from tpu_resiliency.utils.events import EVENTS_FILE_ENV, METRICS_FILE_ENV
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.tracing import ensure_trace_id, span
 from tpu_resiliency.watchdog.config import FaultToleranceConfig
 
 log = get_logger(__name__)
@@ -122,6 +123,14 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="JSONL structured-event stream shared by the agent and every worker "
         "(exports $TPU_RESILIENCY_EVENTS_FILE; default: inherit the env var)",
+    )
+    p.add_argument(
+        "--metrics-file",
+        default=None,
+        help="bridge events into per-process metrics JSON snapshots at this "
+        "path, '<pid>' inserted before the extension (exports "
+        "$TPU_RESILIENCY_METRICS_FILE); post-hoc aggregation needs only "
+        "--events-file + tpu-metrics-dump",
     )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
@@ -313,6 +322,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         # One exported variable wires the whole tree: the agent records through it
         # and every spawned worker/monitor inherits it (events.py env sink).
         os.environ[EVENTS_FILE_ENV] = os.path.abspath(args.events_file)
+    if args.metrics_file:
+        os.environ[METRICS_FILE_ENV] = os.path.abspath(args.metrics_file)
+    # Trace identity rides the same single-export pattern: mint here (the root
+    # of the process tree) so every agent/worker/monitor event shares one
+    # trace_id and spans stitch cross-process (tools/trace_export.py).
+    ensure_trace_id()
 
     if args.standalone:
         # Single-node convenience (reference --standalone): private ephemeral
@@ -371,7 +386,10 @@ def main(argv: Optional[list[str]] = None) -> int:
     )
     agent = ElasticAgent(cfg, ft_cfg, store)
     try:
-        exitcodes = agent.run()
+        # The root span of the whole run: every round/rendezvous/worker span
+        # parents (transitively) under it.
+        with span("launcher", "launcher.job", node_id=cfg.node_id):
+            exitcodes = agent.run()
         log.info(f"workload finished: exit codes {exitcodes}")
         return 0
     except WorkersFailed as e:

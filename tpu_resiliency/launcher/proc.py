@@ -273,6 +273,14 @@ class WorkerGroup:
         for w in self.workers:
             if w.exitcode is None:
                 log.warning(f"worker rank {w.global_rank} ignored signal; SIGKILL")
+                # The top rung of the kill ladder — pairs with the monitor's
+                # per-signal ``kill_ladder`` records so the stream shows which
+                # step actually ended a wedged rank.
+                record_event(
+                    "launcher", "kill_ladder", step="SIGKILL",
+                    global_rank=w.global_rank, worker_pid=w.pid,
+                    grace_s=grace,
+                )
                 self._signal_tree(w.pid, signal.SIGKILL)
             else:
                 # Reap stragglers the dead leader left behind in its group.

@@ -21,7 +21,9 @@ from typing import Callable, Optional
 
 from tpu_resiliency.exceptions import FaultToleranceError, StoreError
 from tpu_resiliency.platform.store import CoordStore, StoreView
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
 
@@ -173,7 +175,19 @@ class StoreRendezvous:
         return ok
 
     def next_round(self, prev_round: int = -1) -> RendezvousOutcome:
-        """Block until a round numbered > `prev_round` closes with us placed in it."""
+        """Block until a round numbered > `prev_round` closes with us placed in it.
+
+        The whole wait is one ``rendezvous.round`` span: its duration IS the
+        re-rendezvous segment of restart latency (the p50/p95 that
+        ``tools/metrics_dump.py`` reports), and in the trace it sits between a
+        failed round's end and the next round's spawn."""
+        with span(
+            "rendezvous", "rendezvous.round",
+            prev_round=prev_round, node_id=self.node_id,
+        ):
+            return self._next_round(prev_round)
+
+    def _next_round(self, prev_round: int) -> RendezvousOutcome:
         self.start_keepalive()
         self.store.touch(f"ka/{self.node_id}")
         deadline = time.monotonic() + self.s.join_timeout
@@ -214,7 +228,11 @@ class StoreRendezvous:
                     "expected": prev_known,
                 }
                 min_reached_at = None
-                self._cas(cur, nxt)
+                if self._cas(cur, nxt):
+                    record_event(
+                        "rendezvous", "rendezvous_opened", round=nxt["round"],
+                        node_id=me, expected=prev_known,
+                    )
                 continue
             # Case 2: a closed round newer than what we had.
             if cur["status"] == "closed":
@@ -276,6 +294,11 @@ class StoreRendezvous:
                     min_reached_at = None
                     if self._cas(cur, nxt):
                         log.info(f"[{me}] actives all dead; reopened round {cur['round'] + 1}")
+                        record_event(
+                            "rendezvous", "rendezvous_opened",
+                            round=cur["round"] + 1, node_id=me,
+                            reason="actives all dead",
+                        )
                     continue
                 # Registered and the job is healthy: we are standby redundancy for
                 # this closed round — report as a spare now rather than blocking
@@ -354,6 +377,14 @@ class StoreRendezvous:
                         log.info(
                             f"[{me}] closed rendezvous round {cur['round']}: "
                             f"active={active} spares={spares}"
+                        )
+                        # Leader-only close record: ``waited`` is the
+                        # min-nodes→close hold (last-call + expected-peer
+                        # grace), the tunable part of round-formation latency.
+                        record_event(
+                            "rendezvous", "rendezvous_closed",
+                            round=cur["round"], node_id=me, waited_s=waited,
+                            active=active, spares=spares, full=full,
                         )
                     continue
             # Event-driven: any peer's CAS on the round state wakes us at once

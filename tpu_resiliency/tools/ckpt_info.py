@@ -24,7 +24,7 @@ import sys
 from typing import Optional
 
 from tpu_resiliency.checkpoint.local_manager import _FILE_RE
-from tpu_resiliency.tools import pipe_safe
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
 
 _SESSION_RE = re.compile(r"^s(\d+)$")
 _RANK_RE = re.compile(r"^r(\d+)$")
@@ -72,13 +72,21 @@ def scan(root: str, session: Optional[int] = None) -> list[SessionInfo]:
     files between listing and stat'ing, so every per-entry touch tolerates
     disappearance (the audit then simply reflects the post-prune state)."""
     sessions = []
-    for sname in sorted(os.listdir(root)):
+    try:
+        snames = sorted(os.listdir(root))
+    except OSError:
+        return []  # root itself unlinked mid-audit: post-prune state is "empty"
+    for sname in snames:
         sm = _SESSION_RE.match(sname)
         if not sm or (session is not None and int(sm.group(1)) != session):
             continue
         info = SessionInfo(int(sm.group(1)), set(), {}, {}, [])
         sdir = os.path.join(root, sname)
-        for rname in sorted(os.listdir(sdir)):
+        try:
+            rnames = sorted(os.listdir(sdir))
+        except OSError:
+            continue  # session dir unlinked between the two listings
+        for rname in rnames:
             rm = _RANK_RE.match(rname)
             if not rm:
                 continue
@@ -190,7 +198,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         for info in sessions:
             render(info, world=world)
 
-    pipe_safe(emit)
+    if pipe_safe(emit):
+        return SIGPIPE_EXIT
     return 0
 
 

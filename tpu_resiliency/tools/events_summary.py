@@ -25,7 +25,7 @@ import sys
 from collections import Counter
 from typing import Any, Optional
 
-from tpu_resiliency.tools import pipe_safe
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
 from tpu_resiliency.utils.events import RESERVED_KEYS, read_events
 
 
@@ -161,22 +161,35 @@ def iter_new_records(path: str, poll: float = 0.5, stop=None):
     corrupt the resume position on multi-byte content from non-framework
     producers); torn trailing lines are retried whole on the next poll
     (JSONL writes are single atomic appends, so a partial line only means we
-    raced the writer mid-write). A missing file is the wait state — the
-    launcher may not have started — but any other OSError (directory,
-    permission) propagates: an unrecoverable path must fail visibly, not
-    hang silently. ``stop``: optional ``threading.Event``-like; checked each
-    poll so tests (and signal handlers) can end the loop."""
+    raced the writer mid-write). Replacement detection is ``tail -F``:
+    the file's identity (``st_ino``/``st_dev``) is tracked alongside its
+    size, so a recreated events file from a NEW launcher run restarts the
+    offset at zero even when the new file has already grown past the old
+    offset by the next poll — size-shrink alone would resume mid-file at an
+    arbitrary byte. A missing file is the wait state — the launcher may not
+    have started — but any other OSError (directory, permission)
+    propagates: an unrecoverable path must fail visibly, not hang silently.
+    ``stop``: optional ``threading.Event``-like; checked each poll so tests
+    (and signal handlers) can end the loop."""
     import json
     import time as _time
 
     offset = 0
     buf = b""
+    file_id = None  # (st_ino, st_dev) of the file the offset belongs to
     while stop is None or not stop.is_set():
         try:
             with open(path, "rb") as f:
+                st = os.fstat(f.fileno())
+                if file_id is not None and (st.st_ino, st.st_dev) != file_id:
+                    # Recreated under the same name (a new launcher run):
+                    # the old offset describes a different file entirely.
+                    offset = 0
+                    buf = b""
+                file_id = (st.st_ino, st.st_dev)
                 if f.seek(0, 2) < offset:
-                    # Truncated/recreated (a new launcher run reusing the
-                    # path): restart from the top like tail -f on shrink.
+                    # Truncated in place: restart from the top like tail -f
+                    # on shrink.
                     offset = 0
                     buf = b""
                 f.seek(offset)
@@ -262,7 +275,10 @@ def _follow(path: str, kind: Optional[str]) -> int:
             )
 
     try:
-        pipe_safe(emit)  # `--follow | head` must exit clean like batch mode
+        # `--follow | head` must exit clean like batch mode — but as 141, so a
+        # script can tell the follow was cut short rather than complete.
+        if pipe_safe(emit):
+            return SIGPIPE_EXIT
     except OSError as e:
         print(f"cannot follow events file: {e}", file=sys.stderr)
         return 1
@@ -297,9 +313,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"cannot read events file: {e}", file=sys.stderr)
         return 1
     records = read_events(args.events_file)
-    pipe_safe(
+    if pipe_safe(
         lambda: summarize(records, kind=args.kind, timeline=not args.no_timeline)
-    )
+    ):
+        return SIGPIPE_EXIT
     return 0
 
 

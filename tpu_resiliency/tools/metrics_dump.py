@@ -1,0 +1,171 @@
+"""Aggregate a finished run's events JSONL into metrics.
+
+The post-hoc twin of the live :class:`~tpu_resiliency.utils.metrics.MetricsSink`
+— same kind→metric mapping (``utils/metrics.py:observe_record``), replayed over
+a JSONL file instead of fed per ``record()`` call, so an operator answers "how
+many restarts, p95 rendezvous time, checkpoint save latency" from the artifact
+a run leaves behind, without a scrape pipeline and without replaying raw JSONL
+by hand.
+
+Usage::
+
+    python -m tpu_resiliency.tools.metrics_dump run_events.jsonl            # report
+    python -m tpu_resiliency.tools.metrics_dump run_events.jsonl --format prom
+    python -m tpu_resiliency.tools.metrics_dump run_events.jsonl --format json -o m.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+from typing import Optional
+
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
+from tpu_resiliency.utils.events import read_events
+from tpu_resiliency.utils.metrics import MetricsRegistry, aggregate
+
+
+def _counter_total(reg: MetricsRegistry, name: str) -> float:
+    snap = reg.snapshot()["metrics"].get(name, [])
+    return sum(e.get("value", 0.0) for e in snap)
+
+
+def _fmt_s(v: float) -> str:
+    if math.isnan(v):
+        return "-"
+    return f"{v * 1e3:.1f} ms" if v < 1.0 else f"{v:.2f} s"
+
+
+def _latency_lines(reg: MetricsRegistry, family: str, label: str) -> list[str]:
+    """p50/p95 per labelled series of one histogram family, stably ordered."""
+    out = []
+    for labels, h in sorted(reg.histograms(family).items()):
+        name = dict(labels).get(label, "?")
+        out.append(
+            f"    {name}: n={h.count} p50={_fmt_s(h.quantile(0.5))} "
+            f"p95={_fmt_s(h.quantile(0.95))} max={_fmt_s(h.quantile(1.0))}"
+        )
+    return out
+
+
+def render_report(reg: MetricsRegistry, out=None) -> None:
+    """The operator summary: restarts, rendezvous latency, checkpoint latency."""
+    out = sys.stdout if out is None else out
+    snap = reg.snapshot()["metrics"]
+
+    total = _counter_total(reg, "tpu_events_total")
+    print(f"events: {int(total)}", file=out)
+
+    print("restarts:", file=out)
+    restarts = {
+        dict(e["labels"]).get("layer", "?"): e["value"]
+        for e in snap.get("tpu_restarts_total", [])
+    }
+    print(f"    in-job requested: {int(restarts.get('injob', 0))}", file=out)
+    print(f"    in-process signalled: {int(restarts.get('inprocess', 0))}", file=out)
+    for name, label in (
+        ("tpu_rendezvous_rounds_total", "rendezvous rounds"),
+        ("tpu_worker_failures_total", "worker failures"),
+        ("tpu_spare_promotions_total", "warm-spare promotions"),
+        ("tpu_rank_terminations_total", "rank terminations"),
+        ("tpu_budget_exhausted_total", "budget exhaustions"),
+        ("tpu_ckpt_saves_total", "checkpoint saves"),
+        ("tpu_ckpt_save_failures_total", "checkpoint save failures"),
+    ):
+        n = _counter_total(reg, name)
+        if n:
+            print(f"    {label}: {int(n)}", file=out)
+
+    span_lines = _latency_lines(reg, "tpu_span_seconds", "span")
+    if span_lines:
+        print("span durations (p50/p95):", file=out)
+        for line in span_lines:
+            print(line, file=out)
+    timing_lines = _latency_lines(reg, "tpu_timing_seconds", "name")
+    if timing_lines:
+        print("timed blocks (p50/p95):", file=out)
+        for line in timing_lines:
+            print(line, file=out)
+
+    # The two headline latencies, called out by name so a fleet dashboard's
+    # first question needs no knowledge of span naming conventions.
+    rdzv = reg.histograms("tpu_span_seconds").get((("span", "rendezvous.round"),))
+    if rdzv is not None and rdzv.count:
+        print(
+            f"rendezvous round duration: n={rdzv.count} "
+            f"p50={_fmt_s(rdzv.quantile(0.5))} p95={_fmt_s(rdzv.quantile(0.95))}",
+            file=out,
+        )
+    ckpt = [
+        (dict(labels)["name"], h)
+        for labels, h in reg.histograms("tpu_timing_seconds").items()
+        if dict(labels).get("name", "").startswith("ckpt.") and h.count
+    ]
+    if ckpt:
+        worst = {name: h.quantile(0.95) for name, h in ckpt}
+        total_p50 = sum(h.quantile(0.5) for _, h in ckpt)
+        print(
+            f"checkpoint save/load latency: phases={sorted(worst)} "
+            f"sum(p50)={_fmt_s(total_p50)}",
+            file=out,
+        )
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Aggregate a tpu-resiliency events JSONL file into metrics"
+    )
+    ap.add_argument("events_file")
+    ap.add_argument(
+        "--format", choices=("report", "prom", "json"), default="report",
+        help="report: human summary (default); prom: Prometheus text "
+        "exposition; json: quantile snapshot",
+    )
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="write here instead of stdout (json format: atomic write)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.events_file):
+            pass
+    except OSError as e:
+        print(f"cannot read events file: {e}", file=sys.stderr)
+        return 1
+    records = read_events(args.events_file)
+    if not records:
+        print("no events to aggregate", file=sys.stderr)
+        return 1
+    reg = aggregate(records)
+    if args.format == "json" and args.output:
+        reg.write_json(args.output)
+        print(f"wrote {args.output}")
+        return 0
+
+    def emit() -> None:
+        if args.format == "prom":
+            sys.stdout.write(reg.to_prometheus())
+        elif args.format == "json":
+            json.dump(reg.snapshot(), sys.stdout, indent=2, default=repr)
+            sys.stdout.write("\n")
+        else:
+            render_report(reg)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            old, sys.stdout = sys.stdout, f
+            try:
+                emit()
+            finally:
+                sys.stdout = old
+        print(f"wrote {args.output}")
+        return 0
+    if pipe_safe(emit):
+        return SIGPIPE_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,7 +31,7 @@ from typing import Optional
 
 from tpu_resiliency.exceptions import StoreError
 from tpu_resiliency.platform.store import AUTH_KEY_ENV, KVClient
-from tpu_resiliency.tools import pipe_safe
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
 
 
 def report(client: KVClient, prefix: str, stale_prefix: Optional[str],
@@ -112,9 +112,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     try:
-        pipe_safe(
+        if pipe_safe(
             lambda: report(client, args.prefix, args.stale, args.max_age)
-        )
+        ):
+            return SIGPIPE_EXIT
     except (OSError, StoreError) as e:
         print(f"store at {args.endpoint} failed mid-report: {e}", file=sys.stderr)
         return 1

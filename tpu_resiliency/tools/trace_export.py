@@ -1,0 +1,183 @@
+"""Events JSONL → Chrome trace-event JSON, viewable in Perfetto.
+
+The structured event stream (``utils/events.py`` + ``utils/tracing.py``) already
+carries everything a causal view needs — span begin/end pairs with ids, process
+identity, wall-clock timestamps. This tool is the renderer: it converts one
+run's JSONL file into the Chrome trace-event format that ``ui.perfetto.dev``
+(or ``chrome://tracing``) loads directly, so "what actually happened during
+that restart" becomes a picture — the launcher's round span, the rendezvous
+wait inside it, each worker's iteration/barrier spans beneath, and every plain
+event as an instant marker on the row it belongs to.
+
+Mapping:
+
+- matched ``span_begin``/``span_end`` (same envelope ``span_id``) → one
+  complete ``"X"`` slice with the begin payload + duration as args;
+- unmatched ``span_begin`` (process died mid-span — exactly the interesting
+  case) → an ``"X"`` slice running to the last event's timestamp, flagged
+  ``unfinished``;
+- every other record → an instant ``"i"`` marker;
+- per-pid ``"M"`` metadata rows naming each process by its dominant source.
+
+Usage::
+
+    python -m tpu_resiliency.tools.trace_export run_events.jsonl -o run.trace.json
+    python -m tpu_resiliency.tools.trace_export run_events.jsonl   # stdout
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter
+from typing import Optional
+
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
+from tpu_resiliency.utils.events import RESERVED_KEYS, read_events
+
+
+def _payload(rec: dict) -> dict:
+    return {k: v for k, v in rec.items() if k not in RESERVED_KEYS}
+
+
+def _tid(rec: dict) -> int:
+    # One row per rank inside a process; rank-less records (launcher, monitors)
+    # share row 0 of their pid.
+    rank = rec.get("rank")
+    return rank if isinstance(rank, int) else 0
+
+
+def to_chrome_trace(records: list[dict]) -> dict:
+    """Convert parsed event records to a Chrome trace-event document."""
+    records = [
+        r for r in records
+        if isinstance(r.get("ts"), (int, float)) and isinstance(r.get("kind"), str)
+    ]
+    records.sort(key=lambda r: r["ts"])
+    if not records:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = records[0]["ts"]
+    t_last = records[-1]["ts"]
+
+    def us(ts: float) -> float:
+        return (ts - t0) * 1e6
+
+    events: list[dict] = []
+    #: (pid, span_id) -> begin record; span ids are unique per span but scoping
+    #: by pid keeps a forked child that inherited its parent's stack harmless.
+    open_spans: dict[tuple, dict] = {}
+    source_by_pid: Counter = Counter()
+
+    for rec in records:
+        pid = rec.get("pid", 0)
+        source_by_pid[(pid, rec.get("source", "?"))] += 1
+        kind = rec["kind"]
+        p = _payload(rec)
+        if kind == "span_begin" and rec.get("span_id"):
+            open_spans[(pid, rec["span_id"])] = rec
+            continue
+        if kind == "span_end" and rec.get("span_id"):
+            begin = open_spans.pop((pid, rec["span_id"]), None)
+            if begin is None:
+                # End without begin (stream truncated at the head): degrade to
+                # an instant so the error/duration survives in the view.
+                events.append({
+                    "name": str(p.get("span", "span")), "cat": rec.get("source", "?"),
+                    "ph": "i", "s": "t", "ts": us(rec["ts"]),
+                    "pid": pid, "tid": _tid(rec), "args": p,
+                })
+                continue
+            bp = _payload(begin)
+            args = {**bp, **p, "span_id": rec["span_id"]}
+            args.pop("span", None)
+            events.append({
+                "name": str(bp.get("span", "span")),
+                "cat": begin.get("source", "?"),
+                "ph": "X",
+                "ts": us(begin["ts"]),
+                "dur": max(0.0, us(rec["ts"]) - us(begin["ts"])),
+                "pid": pid,
+                "tid": _tid(begin),
+                "args": args,
+            })
+            continue
+        # Plain event → instant marker, thread-scoped.
+        events.append({
+            "name": kind, "cat": rec.get("source", "?"),
+            "ph": "i", "s": "t", "ts": us(rec["ts"]),
+            "pid": pid, "tid": _tid(rec),
+            "args": {k: v for k, v in p.items()},
+        })
+
+    # A span the process never closed (it crashed inside — the signal an
+    # operator is usually hunting) renders as a slice to end-of-stream.
+    for (pid, sid), begin in open_spans.items():
+        bp = _payload(begin)
+        args = {**bp, "span_id": sid, "unfinished": True}
+        args.pop("span", None)
+        events.append({
+            "name": str(bp.get("span", "span")), "cat": begin.get("source", "?"),
+            "ph": "X", "ts": us(begin["ts"]),
+            "dur": max(0.0, us(t_last) - us(begin["ts"])),
+            "pid": pid, "tid": _tid(begin), "args": args,
+        })
+
+    # Name each pid row by its dominant event source (launcher/worker/monitor).
+    dominant: dict[int, tuple[str, int]] = {}
+    for (pid, source), n in source_by_pid.items():
+        if pid not in dominant or n > dominant[pid][1]:
+            dominant[pid] = (source, n)
+    for pid, (source, _) in sorted(dominant.items()):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{source} (pid {pid})"},
+        })
+
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Convert a tpu-resiliency events JSONL file to Chrome "
+        "trace-event JSON (load in ui.perfetto.dev)"
+    )
+    ap.add_argument("events_file")
+    ap.add_argument(
+        "-o", "--output", default=None,
+        help="write the trace here (default: stdout)",
+    )
+    ap.add_argument(
+        "--indent", type=int, default=None,
+        help="pretty-print with this indent (default: compact)",
+    )
+    args = ap.parse_args(argv)
+    # read_events tolerates unreadable files (shared-stream semantics); a CLI
+    # invocation on a missing/denied path must fail visibly instead.
+    try:
+        with open(args.events_file):
+            pass
+    except OSError as e:
+        print(f"cannot read events file: {e}", file=sys.stderr)
+        return 1
+    trace = to_chrome_trace(read_events(args.events_file))
+    if not trace["traceEvents"]:
+        print("no events to export", file=sys.stderr)
+        return 1
+    doc = json.dumps(trace, indent=args.indent, default=repr)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(doc + "\n")
+        n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        print(
+            f"wrote {args.output}: {len(trace['traceEvents'])} trace events "
+            f"({n_spans} spans) — load in ui.perfetto.dev"
+        )
+        return 0
+    if pipe_safe(lambda: print(doc)):
+        return SIGPIPE_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

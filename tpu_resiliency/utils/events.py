@@ -8,10 +8,29 @@ happened; this stream tells machines: every rendezvous round, restart, fault
 detection, checkpoint save, and degraded-set transition is one self-describing
 record.
 
+Instrumented as built (the canonical emitter set — one record per decision):
+
+- ``launcher``: rendezvous rounds, worker failures/promotions, restart requests,
+  restart-budget charges, round success, control requests, budget exhaustion,
+  SIGKILL escalation, ``launcher.job``/``launcher.round``/``worker.spawn`` spans.
+- ``rendezvous``: round open/reopen/close records and the ``rendezvous.round``
+  wait span.
+- ``watchdog``: hang/health terminations, kill-ladder steps, per-rank heartbeat
+  statistics on disconnect.
+- ``inprocess``: iteration starts, restart signals, fn exceptions, rank
+  terminations, stand-downs, completion, plus ``inprocess.restart`` and barrier
+  spans.
+- ``checkpoint``: save/load phase timings (d2h, serialize, replicate, write),
+  ``ckpt_saved``/``ckpt_save_incomplete`` with byte counts, group rebuilds,
+  async-save scheduling.
+- ``ft``/``straggler``/``preemption`` (integrations): timeout calibrations,
+  straggler reports, preemption sync points, training-finished markers.
+
 Design:
 
 - :class:`Event`: ``(ts, source, kind, payload)`` plus process identity (pid, rank
-  when known) — everything JSON-serializable.
+  when known) and, when tracing is active, ``trace_id``/``span_id`` causal context
+  (``utils/tracing.py``) — everything JSON-serializable.
 - Pluggable sinks registered per process (``add_sink``); the default wiring is
   environment-driven: ``TPU_RESILIENCY_EVENTS_FILE=<path>`` attaches a JSONL sink,
   so a launcher enables one stream for itself and every worker it spawns by
@@ -22,6 +41,9 @@ Design:
   breaks the workload (events are observability, not control flow).
 - ``@prof``: times a callable and records a ``timing`` event with success/failure,
   the reference's ``@prof`` metric decorator.
+- Consumers: ``tools/events_summary.py`` (timeline), ``tools/trace_export.py``
+  (Chrome/Perfetto trace), ``tools/metrics_dump.py`` + ``utils/metrics.py``
+  (aggregation); see ``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -38,11 +60,17 @@ from tpu_resiliency.utils.logging import get_logger
 log = get_logger(__name__)
 
 EVENTS_FILE_ENV = "TPU_RESILIENCY_EVENTS_FILE"
+#: Set to a path to ALSO bridge every record into the metrics registry and
+#: snapshot it as JSON (``utils/metrics.py``); ``<pid>`` is inserted before the
+#: extension so each process of a node drops its own snapshot (no clobbering).
+METRICS_FILE_ENV = "TPU_RESILIENCY_METRICS_FILE"
 
 #: Envelope keys every JSONL record carries; payload keys that collide are
-#: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary) use this to
-#: split envelope from payload — one schema, one place.
-RESERVED_KEYS = ("ts", "source", "kind", "pid", "rank")
+#: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary, trace_export)
+#: use this to split envelope from payload — one schema, one place.
+#: ``trace_id``/``span_id`` are envelope members too (omitted when tracing is
+#: inactive) so a payload key of the same name can never forge causal context.
+RESERVED_KEYS = ("ts", "source", "kind", "pid", "rank", "trace_id", "span_id")
 
 
 @dataclasses.dataclass
@@ -53,15 +81,27 @@ class Event:
     payload: dict
     pid: int = dataclasses.field(default_factory=os.getpid)
     rank: Optional[int] = None
+    #: causal context (``utils/tracing.py``): the run's trace id and the span
+    #: active when this event was recorded — None outside any trace
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
     def to_json(self) -> str:
+        env = {
+            "ts": self.ts,
+            "source": self.source,
+            "kind": self.kind,
+            "pid": self.pid,
+            "rank": self.rank,
+        }
+        # Lean lines: untraced processes pay zero bytes for the trace fields.
+        if self.trace_id is not None:
+            env["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            env["span_id"] = self.span_id
         return json.dumps(
             {
-                "ts": self.ts,
-                "source": self.source,
-                "kind": self.kind,
-                "pid": self.pid,
-                "rank": self.rank,
+                **env,
                 **{f"p_{k}" if k in RESERVED_KEYS else k: v
                    for k, v in self.payload.items()},
             },
@@ -103,6 +143,34 @@ _sinks: list[Callable[[Event], None]] = []
 _sinks_lock = threading.Lock()
 _env_wired_for: Optional[str] = None
 
+#: ``() -> (trace_id, span_id)`` supplier consulted by ``record``. The default
+#: reads the tracing env vars directly so a process that never imports
+#: ``utils/tracing`` still stamps inherited context onto its events;
+#: ``utils/tracing`` swaps in its thread-local-aware provider on import.
+#: (A hook, not an import: events must stay the dependency root.)
+TRACE_ID_ENV = "TPU_RESILIENCY_TRACE_ID"
+PARENT_SPAN_ENV = "TPU_RESILIENCY_PARENT_SPAN"
+
+
+def _env_trace_context() -> tuple[Optional[str], Optional[str]]:
+    return (
+        os.environ.get(TRACE_ID_ENV) or None,
+        os.environ.get(PARENT_SPAN_ENV) or None,
+    )
+
+
+_context_provider: Callable[[], tuple[Optional[str], Optional[str]]] = (
+    _env_trace_context
+)
+
+
+def set_context_provider(
+    fn: Callable[[], tuple[Optional[str], Optional[str]]]
+) -> None:
+    """Install the ``(trace_id, span_id)`` supplier stamped onto every event."""
+    global _context_provider
+    _context_provider = fn
+
 
 def add_sink(sink: Callable[[Event], None]) -> None:
     with _sinks_lock:
@@ -120,27 +188,46 @@ def remove_sink(sink: Callable[[Event], None]) -> None:
 def clear_sinks() -> None:
     with _sinks_lock:
         _sinks.clear()
-    global _env_wired_for
+    global _env_wired_for, _metrics_wired_for
     _env_wired_for = None
+    _metrics_wired_for = None
+
+
+_metrics_wired_for: Optional[str] = None
 
 
 def _wire_env_sink() -> None:
-    """Attach (once per path) the JSONL sink named by $TPU_RESILIENCY_EVENTS_FILE.
+    """Attach (once per path) the JSONL sink named by $TPU_RESILIENCY_EVENTS_FILE
+    and the metrics bridge named by $TPU_RESILIENCY_METRICS_FILE.
     Re-checked on every record so a launcher exporting the variable after import
     still takes effect, and forked/spawned children wire themselves lazily."""
-    global _env_wired_for
+    global _env_wired_for, _metrics_wired_for
     path = os.environ.get(EVENTS_FILE_ENV)
-    if not path or path == _env_wired_for:
-        return
-    with _sinks_lock:
-        if _env_wired_for == path:
-            return
-        try:
-            _sinks.append(JsonlSink(path))
-            _env_wired_for = path
-        except OSError as e:
-            log.warning(f"cannot open events file {path!r}: {e}")
-            _env_wired_for = path  # don't retry every event
+    if path and path != _env_wired_for:
+        with _sinks_lock:
+            if _env_wired_for != path:
+                try:
+                    _sinks.append(JsonlSink(path))
+                    _env_wired_for = path
+                except OSError as e:
+                    log.warning(f"cannot open events file {path!r}: {e}")
+                    _env_wired_for = path  # don't retry every event
+    mpath = os.environ.get(METRICS_FILE_ENV)
+    if mpath and mpath != _metrics_wired_for:
+        with _sinks_lock:
+            if _metrics_wired_for != mpath:
+                try:
+                    # Lazy import: events is the dependency root; metrics
+                    # imports events, never the reverse at module load.
+                    from tpu_resiliency.utils.metrics import MetricsSink
+
+                    base, ext = os.path.splitext(mpath)
+                    _sinks.append(
+                        MetricsSink(json_path=f"{base}.{os.getpid()}{ext or '.json'}")
+                    )
+                except Exception as e:
+                    log.warning(f"cannot wire metrics snapshots to {mpath!r}: {e}")
+                _metrics_wired_for = mpath
 
 
 def record(source: str, kind: str, **payload: Any) -> None:
@@ -151,12 +238,18 @@ def record(source: str, kind: str, **payload: Any) -> None:
     if not sinks:
         return
     rank_s = os.environ.get("RANK")
+    try:
+        trace_id, span_id = _context_provider()
+    except Exception:
+        trace_id = span_id = None  # context is decoration, never control flow
     ev = Event(
         ts=time.time(),
         source=source,
         kind=kind,
         payload=payload,
         rank=int(rank_s) if rank_s and rank_s.isdigit() else None,
+        trace_id=trace_id,
+        span_id=span_id,
     )
     for sink in sinks:
         try:

@@ -1,0 +1,453 @@
+"""Thread-safe metrics registry with Prometheus exposition + events bridge.
+
+The reference NVRx emits torchelastic-style structured events and ``@prof``
+timings but ships no aggregation — its own tests grep log lines. This module is
+the missing operator surface: Counter / Gauge / Histogram primitives behind a
+registry, rendered either as Prometheus text exposition (scrapeable from a
+sidecar) or as a JSON snapshot file, and fed from the structured event stream
+two ways:
+
+- **live**: :class:`MetricsSink` is an ``events.add_sink`` sink — one
+  ``record()`` call feeds both the JSONL stream and the registry;
+- **post-hoc**: :func:`aggregate` replays a finished run's JSONL into a fresh
+  registry (``tools/metrics_dump.py``), so "how many restarts, p95 rendezvous
+  time, checkpoint save latency" never again means replaying raw JSONL by hand.
+
+Both paths share one kind→metric mapping (:func:`observe_record`): the live
+sink converts each :class:`~tpu_resiliency.utils.events.Event` to the same flat
+record shape the JSONL file holds and routes it through the identical code.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+import random
+import re
+import threading
+import time
+from typing import Any, Iterable, Optional
+
+from tpu_resiliency.utils.events import RESERVED_KEYS
+
+#: Prometheus histogram bucket upper bounds (seconds) tuned for restart
+#: machinery: sub-ms store ops up through multi-minute rendezvous holds.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+#: Sample reservoir cap per histogram: quantiles stay exact until a series
+#: outgrows this, then degrade to uniform reservoir sampling (bounded RSS on a
+#: multi-day run; the Prometheus buckets are exact regardless).
+RESERVOIR_SIZE = 8192
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(name: str) -> str:
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Prometheus-style cumulative-bucket histogram + bounded sample reservoir.
+
+    Buckets give exact exposition-format counts; the reservoir gives quantiles
+    (exact below :data:`RESERVOIR_SIZE` observations, sampled beyond — the
+    sampler is seeded so aggregating the same JSONL twice answers the same).
+    """
+
+    def __init__(self, buckets: Optional[Iterable[float]] = None) -> None:
+        self._lock = threading.Lock()
+        self.bounds = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        self.bucket_counts = [0] * (len(self.bounds) + 1)  # +Inf tail
+        self.count = 0
+        self.sum = 0.0
+        self._samples: list[float] = []
+        self._rng = random.Random(0x5EED)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        if math.isnan(v):
+            return
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.bucket_counts[bisect.bisect_left(self.bounds, v)] += 1
+            if len(self._samples) < RESERVOIR_SIZE:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < RESERVOIR_SIZE:
+                    self._samples[j] = v
+
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile over the reservoir; NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        with self._lock:
+            if not self._samples:
+                return float("nan")
+            ordered = sorted(self._samples)
+        idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
+        return ordered[idx]
+
+
+class MetricsRegistry:
+    """Name+labels → metric instance; the creation call is the lookup call.
+
+    ``registry.counter("tpu_restarts_total", layer="injob").inc()`` creates the
+    series on first use and returns the existing instance after — callers never
+    pre-declare. A name is bound to one type and one label-key set for the
+    registry's lifetime (Prometheus exposition requires it).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: name -> (kind, help)
+        self._families: dict[str, tuple[str, str]] = {}
+        #: (name, labels_tuple) -> metric
+        self._series: dict[tuple, Any] = {}
+
+    def _get(self, kind: str, ctor, name: str, help: str, labels: dict):
+        name = _sanitize(name)
+        key = (name, tuple(sorted(
+            (_LABEL_BAD.sub("_", k), str(v)) for k, v in labels.items()
+        )))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                self._families[name] = (kind, help)
+            elif fam[0] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam[0]}, not {kind}"
+                )
+            m = self._series.get(key)
+            if m is None:
+                m = self._series[key] = ctor()
+            return m
+
+    # Positional-only metric/help/buckets params: the label namespace is open
+    # (``name=...``, ``help=...`` are legitimate label keys).
+    def counter(self, name: str, help: str = "", /, **labels) -> Counter:
+        return self._get("counter", Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", /, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, help, labels)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Optional[Iterable[float]] = None, /, **labels,
+    ) -> Histogram:
+        return self._get(
+            "histogram", lambda: Histogram(buckets), name, help, labels
+        )
+
+    def histograms(self, name: str) -> dict[tuple, Histogram]:
+        """Every series of histogram family ``name`` keyed by its label tuple."""
+        name = _sanitize(name)
+        with self._lock:
+            return {
+                k[1]: m for k, m in self._series.items() if k[0] == name
+                and isinstance(m, Histogram)
+            }
+
+    # -- rendering ---------------------------------------------------------
+
+    @staticmethod
+    def _label_str(labels: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{v}"' for k, v in labels]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    @staticmethod
+    def _fmt(v: float) -> str:
+        if v == math.inf:
+            return "+Inf"
+        if float(v).is_integer():
+            return str(int(v))
+        return repr(float(v))
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        with self._lock:
+            families = dict(self._families)
+            series = dict(self._series)
+        lines: list[str] = []
+        for name in sorted(families):
+            kind, help = families[name]
+            if help:
+                lines.append(f"# HELP {name} {help}")
+            lines.append(f"# TYPE {name} {kind}")
+            for (sname, labels), m in sorted(series.items()):
+                if sname != name:
+                    continue
+                if isinstance(m, (Counter, Gauge)):
+                    lines.append(
+                        f"{name}{self._label_str(labels)} {self._fmt(m.value)}"
+                    )
+                else:
+                    cum = 0
+                    for bound, n in zip(m.bounds, m.bucket_counts):
+                        cum += n
+                        le = self._label_str(labels, f'le="{self._fmt(bound)}"')
+                        lines.append(f"{name}_bucket{le} {cum}")
+                    le = self._label_str(labels, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{le} {m.count}")
+                    lines.append(
+                        f"{name}_sum{self._label_str(labels)} {self._fmt(m.sum)}"
+                    )
+                    lines.append(
+                        f"{name}_count{self._label_str(labels)} {m.count}"
+                    )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def snapshot(self) -> dict:
+        """JSON-serializable state: counters/gauges by series, histograms with
+        count/sum/quantiles (the operator's one-call answer, no PromQL needed)."""
+        with self._lock:
+            families = dict(self._families)
+            series = dict(self._series)
+        out: dict = {"ts": time.time(), "metrics": {}}
+        for (name, labels), m in sorted(series.items()):
+            kind, help = families[name]
+            entry: dict = {"type": kind, "labels": dict(labels)}
+            if isinstance(m, (Counter, Gauge)):
+                entry["value"] = m.value
+            else:
+                entry.update(
+                    count=m.count,
+                    sum=m.sum,
+                    p50=m.quantile(0.50),
+                    p90=m.quantile(0.90),
+                    p95=m.quantile(0.95),
+                    p99=m.quantile(0.99),
+                )
+            out["metrics"].setdefault(name, []).append(entry)
+        return out
+
+    def write_json(self, path: str) -> None:
+        """Atomic snapshot-to-file (tmp + rename): a scraper reading the path
+        mid-write never sees a torn document."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.snapshot(), f, indent=2, default=repr)
+            f.write("\n")
+        os.replace(tmp, path)
+
+
+_default_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (what :class:`MetricsSink` feeds)."""
+    return _default_registry
+
+
+# -- events → metrics bridge ------------------------------------------------
+
+def observe_record(rec: dict, reg: MetricsRegistry) -> None:
+    """Route one event record (JSONL dict or flattened Event) into metrics.
+
+    The single kind→metric mapping shared by the live sink and the post-hoc
+    aggregator; unknown kinds still land in ``tpu_events_total`` so a new
+    emitter is visible before this table learns its name.
+    """
+    kind = rec.get("kind")
+    if not isinstance(kind, str):
+        return
+    reg.counter("tpu_events_total", "structured events by kind", kind=kind).inc()
+    if kind == "rendezvous_round":
+        reg.counter(
+            "tpu_rendezvous_rounds_total", "rendezvous rounds entered"
+        ).inc()
+        if isinstance(rec.get("world_size"), (int, float)):
+            reg.gauge("tpu_world_size", "last observed world size").set(
+                rec["world_size"]
+            )
+        if isinstance(rec.get("round"), (int, float)):
+            reg.gauge("tpu_rendezvous_round", "last rendezvous round").set(
+                rec["round"]
+            )
+    elif kind == "restart_requested":
+        reg.counter(
+            "tpu_restarts_total", "restart rounds by layer", layer="injob"
+        ).inc()
+    elif kind == "restart_signalled":
+        reg.counter(
+            "tpu_restarts_total", "restart rounds by layer", layer="inprocess"
+        ).inc()
+    elif kind == "restart_budget":
+        if isinstance(rec.get("used"), (int, float)):
+            reg.gauge(
+                "tpu_restart_budget_used", "restart budget consumed"
+            ).set(rec["used"])
+    elif kind == "worker_failed":
+        reg.counter("tpu_worker_failures_total", "worker process failures").inc()
+    elif kind == "worker_promoted":
+        reg.counter(
+            "tpu_spare_promotions_total", "warm-spare promotions"
+        ).inc()
+    elif kind in ("hang_detected", "health_terminated"):
+        reg.counter(
+            "tpu_rank_terminations_total", "monitor-initiated terminations",
+            cause="hang" if kind == "hang_detected" else "health",
+        ).inc()
+    elif kind == "kill_ladder":
+        reg.counter(
+            "tpu_kill_ladder_total", "termination signals by step",
+            step=str(rec.get("step", "?")),
+        ).inc()
+    elif kind == "budget_exhausted":
+        reg.counter(
+            "tpu_budget_exhausted_total", "restart budget exhaustions"
+        ).inc()
+    elif kind == "ckpt_saved":
+        reg.counter("tpu_ckpt_saves_total", "durable checkpoint saves").inc()
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.histogram(
+                "tpu_ckpt_bytes", "checkpoint bytes per save",
+                (2**10, 2**16, 2**20, 2**24, 2**27, 2**30, 2**33, 2**36),
+            ).observe(rec["bytes"])
+    elif kind == "ckpt_save_incomplete":
+        reg.counter(
+            "tpu_ckpt_save_failures_total", "coverage-failed checkpoint saves"
+        ).inc()
+    elif kind == "heartbeat_stats":
+        if isinstance(rec.get("max_gap_s"), (int, float)):
+            reg.histogram(
+                "tpu_heartbeat_gap_seconds", "per-session max heartbeat gap"
+            ).observe(rec["max_gap_s"])
+    elif kind == "timing":
+        d = rec.get("duration_s")
+        if isinstance(d, (int, float)):
+            reg.histogram(
+                "tpu_timing_seconds", "@prof / debug_time durations",
+                name=str(rec.get("name", "?")),
+            ).observe(d)
+        if rec.get("ok") is False:
+            reg.counter(
+                "tpu_timing_failures_total", "timed blocks that raised",
+                name=str(rec.get("name", "?")),
+            ).inc()
+    elif kind == "span_end":
+        d = rec.get("duration_s")
+        if isinstance(d, (int, float)):
+            reg.histogram(
+                "tpu_span_seconds", "span durations by name",
+                span=str(rec.get("span", "?")),
+            ).observe(d)
+        if rec.get("ok") is False:
+            reg.counter(
+                "tpu_span_failures_total", "spans that raised",
+                span=str(rec.get("span", "?")),
+            ).inc()
+
+
+def aggregate(
+    records: Iterable[dict], reg: Optional[MetricsRegistry] = None
+) -> MetricsRegistry:
+    """Replay a finished run's records into a (fresh by default) registry."""
+    reg = MetricsRegistry() if reg is None else reg
+    for rec in records:
+        if isinstance(rec, dict):
+            observe_record(rec, reg)
+    return reg
+
+
+class MetricsSink:
+    """``events.add_sink`` bridge: one ``record()`` call feeds both streams.
+
+    Optionally snapshots the registry to ``json_path`` at most every
+    ``snapshot_interval`` seconds (piggybacked on event arrivals — no extra
+    thread to leak into forked workers) plus once at interpreter exit, so the
+    file always reflects the process's final state.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        json_path: Optional[str] = None,
+        snapshot_interval: float = 10.0,
+    ):
+        self.registry = registry if registry is not None else get_registry()
+        self.json_path = json_path
+        self.snapshot_interval = snapshot_interval
+        self._last_snapshot = 0.0
+        if json_path is not None:
+            import atexit
+
+            atexit.register(self._final_snapshot)
+
+    def _final_snapshot(self) -> None:
+        try:
+            self.registry.write_json(self.json_path)
+        except Exception:
+            pass  # observability, not control flow
+
+    def __call__(self, event) -> None:
+        # Same flat shape as the JSONL line (including the p_-rename of payload
+        # keys that collide with the envelope), minus the json round-trip.
+        rec = {
+            "ts": event.ts, "source": event.source, "kind": event.kind,
+            "pid": event.pid, "rank": event.rank,
+            **{f"p_{k}" if k in RESERVED_KEYS else k: v
+               for k, v in event.payload.items()},
+        }
+        observe_record(rec, self.registry)
+        if self.json_path is not None:
+            now = time.monotonic()
+            if now - self._last_snapshot >= self.snapshot_interval:
+                self._last_snapshot = now
+                self.registry.write_json(self.json_path)

@@ -54,6 +54,10 @@ class _RankSession:
     open_sections: dict = dataclasses.field(default_factory=dict)  # name -> open ts
     last_section_activity: Optional[float] = None
     terminated: bool = False
+    #: heartbeat statistics for the disconnect-time ``heartbeat_stats`` record:
+    #: observed gap distribution is what calibrated timeouts are judged against
+    hb_count: int = 0
+    max_hb_gap: float = 0.0
 
 
 class RankMonitorServer:
@@ -166,9 +170,21 @@ class RankMonitorServer:
                 await framing.write_obj_stream(writer, reply)
         finally:
             if self.session is not None:
+                s = self.session
                 self.log.info(
-                    f"rank {self.session.info.global_rank} disconnected from monitor"
+                    f"rank {s.info.global_rank} disconnected from monitor"
                 )
+                if s.hb_count:
+                    # One summary record per monitored session, not one per
+                    # heartbeat: the max gap is the margin-to-timeout an
+                    # operator tunes ``rank_heartbeat_timeout`` against.
+                    record_event(
+                        "watchdog", "heartbeat_stats",
+                        global_rank=s.info.global_rank,
+                        heartbeats=s.hb_count,
+                        max_gap_s=round(s.max_hb_gap, 6),
+                        timeout_s=self.hb_timeouts.subsequent,
+                    )
             writer.close()
 
     def _dispatch(self, msg):
@@ -206,7 +222,12 @@ class RankMonitorServer:
     def _on_heartbeat(self, msg: HeartbeatMsg):
         if self.session is None:
             return ErrorMsg("heartbeat before init")
-        self.session.last_hb = time.monotonic()
+        s = self.session
+        now = time.monotonic()
+        if s.last_hb is not None:
+            s.max_hb_gap = max(s.max_hb_gap, now - s.last_hb)
+        s.hb_count += 1
+        s.last_hb = now
         return OkMsg()
 
     def _on_section(self, msg: SectionMsg):
@@ -271,12 +292,16 @@ class RankMonitorServer:
                     continue
                 now = time.monotonic()
                 cause = "hang"
-                reason = self._hb_timeout_elapsed(now) or self._section_timeout_elapsed(now)
+                via = "heartbeat"
+                reason = self._hb_timeout_elapsed(now)
+                if reason is None:
+                    reason = self._section_timeout_elapsed(now)
+                    via = "section"
                 if reason is None and self._health_failure is not None:
                     reason = f"health check failed: {self._health_failure}"
-                    cause = "health"
+                    cause, via = "health", "health"
                 if reason is not None:
-                    self._terminate_rank(reason, cause)
+                    self._terminate_rank(reason, cause, via)
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -287,26 +312,44 @@ class RankMonitorServer:
     def _on_health_failure(self, check: HealthCheck) -> None:
         self._health_failure = check.describe()
 
-    def _terminate_rank(self, reason: str, cause: str = "hang") -> None:
+    def _terminate_rank(self, reason: str, cause: str = "hang", via: str = "?") -> None:
         s = self.session
         s.terminated = True
         # Distinct kinds: hang (heartbeat/section timeout) vs health (device/node
-        # check failure) — consumers triage the two very differently.
+        # check failure) — consumers triage the two very differently. ``via``
+        # splits the hang kind further (heartbeat gap vs section timeout).
         record_event(
             "watchdog",
             "hang_detected" if cause == "hang" else "health_terminated",
             global_rank=s.info.global_rank,
-            pid=s.info.pid, reason=reason,
+            pid=s.info.pid, reason=reason, via=via,
         )
         self.restarter.handling_start(f"reason={reason!r}")
         self.log.error(f"terminating rank {s.info.global_rank} (pid {s.info.pid}): {reason}")
         self.restarter.handling_processing()
         try:
+            # Each rung of the kill ladder is its own record: the step that
+            # actually ended the rank (this signal, or the launcher's later
+            # SIGKILL escalation) is reconstructable from the stream.
             os.kill(s.info.pid, signal.SIGCONT)  # wake a stopped process first
-            os.kill(s.info.pid, self.cfg.rank_termination_signal)
+            self._record_kill("SIGCONT", s)
+            term = self.cfg.rank_termination_signal
+            os.kill(s.info.pid, term)
+            try:
+                term_name = signal.Signals(term).name
+            except ValueError:
+                term_name = str(term)
+            self._record_kill(term_name, s)
         except ProcessLookupError:
             self.log.info("rank process already gone")
         self.restarter.handling_completed()
+
+    @staticmethod
+    def _record_kill(step: str, s: _RankSession) -> None:
+        record_event(
+            "watchdog", "kill_ladder", step=step,
+            global_rank=s.info.global_rank, pid=s.info.pid,
+        )
 
     def request_stop(self) -> None:
         if self._stop_event is not None:
