@@ -1,0 +1,191 @@
+"""Benchmark clique replication: pickled-blob (v1) vs streaming bulk (v2) path.
+
+Loopback clique of N ranks (threads against one KVServer), each replicating a
+shard of ``--mb`` megabytes to every clique peer per round — the exact code
+path ``LocalCheckpointManager.save`` drives. Two configurations:
+
+- **old**: ``serialize_to_bytes`` (joined blob) + ``replicate()`` over
+  ``PeerExchange(protocol=1)`` — every send pickles ``{"src", "tag", "blob"}``
+  into fresh contiguous buffers and the receiver copies the payload again.
+- **new**: ``serialize_parts`` + ``replicate_parts()`` over the v2 bulk frames —
+  sends scatter-gather the caller's buffers (``sendmsg``), receives land in one
+  preallocated buffer (``recv_into``), concurrent peer fan-out.
+
+Also measures peak extra allocation of a single send→recv transfer per path
+(``tracemalloc``): the zero-copy claim is ``alloc_ratio_new ≤ 1.25`` (the
+receive buffer itself is the 1.0; everything beyond it is protocol overhead).
+
+    python scripts/bench_replication.py [--mb 256] [--world 3] [--rounds 3] \
+        [--out BENCH_replication.json]
+"""
+
+import argparse
+import concurrent.futures as cf
+import json
+import os
+import platform
+import sys
+import time
+import tracemalloc
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_resiliency.checkpoint import format as ckpt_format  # noqa: E402
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm  # noqa: E402
+from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy  # noqa: E402
+from tpu_resiliency.platform.store import CoordStore, KVServer  # noqa: E402
+
+
+def _payload(mb: int, rank: int):
+    """One leaf-per-16MB tree, the shape serialize_parts scatter-gathers."""
+    n = mb * (1 << 20)
+    leaf = min(n, 16 << 20)
+    rng = np.random.default_rng(rank)
+    return [rng.integers(0, 255, leaf, dtype=np.uint8) for _ in range(n // leaf)]
+
+
+def bench_clique(world: int, mb: int, rounds: int, streaming: bool) -> float:
+    """Median seconds per replicate round across the clique."""
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=120.0)
+        stores.append(s)
+        return s
+
+    proto = None if streaming else 1
+
+    def body(rank):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=120.0)
+        ex = PeerExchange(mk(), rank, timeout=120.0, protocol=proto)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world
+            )
+            tensors = _payload(mb, rank)
+            times = []
+            for _ in range(rounds):
+                comm.barrier("round-in")
+                t0 = time.perf_counter()
+                if streaming:
+                    prefix, views = ckpt_format.serialize_parts(b"hollow", tensors)
+                    held = strat.replicate_parts([prefix, *views])
+                    assert len(held) == world - 1
+                else:
+                    blob = ckpt_format.serialize_to_bytes(b"hollow", tensors)
+                    held = strat.replicate(blob)
+                    assert len(held) == world
+                comm.barrier("round-out")
+                times.append(time.perf_counter() - t0)
+            return times
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            per_rank = [
+                f.result(timeout=600.0)
+                for f in [pool.submit(body, r) for r in range(world)]
+            ]
+    finally:
+        for s in stores:
+            s.close()
+        srv.close()
+    # A round ends when the slowest rank finishes; barrier timing makes every
+    # rank's per-round wall time comparable — take the max across ranks.
+    round_times = [max(ts) for ts in zip(*per_rank)]
+    return sorted(round_times)[len(round_times) // 2]
+
+
+def bench_alloc(mb: int, streaming: bool) -> float:
+    """Peak extra allocation of ONE send→recv transfer, as a multiple of the
+    payload size. Serial phases (send fully buffered by the kernel? no — run
+    the send on a thread while the receiver drains) under tracemalloc."""
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=60.0)
+        stores.append(s)
+        return s
+
+    proto = None if streaming else 1
+    nbytes = mb * (1 << 20)
+    tensors = _payload(mb, 0)
+    exs = []
+    try:
+        for rank in (0, 1):
+            ex = PeerExchange(mk(), rank, timeout=60.0, protocol=proto)
+            ex.start()
+            exs.append(ex)
+        prefix, views = ckpt_format.serialize_parts(b"hollow", tensors)
+        tracemalloc.start()
+        base, _ = tracemalloc.get_traced_memory()
+        with cf.ThreadPoolExecutor(max_workers=1) as pool:
+            if streaming:
+                fut = pool.submit(exs[0].send_parts, 1, "t", [prefix, *views])
+            else:
+                blob = b"".join([prefix, *[bytes(v) for v in views]])
+                fut = pool.submit(exs[0].send, 1, "t", blob)
+            got = exs[1].recv(0, "t", timeout=60.0)
+            fut.result()
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        assert memoryview(got).cast("B").nbytes == len(prefix) + nbytes
+        return (peak - base) / nbytes
+    finally:
+        for ex in exs:
+            ex.close()
+        for s in stores:
+            s.close()
+        srv.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=int, default=256, help="shard size per rank (MiB)")
+    ap.add_argument("--world", type=int, default=3, help="clique size")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--alloc-mb", type=int, default=None,
+                    help="payload for the allocation probe (default: --mb)")
+    ap.add_argument("--out", default=None, help="write results JSON here")
+    args = ap.parse_args(argv)
+
+    # Bytes exchanged per round: every rank sends its shard to world-1 peers.
+    exchanged = args.world * (args.world - 1) * args.mb * (1 << 20)
+
+    old_s = bench_clique(args.world, args.mb, args.rounds, streaming=False)
+    new_s = bench_clique(args.world, args.mb, args.rounds, streaming=True)
+    alloc_mb = args.alloc_mb or args.mb
+    alloc_old = bench_alloc(alloc_mb, streaming=False)
+    alloc_new = bench_alloc(alloc_mb, streaming=True)
+
+    results = {
+        "world": args.world,
+        "payload_mb": args.mb,
+        "rounds": args.rounds,
+        "old_round_s": round(old_s, 4),
+        "new_round_s": round(new_s, 4),
+        "old_mbps": round(exchanged / old_s / 1e6, 1),
+        "new_mbps": round(exchanged / new_s / 1e6, 1),
+        "speedup": round(old_s / new_s, 2),
+        "alloc_probe_mb": alloc_mb,
+        "alloc_ratio_old": round(alloc_old, 3),
+        "alloc_ratio_new": round(alloc_new, 3),
+        "host": platform.node(),
+        "python": platform.python_version(),
+    }
+    print(json.dumps(results, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,39 @@
+"""Perf acceptance for the streaming replication data plane (slow; tier-1
+deselects ``-m slow``). Runs ``scripts/bench_replication.py`` end to end at a
+CI-sized payload and asserts the zero-copy claim: peak extra allocation of a
+transfer on the v2 path is at most 1.25× the payload (the single receive
+buffer plus protocol overhead), and the streaming path beats the pickled-blob
+path. The committed 256 MB results live in ``BENCH_replication.json``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_streaming_path_is_zero_copy_and_faster(tmp_path):
+    out = tmp_path / "bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_replication.py"),
+            "--mb", "32", "--rounds", "2", "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr
+    results = json.loads(out.read_text())
+    # The zero-copy assertion: one receive buffer (1.0×) + bounded overhead.
+    assert results["alloc_ratio_new"] <= 1.25, results
+    # The old path materializes the shard repeatedly; the gap must be real even
+    # at CI payload sizes (the committed 256 MB run shows the full margin).
+    assert results["speedup"] >= 1.5, results
+    assert results["new_mbps"] > results["old_mbps"], results

@@ -1,0 +1,208 @@
+"""Wire framing: v1 object frames, v2 bulk frames, and their coexistence.
+
+The bulk protocol is the checkpoint-replication hot path (multi-GB shards), so
+these tests pin the properties the perf work depends on: no extra payload
+copies on receive, scatter-gather sends that never join, sendfile framing, and
+clean self-discrimination between the two frame kinds on one stream.
+"""
+
+import os
+import pickle
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.platform import framing
+
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+class TestRecvExact:
+    def test_returns_view_over_single_buffer(self):
+        a, b = _pair()
+        try:
+            a.sendall(b"hello world")
+            got = framing.recv_exact(b, 11)
+            # The zero-copy contract: one preallocated buffer, no bytes() tail.
+            assert isinstance(got, memoryview)
+            assert bytes(got) == b"hello world"
+        finally:
+            a.close()
+            b.close()
+
+    def test_chunked_arrival(self):
+        a, b = _pair()
+        try:
+            payload = os.urandom(1 << 16)
+
+            def drip():
+                for i in range(0, len(payload), 4096):
+                    a.sendall(payload[i : i + 4096])
+
+            t = threading.Thread(target=drip)
+            t.start()
+            got = framing.recv_exact(b, len(payload))
+            t.join()
+            assert bytes(got) == payload
+        finally:
+            a.close()
+            b.close()
+
+    def test_eof_raises(self):
+        a, b = _pair()
+        try:
+            a.sendall(b"abc")
+            a.close()
+            with pytest.raises(EOFError):
+                framing.recv_exact(b, 10)
+        finally:
+            b.close()
+
+
+class TestObjFrames:
+    def test_roundtrip(self):
+        a, b = _pair()
+        try:
+            framing.send_obj(a, {"k": [1, 2, 3]})
+            assert framing.recv_obj(b) == {"k": [1, 2, 3]}
+        finally:
+            a.close()
+            b.close()
+
+
+class TestBulkFrames:
+    def test_magic_cannot_alias_a_v1_length(self):
+        # A v1 receiver reading a bulk frame sees the magic as an absurd length
+        # and rejects it cleanly — the property that makes mixed streams safe.
+        (as_len,) = framing.LEN.unpack(framing.BULK_MAGIC)
+        assert as_len > framing.DEFAULT_MAX_FRAME
+        a, b = _pair()
+        try:
+            threading.Thread(
+                target=framing.send_bulk, args=(a, {"src": 0, "tag": "t"}, [b"x" * 64])
+            ).start()
+            with pytest.raises(ValueError, match="too large"):
+                framing.recv_obj(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_scatter_gather_roundtrip(self):
+        parts = [b"head", np.arange(1024, dtype=np.float32), b"", bytearray(b"tail")]
+        joined = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+        a, b = _pair()
+        try:
+            t = threading.Thread(
+                target=framing.send_bulk, args=(a, {"src": 3, "tag": "s"}, parts)
+            )
+            t.start()
+            kind, header, payload = framing.recv_any(b)
+            t.join()
+            assert kind == "bulk"
+            assert header["src"] == 3 and header["tag"] == "s"
+            assert header["nbytes"] == len(joined)
+            assert bytes(payload) == joined
+        finally:
+            a.close()
+            b.close()
+
+    def test_many_parts_exceeding_iov_max(self):
+        # Forces the sendmsg iovec batching path (Linux UIO_MAXIOV is 1024).
+        parts = [bytes([i % 256]) * 7 for i in range(2500)]
+        a, b = _pair()
+        try:
+            t = threading.Thread(
+                target=framing.send_bulk, args=(a, {"src": 0, "tag": "m"}, parts)
+            )
+            t.start()
+            kind, header, payload = framing.recv_any(b)
+            t.join()
+            assert kind == "bulk"
+            assert bytes(payload) == b"".join(parts)
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_any_accepts_obj_frames(self):
+        a, b = _pair()
+        try:
+            framing.send_obj(a, {"src": 1, "tag": "t", "blob": b"old"})
+            kind, obj, payload = framing.recv_any(b)
+            assert kind == "obj" and payload is None
+            assert obj["blob"] == b"old"
+        finally:
+            a.close()
+            b.close()
+
+    def test_alloc_lands_payload_in_registered_buffer(self):
+        dest = bytearray(128)
+
+        def alloc(header):
+            assert header["tag"] == "t"
+            return dest
+
+        a, b = _pair()
+        try:
+            t = threading.Thread(
+                target=framing.send_bulk, args=(a, {"src": 0, "tag": "t"}, [b"y" * 100])
+            )
+            t.start()
+            kind, header, payload = framing.recv_any(b, alloc=alloc)
+            t.join()
+            assert kind == "bulk"
+            assert payload.obj is dest  # received in place, zero copies
+            assert bytes(dest[:100]) == b"y" * 100
+        finally:
+            a.close()
+            b.close()
+
+    def test_alloc_too_small_falls_back_to_fresh_buffer(self):
+        a, b = _pair()
+        try:
+            t = threading.Thread(
+                target=framing.send_bulk, args=(a, {"src": 0, "tag": "t"}, [b"z" * 64])
+            )
+            t.start()
+            kind, _, payload = framing.recv_any(b, alloc=lambda h: bytearray(8))
+            t.join()
+            assert kind == "bulk" and bytes(payload) == b"z" * 64
+        finally:
+            a.close()
+            b.close()
+
+    def test_oversized_bulk_rejected(self):
+        a, b = _pair()
+        try:
+            hdr = pickle.dumps({"src": 0, "tag": "t", "nbytes": 1 << 40})
+            a.sendall(framing.BULK_MAGIC + framing.LEN.pack(len(hdr)) + hdr)
+            with pytest.raises(ValueError, match="too large"):
+                framing.recv_any(b, max_frame=1 << 20)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestSendBulkFile:
+    def test_file_splice_roundtrip(self, tmp_path):
+        payload = os.urandom(1 << 20)
+        path = tmp_path / "shard.bin"
+        path.write_bytes(payload)
+        a, b = _pair()
+        try:
+            t = threading.Thread(
+                target=framing.send_bulk_file, args=(a, {"src": 2, "tag": "f"}, str(path))
+            )
+            t.start()
+            kind, header, got = framing.recv_any(b, max_frame=1 << 24)
+            t.join()
+            assert kind == "bulk"
+            assert header["nbytes"] == len(payload)
+            assert bytes(got) == payload
+        finally:
+            a.close()
+            b.close()

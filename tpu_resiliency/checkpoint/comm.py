@@ -22,17 +22,32 @@ import os
 import secrets
 import socket
 import threading
-from typing import Any, Optional
+import time
+from typing import Any, Optional, Sequence
 
 from tpu_resiliency.exceptions import CheckpointError, StoreTimeoutError
 from tpu_resiliency.platform import framing
 from tpu_resiliency.platform.store import AUTH_KEY_ENV, StoreView, _hmac
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 # Checkpoint shards can be large; allow 16 GB frames on p2p links.
 P2P_MAX_FRAME = 16 * 1024**3
+
+
+def _transfer_event(direction: str, nbytes: int, dt: float, **extra) -> None:
+    """One ``p2p_transfer`` event per shard move — the volume is one per peer
+    per replication round (minutes apart), so per-transfer events are cheap and
+    feed both the live metrics sink and post-hoc aggregation
+    (``utils/metrics.py:observe_record`` maps them to
+    ``tpu_ckpt_replication_bytes_total`` and ``tpu_replication_mbps``)."""
+    record_event(
+        "checkpoint", "p2p_transfer",
+        direction=direction, bytes=nbytes, duration_s=dt,
+        mbps=(nbytes / dt / 1e6) if dt > 0 else 0.0, **extra,
+    )
 
 
 def _reachable_host() -> str:
@@ -104,18 +119,34 @@ class StoreComm:
         self.store.barrier_join(tag, self.rank, self.world_size, timeout or self.timeout)
 
     def all_gather(self, obj: Any, tag: str = "ag", timeout: Optional[float] = None) -> list:
-        """Returns ``[obj_from_rank]`` ordered by group rank index."""
+        """Returns ``[obj_from_rank]`` ordered by group rank index.
+
+        Exactly one value-fetch round trip per collective: the entry barrier
+        guarantees every member's value is set, so a single server-side
+        ``prefix_get`` scan replaces N sequential polled ``get``\\ s (whose
+        round-trip latency dominated the collective at any real group size).
+        Two barriers total — entry (values complete) and exit (the leader's
+        batched ``prefix_clear`` only runs after everyone has read).
+        """
         t = timeout or self.timeout
         r = self._round(tag)
         base = f"{tag}/{r}"
         self.store.set(f"{base}/{self.rank}", obj)
         self.store.barrier_join(f"{tag}/b0", self.rank, self.world_size, t)
-        out = [self.store.get(f"{base}/{peer}", timeout=t) for peer in self.ranks]
+        vals = self.store.prefix_get(f"{base}/")
+        try:
+            out = [vals[f"{base}/{peer}"] for peer in self.ranks]
+        except KeyError as e:
+            # Every member set its value before joining b0; a hole means the
+            # store lost state (restarted mid-collective) — surface it.
+            raise CheckpointError(
+                f"all_gather {tag!r} round {r}: missing value for key {e} "
+                f"(got {sorted(vals)})"
+            ) from None
         # Exit barrier so the leader only deletes after everyone has read.
         self.store.barrier_join(f"{tag}/b1", self.rank, self.world_size, t)
         if self.is_leader:
-            for peer in self.ranks:
-                self.store.delete(f"{base}/{peer}")
+            self.store.prefix_clear(f"{base}/")
         return out
 
     def broadcast(self, obj: Any, src: int, tag: str = "bc", timeout: Optional[float] = None) -> Any:
@@ -154,6 +185,17 @@ class PeerExchange:
     matching frame. Message matching is (src, tag) so concurrent replication rounds with
     distinct tags don't cross. Analogue of the reference's isend/irecv shard routing
     (``checkpointing/local/replication/group_utils.py:394-465``).
+
+    **Wire protocol (v2).** The hello each side already exchanges carries ``v``;
+    a v2→v2 link moves payloads as raw bulk frames (small pickled header + raw
+    bytes, ``framing.send_bulk``): the sender scatter-gathers the caller's
+    buffers straight onto the socket (:meth:`send_parts`) or splices a file with
+    ``os.sendfile`` (:meth:`send_file`); the receiver lands the payload in ONE
+    preallocated buffer — a registered :meth:`recv_into` destination when the
+    caller provided one. Talking to a v1 peer (hello ``v`` < 2, or this side
+    constructed with ``protocol=1``) transparently falls back to the pickled
+    ``{"src", "tag", "blob"}`` object frame, and a v2 receiver accepts both
+    kinds on one stream — mixed-version cliques round-trip byte-identically.
     """
 
     def __init__(
@@ -162,6 +204,7 @@ class PeerExchange:
         rank: int,
         timeout: float = 300.0,
         auth_key: Optional[str] = None,
+        protocol: Optional[int] = None,
     ):
         self.store = store.scoped("p2p")
         self.rank = rank
@@ -169,8 +212,15 @@ class PeerExchange:
         if auth_key is None:
             auth_key = os.environ.get(AUTH_KEY_ENV) or None
         self.auth_key = auth_key
+        #: Advertised/spoken protocol version; ``protocol=1`` pins this end to
+        #: the legacy pickled-blob frames (rolling upgrades, benchmarks).
+        self.protocol = min(framing.PROTO_VERSION, protocol or framing.PROTO_VERSION)
         self._sock: Optional[socket.socket] = None
-        self._inbox: dict[tuple[int, str], list[bytes]] = {}
+        self._inbox: dict[tuple[int, str], list] = {}
+        #: (src, tag) → caller-registered receive buffers (``recv_into``): the
+        #: accept thread lands a matching bulk payload directly in one of these
+        #: instead of allocating.
+        self._pending: dict[tuple[int, str], list[memoryview]] = {}
         self._cond = threading.Condition()
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
@@ -235,14 +285,45 @@ class PeerExchange:
                 target=self._recv_conn, args=(conn,), daemon=True, name="p2p-recv"
             ).start()
 
+    def _claim_buffer(self, header: dict) -> Optional[memoryview]:
+        """Accept-thread side of :meth:`recv_into`: pop a registered destination
+        buffer for this frame's (src, tag) if one fits, else None (fresh alloc)."""
+        try:
+            key = (header["src"], header["tag"])
+            nbytes = int(header["nbytes"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        with self._cond:
+            bufs = self._pending.get(key)
+            if not bufs:
+                return None
+            for i, view in enumerate(bufs):
+                if view.nbytes >= nbytes:
+                    return bufs.pop(i)
+            log.warning(
+                f"p2p: registered recv_into buffer(s) for {key} too small for "
+                f"{nbytes} B frame; receiving into a fresh buffer"
+            )
+            return None
+
     def _recv_conn(self, conn: socket.socket) -> None:
         try:
             if not self._handshake_server(conn):
                 return
-            msg = framing.recv_obj(conn, max_frame=P2P_MAX_FRAME)
-            src, tag, blob = msg["src"], msg["tag"], msg["blob"]
+            t0 = time.perf_counter()
+            kind, msg, payload = framing.recv_any(
+                conn, max_frame=P2P_MAX_FRAME, alloc=self._claim_buffer
+            )
+            if kind == "bulk":
+                src, tag = msg["src"], msg["tag"]
+            else:
+                src, tag, payload = msg["src"], msg["tag"], msg["blob"]
+            nbytes = memoryview(payload).cast("B").nbytes if payload is not None else 0
+            _transfer_event(
+                "recv", nbytes, time.perf_counter() - t0, src=src, frame=kind
+            )
             with self._cond:
-                self._inbox.setdefault((src, tag), []).append(blob)
+                self._inbox.setdefault((src, tag), []).append(payload)
                 self._cond.notify_all()
         except (ConnectionError, EOFError, OSError, KeyError, TypeError, ValueError):
             log.warning("p2p: dropped malformed incoming frame", exc_info=True)
@@ -255,9 +336,12 @@ class PeerExchange:
     def _handshake_server(self, conn: socket.socket) -> bool:
         """Challenge/response before any pickled payload is parsed (same hello
         protocol as ``KVServer`` — see its ``_accept``/``_parse`` auth path). No-op
-        when auth is off (loopback-only bind)."""
+        when auth is off (loopback-only bind). The hello's ``v`` advertises this
+        end's protocol ceiling; the connecting sender picks the frame format."""
         nonce = secrets.token_bytes(16)
-        framing.send_obj(conn, {"v": 1, "auth": self.auth_key is not None, "nonce": nonce})
+        framing.send_obj(
+            conn, {"v": self.protocol, "auth": self.auth_key is not None, "nonce": nonce}
+        )
         if self.auth_key is None:
             return True
         conn.settimeout(30.0)
@@ -270,14 +354,23 @@ class PeerExchange:
         conn.settimeout(None)
         return ok
 
-    def _handshake_client(self, conn: socket.socket) -> None:
+    def _handshake_client(self, conn: socket.socket) -> int:
+        """Returns the peer's advertised protocol version (1 for pre-versioned
+        hellos — every peer has sent ``v`` since v1, but default defensively)."""
         hello = framing.recv_obj(conn, max_frame=1024)
-        if isinstance(hello, dict) and hello.get("auth"):
-            if self.auth_key is None:
-                raise CheckpointError(
-                    f"p2p peer requires authentication; set ${AUTH_KEY_ENV}"
-                )
-            framing.send_obj(conn, {"mac": _hmac(self.auth_key, hello["nonce"])})
+        peer_v = 1
+        if isinstance(hello, dict):
+            try:
+                peer_v = int(hello.get("v", 1))
+            except (TypeError, ValueError):
+                peer_v = 1
+            if hello.get("auth"):
+                if self.auth_key is None:
+                    raise CheckpointError(
+                        f"p2p peer requires authentication; set ${AUTH_KEY_ENV}"
+                    )
+                framing.send_obj(conn, {"mac": _hmac(self.auth_key, hello["nonce"])})
+        return peer_v
 
     def _peer_addr(self, peer: int) -> tuple[str, int]:
         if peer not in self._addr_cache:
@@ -289,22 +382,153 @@ class PeerExchange:
                 raise CheckpointError(f"p2p: no address published for rank {peer}") from e
         return self._addr_cache[peer]
 
-    def send(self, dst: int, tag: str, blob: bytes) -> None:
+    def _dial(self, dst: int) -> tuple[socket.socket, int]:
+        """Connect + handshake; returns ``(socket, peer_protocol_version)``."""
         host, port = self._peer_addr(dst)
-        with socket.create_connection((host, port), timeout=self.timeout) as conn:
+        conn = socket.create_connection((host, port), timeout=self.timeout)
+        try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._handshake_client(conn)
-            framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+            peer_v = self._handshake_client(conn)
+        except BaseException:
+            conn.close()
+            raise
+        return conn, peer_v
 
-    def recv(self, src: int, tag: str, timeout: Optional[float] = None) -> bytes:
-        import time as _time
+    def _use_bulk(self, peer_v: int) -> bool:
+        return peer_v >= framing.PROTO_V2 and self.protocol >= framing.PROTO_V2
 
-        deadline = _time.monotonic() + (timeout or self.timeout)
+    def send(self, dst: int, tag: str, blob) -> None:
+        """Push one bytes-like payload to a peer (sugar over :meth:`send_parts`)."""
+        self.send_parts(dst, tag, [blob])
+
+    def send_parts(self, dst: int, tag: str, parts: Sequence[Any]) -> int:
+        """Send a payload as its constituent buffers, never joining them.
+
+        On a v2 link the parts go out as one bulk frame, scatter-gathered from
+        the caller's buffers (``socket.sendmsg``) — zero userspace copies. A v1
+        peer gets the legacy pickled ``{"src", "tag", "blob"}`` frame (one join,
+        the price of compatibility). Returns payload bytes sent.
+        """
+        conn, peer_v = self._dial(dst)
+        t0 = time.perf_counter()
+        try:
+            with conn:
+                if self._use_bulk(peer_v):
+                    nbytes = framing.send_bulk(
+                        conn, {"src": self.rank, "tag": tag}, parts
+                    )
+                    frame = "bulk"
+                else:
+                    blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+                    framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+                    nbytes = len(blob)
+                    frame = "obj"
+        except OSError as e:
+            raise CheckpointError(f"p2p: send to rank {dst} failed: {e!r}") from e
+        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
+        return nbytes
+
+    def send_file(self, dst: int, tag: str, path: str) -> int:
+        """Stream an on-disk payload to a peer.
+
+        On a v2 link the file is spliced kernel-side with ``os.sendfile`` — the
+        shard never enters userspace. A v1 peer forces the legacy whole-blob
+        frame (read + pickle). Returns payload bytes sent.
+        """
+        conn, peer_v = self._dial(dst)
+        t0 = time.perf_counter()
+        try:
+            with conn:
+                if self._use_bulk(peer_v):
+                    nbytes = framing.send_bulk_file(
+                        conn, {"src": self.rank, "tag": tag}, path
+                    )
+                    frame = "file"
+                else:
+                    with open(path, "rb") as f:
+                        blob = f.read()
+                    framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+                    nbytes = len(blob)
+                    frame = "obj"
+        except OSError as e:
+            raise CheckpointError(
+                f"p2p: send_file({path!r}) to rank {dst} failed: {e!r}"
+            ) from e
+        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
+        return nbytes
+
+    def recv(self, src: int, tag: str, timeout: Optional[float] = None):
+        """Block for a matching frame; returns its payload (bytes-like: ``bytes``
+        from a v1 frame, a ``memoryview`` over the receive buffer from a bulk
+        frame — pass it to ``format.deserialize_from_buffer`` / ``write_parts``
+        without copying)."""
+        deadline = time.monotonic() + (timeout or self.timeout)
         key = (src, tag)
         with self._cond:
             while not self._inbox.get(key):
-                remaining = deadline - _time.monotonic()
+                remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise CheckpointError(f"p2p: timed out waiting for {tag!r} from rank {src}")
                 self._cond.wait(timeout=min(remaining, 1.0))
             return self._inbox[key].pop(0)
+
+    def recv_into(self, src: int, tag: str, buf, timeout: Optional[float] = None) -> int:
+        """Receive a matching frame directly into ``buf``; returns payload size.
+
+        Registering ``buf`` before the frame arrives lets the accept thread
+        ``recv_into`` the wire payload straight into it — zero extra allocation
+        and zero copies. If the frame raced ahead of the registration (already
+        in the inbox) or came from a v1 peer, the payload lands with one copy.
+        At most one in-flight frame per (src, tag) is supported on this path —
+        the per-round unique-tag discipline the replication layer follows.
+        """
+        base = buf.obj if isinstance(buf, memoryview) else buf
+        view = memoryview(buf).cast("B")
+        key = (src, tag)
+        with self._cond:
+            self._pending.setdefault(key, []).append(view)
+        try:
+            got = self.recv(src, tag, timeout)
+        finally:
+            with self._cond:
+                bufs = self._pending.get(key)
+                if bufs is not None:
+                    try:
+                        bufs.remove(view)
+                    except ValueError:
+                        pass  # claimed by the accept thread — the fast path
+                    if not bufs:
+                        self._pending.pop(key, None)
+        gv = memoryview(got).cast("B")
+        n = gv.nbytes
+        if gv.obj is base:
+            return n  # landed in place
+        if n > view.nbytes:
+            raise CheckpointError(
+                f"p2p: recv_into buffer too small for {tag!r} from rank {src}: "
+                f"{view.nbytes} < {n}"
+            )
+        view[:n] = gv
+        return n
+
+    def purge(self, tag_prefix: str) -> int:
+        """Drop queued frames (and pending ``recv_into`` registrations) whose tag
+        starts with ``tag_prefix``; returns the number of frames dropped.
+
+        Frames nobody ever ``recv``\\ s — a peer restarted mid-round, an
+        abandoned replication round — would otherwise pin their multi-GB
+        payloads in ``_inbox`` for the process's lifetime, and stale frames
+        under a reused tag would be mis-delivered to the next round.
+        ``CliqueReplicationStrategy.rebuild`` calls this when it resets its
+        round counter.
+        """
+        with self._cond:
+            dead = [k for k in self._inbox if k[1].startswith(tag_prefix)]
+            n = sum(len(self._inbox[k]) for k in dead)
+            for k in dead:
+                del self._inbox[k]
+            for k in [k for k in self._pending if k[1].startswith(tag_prefix)]:
+                del self._pending[k]
+        if n:
+            log.info(f"p2p: purged {n} stale frame(s) under tag prefix {tag_prefix!r}")
+        return n

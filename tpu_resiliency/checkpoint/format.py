@@ -255,8 +255,19 @@ def read_payload(path: str) -> tuple[bytes, list[np.ndarray], dict]:
     return header["hollow"], tensors, header.get("meta", {})
 
 
-def serialize_to_bytes(hollow_bytes: bytes, tensors: Sequence[Any], meta: dict | None = None) -> bytes:
-    """In-memory form of the container (used for peer-to-peer replication frames)."""
+def serialize_parts(
+    hollow_bytes: bytes, tensors: Sequence[Any], meta: dict | None = None
+) -> tuple[bytes, list[memoryview]]:
+    """Container as ``(prefix_bytes, [leaf byte views])`` — the zero-copy form.
+
+    The prefix is the small ``MAGIC | header_len | header`` head; the views are
+    raw uint8 windows over each leaf's host buffer. Concatenating
+    ``prefix + views`` yields exactly :func:`serialize_to_bytes`'s blob, but no
+    joined copy ever exists: senders scatter-gather the parts straight onto a
+    socket (``framing.send_bulk``) and writers stream them to a file
+    (:func:`write_parts`). The views alias the input tensors — keep those alive
+    (and unmutated) until the parts are consumed.
+    """
     arrays = [_leaf_to_numpy(t) for t in tensors]
     header = {
         "hollow": hollow_bytes,
@@ -266,26 +277,74 @@ def serialize_to_bytes(hollow_bytes: bytes, tensors: Sequence[Any], meta: dict |
         "meta": meta or {},
     }
     header_bytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
-    parts = [MAGIC, _LEN.pack(len(header_bytes)), header_bytes]
-    parts.extend(_raw_view(a) for a in arrays)
-    return b"".join(parts)
+    prefix = MAGIC + _LEN.pack(len(header_bytes)) + header_bytes
+    return prefix, [_raw_view(a) for a in arrays]
 
 
-def deserialize_from_bytes(blob: bytes) -> tuple[bytes, list[np.ndarray], dict]:
-    if blob[: len(MAGIC)] != MAGIC:
+def parts_nbytes(prefix: bytes, views: Sequence[Any]) -> int:
+    """Total container size of a :func:`serialize_parts` result."""
+    return len(prefix) + sum(memoryview(v).cast("B").nbytes for v in views)
+
+
+def serialize_to_bytes(hollow_bytes: bytes, tensors: Sequence[Any], meta: dict | None = None) -> bytes:
+    """In-memory form of the container (compat path for whole-blob consumers;
+    the replication hot path uses :func:`serialize_parts` and never joins)."""
+    prefix, views = serialize_parts(hollow_bytes, tensors, meta)
+    return b"".join([prefix, *views])
+
+
+def write_parts(path: str, parts: Sequence[Any], fsync: bool = True) -> int:
+    """Atomically stream already-serialized container parts to ``path`` — the
+    ``.dirty``-then-rename protocol of :func:`write_blob` without requiring a
+    joined blob (a receive buffer, a :func:`serialize_parts` result, or any mix
+    of bytes-likes). Returns bytes written."""
+    tmp = path + DIRTY_SUFFIX
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    written = 0
+    with open(tmp, "wb") as f:
+        for p in parts:
+            v = memoryview(p).cast("B")
+            f.write(v)
+            written += v.nbytes
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    _commit_atomic(tmp, path, fsync)
+    return written
+
+
+def deserialize_from_buffer(buf) -> tuple[bytes, list[np.ndarray], dict]:
+    """Zero-copy deserialization: tensors come back as views over ``buf``.
+
+    ``buf`` is any bytes-like (typically the single receive buffer a bulk frame
+    landed in); each leaf is ``np.frombuffer`` over a ``memoryview`` slice, so
+    no per-leaf copies are made. The arrays alias ``buf`` — they are read-only
+    when ``buf`` is, and mutating ``buf`` mutates them. Callers that outlive the
+    buffer (or need writable tensors from an immutable source) copy explicitly.
+    """
+    mv = memoryview(buf).cast("B")
+    if bytes(mv[: len(MAGIC)]) != MAGIC:
         raise CheckpointError("bad magic in serialized checkpoint blob")
     off = len(MAGIC)
-    (hlen,) = _LEN.unpack(blob[off : off + _LEN.size])
+    (hlen,) = _LEN.unpack(mv[off : off + _LEN.size])
     off += _LEN.size
-    header = pickle.loads(blob[off : off + hlen])
+    header = pickle.loads(mv[off : off + hlen])
     off += hlen
     tensors = []
     for spec in header["leaves"]:
         n = spec["nbytes"]
+        if off + n > mv.nbytes:
+            raise CheckpointError("truncated serialized checkpoint blob")
         tensors.append(
-            np.frombuffer(blob[off : off + n], dtype=resolve_dtype(spec["dtype"])).reshape(
+            np.frombuffer(mv[off : off + n], dtype=resolve_dtype(spec["dtype"])).reshape(
                 spec["shape"]
             )
         )
         off += n
     return header["hollow"], tensors, header.get("meta", {})
+
+
+def deserialize_from_bytes(blob) -> tuple[bytes, list[np.ndarray], dict]:
+    """Alias of :func:`deserialize_from_buffer` (kept for callers written against
+    the pre-streaming API; both are zero-copy over the input buffer now)."""
+    return deserialize_from_buffer(blob)

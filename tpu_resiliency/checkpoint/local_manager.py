@@ -52,19 +52,28 @@ class CkptID:
         return f"iter_{self.iteration:07d}_{self.owner}_local.ckpt"
 
 
-def _write_blobs(paths_and_blobs: list[tuple[str, bytes]]) -> None:
-    """Async-part worker: write each blob atomically (module-level: picklable).
+def _write_blobs(paths_and_blobs: list[tuple[str, Any]]) -> None:
+    """Async-part worker: write each payload atomically (module-level: picklable).
 
-    Writer parallelism follows the ``$TPU_RESILIENCY_CKPT_STRIPES`` storage-class
-    knob (``format.write_blob``); default is single-stream, the measured winner
-    on plain host storage."""
+    Each value is a single bytes-like (a receive buffer) or a list of parts (a
+    ``serialize_parts`` result) — either way the payload streams to disk with no
+    joined copy (``format.write_parts``). Writer parallelism for single blobs
+    follows the ``$TPU_RESILIENCY_CKPT_STRIPES`` storage-class knob
+    (``format.write_blob``); default is single-stream, the measured winner on
+    plain host storage."""
     import time as _time
 
     t0 = _time.perf_counter()
-    total = sum(len(b) for _, b in paths_and_blobs)
+    total = sum(
+        sum(len(p) for p in b) if isinstance(b, list) else len(b)
+        for _, b in paths_and_blobs
+    )
     try:
         for path, blob in paths_and_blobs:
-            ckpt_format.write_blob(path, blob)
+            if isinstance(blob, list):
+                ckpt_format.write_parts(path, blob)
+            else:
+                ckpt_format.write_blob(path, blob)
     except BaseException as e:
         record_event(
             "checkpoint", "timing", name="ckpt.save.write",
@@ -103,6 +112,7 @@ class LocalCheckpointManager:
         self.session = session
         self.comm = comm
         self.replication = replication
+        self._caller_kind = caller
         self.queue = AsyncCallsQueue(
             caller=caller, sync_fn=comm.make_sync_fn() if comm is not None else None
         )
@@ -155,20 +165,37 @@ class LocalCheckpointManager:
             hollow_bytes = pickle.dumps(
                 state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
             )
-            blob = ckpt_format.serialize_to_bytes(
+            # Parts, not a joined blob: the container exists only as the header
+            # prefix plus views over the host tensors. Replication scatter-
+            # gathers these straight onto the peer sockets and the writer
+            # streams them to disk — the only whole-shard buffers ever
+            # materialized are the peers' single receive buffers.
+            prefix, views = ckpt_format.serialize_parts(
                 hollow_bytes, state_dict.tensors(), meta={"iteration": iteration, **(meta or {})}
             )
+            parts = [prefix, *views]
+            if self._caller_kind != "thread":
+                # Process/fork callers pickle the async args; materialize the
+                # views (thread caller — the default — stays zero-copy).
+                parts = [prefix] + [bytes(v) for v in views]
         with debug_time("ckpt.save.replicate", source="checkpoint"):
-            held = (
-                self.replication.replicate(blob)
+            received = (
+                self.replication.replicate_parts(parts)
                 if self.replication is not None and self.replication.enabled
-                else {self.rank: blob}
+                else {}
             )
-        writes = [
-            (self._path(CkptID(iteration, owner, self.session)), b)
-            for owner, b in held.items()
+        writes: list[tuple[str, Any]] = [
+            (self._path(CkptID(iteration, self.rank, self.session)), parts)
         ]
-        total_bytes = sum(len(b) for _, b in writes)
+        writes += [
+            (self._path(CkptID(iteration, owner, self.session)),
+             bytes(b) if self._caller_kind != "thread" and not isinstance(b, bytes) else b)
+            for owner, b in received.items()
+        ]
+        total_bytes = sum(
+            sum(len(p) for p in b) if isinstance(b, list) else len(b)
+            for _, b in writes
+        )
         req = AsyncRequest(
             async_fn=_write_blobs,
             async_fn_args=(writes,),
@@ -258,6 +285,8 @@ class LocalCheckpointManager:
             newest,
             lambda owner, it: self._read_blob(it, owner),
             held={(i.owner, i.iteration) for i in self.local_ids()},
+            # On-disk shards stream file→socket via sendfile (no userspace copy).
+            get_path=lambda owner, it: self._path(CkptID(it, owner, self.session)),
         )
         writes = [
             (self._path(CkptID(it, owner, self.session)), blob)
@@ -299,11 +328,13 @@ class LocalCheckpointManager:
             raise CheckpointError("no fully-covered local checkpoint found")
         my_id = CkptID(iteration, self.rank, self.session)
         path = self._path(my_id)
+        get_path = lambda o: self._path(CkptID(iteration, o, self.session))  # noqa: E731
         if os.path.exists(path):
             if self.comm is not None and self.replication is not None:
                 # Participate in the collective retrieve even when locally satisfied.
                 self.replication.retrieve(
-                    None, self._held_owners(iteration), lambda o: self._read_blob(iteration, o)
+                    None, self._held_owners(iteration),
+                    lambda o: self._read_blob(iteration, o), get_path=get_path,
                 )
             return self._read_local_shard(iteration, self.rank)
         else:
@@ -313,7 +344,8 @@ class LocalCheckpointManager:
                     f"and replication is disabled"
                 )
             blob = self.replication.retrieve(
-                self.rank, self._held_owners(iteration), lambda o: self._read_blob(iteration, o)
+                self.rank, self._held_owners(iteration),
+                lambda o: self._read_blob(iteration, o), get_path=get_path,
             )
             if blob is None:
                 raise CheckpointError(

@@ -15,14 +15,36 @@ from a store-gathered availability map, mirroring ``group_utils.py:57,466``.
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import dataclasses
-from typing import Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
 from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
+
+
+def _fan_out(sends: list[Callable[[], Any]]) -> None:
+    """Run peer sends concurrently; first failure propagates.
+
+    The serial peer loop paid full wire time per peer; concurrent sends overlap
+    them so a round's send side costs ~one shard transfer regardless of clique
+    size (the network analogue of the reference's per-bucket writer fan-out,
+    ``filesystem_async.py:232-334``). Per-call executor: rounds are minutes
+    apart and move GBs — thread spawn is noise, and there is no pool lifecycle
+    to leak.
+    """
+    if not sends:
+        return
+    if len(sends) == 1:
+        sends[0]()
+        return
+    with cf.ThreadPoolExecutor(max_workers=len(sends)) as pool:
+        for f in [pool.submit(s) for s in sends]:
+            f.result()
 
 
 def parse_group_sequence(
@@ -197,6 +219,12 @@ class CliqueReplicationStrategy:
         # at 0. Tags must agree across the new group, and rebuild is the one
         # moment every member is provably at the same point — re-align here.
         self._round = 0
+        # Tags restart at 0, so frames from abandoned pre-rebuild rounds (a peer
+        # died mid-replicate; nobody will ever recv them) must not linger: they
+        # pin multi-GB payloads in the exchange inbox forever AND would be
+        # mis-delivered to the new world's round 0 under the reused tag.
+        for prefix in ("repl/", "retr/", "remir/"):
+            self.exchange.purge(prefix)
         log.info(
             f"replication cliques rebuilt over {comm.ranks}: my_group={self.my_group}"
         )
@@ -206,12 +234,15 @@ class CliqueReplicationStrategy:
         my_iteration: Optional[int],
         get_blob,
         held: frozenset[tuple[int, int]] | set[tuple[int, int]] = frozenset(),
+        get_path=None,
     ) -> dict[int, tuple[int, bytes]]:
         """Re-mirror shards within the (rebuilt) cliques. Collective over the comm.
 
         ``my_iteration``: newest iteration of this rank's OWN shard on local disk
         (``None`` when it has none — a fresh joiner participates as receiver
-        only). ``get_blob(owner, iteration)`` loads a locally-held shard's bytes.
+        only). ``get_blob(owner, iteration)`` loads a locally-held shard's bytes;
+        ``get_path(owner, iteration)`` (optional) names its on-disk file, letting
+        sends splice file→socket via ``sendfile`` with zero userspace copies.
         ``held``: the ``(owner, iteration)`` pairs already on this rank's disk —
         a peer that already holds a mirror is skipped (after a shrink, surviving
         clique pairs keep their existing multi-GB mirrors; only shards that lost
@@ -239,14 +270,18 @@ class CliqueReplicationStrategy:
         tag = f"remir/{self._round}"
         self._round += 1
         received: dict[int, tuple[int, bytes]] = {}
-        # Pass 1: own shards.
+        # Pass 1: own shards — sends fan out concurrently, file-spliced when the
+        # caller names the on-disk path.
         if rank in have:
-            blob = None
-            for peer in self.my_group:
-                if peer != rank and (rank, have[rank]) not in peer_held[peer]:
-                    if blob is None:
-                        blob = get_blob(rank, have[rank])
-                    self.exchange.send(peer, f"{tag}/{rank}", blob)
+            targets = [
+                peer
+                for peer in self.my_group
+                if peer != rank and (rank, have[rank]) not in peer_held[peer]
+            ]
+            if targets:
+                _fan_out(self._shard_senders(
+                    targets, f"{tag}/{rank}", rank, have[rank], get_blob, get_path
+                ))
         for peer in self.my_group:
             if (
                 peer != rank
@@ -270,17 +305,35 @@ class CliqueReplicationStrategy:
                 continue
             primary = holders[0]
             grp = group_of(primary, self.groups)
-            for dst in grp:
-                if dst == primary or (owner, it) in peer_held[dst]:
-                    continue
-                if rank == primary:
-                    self.exchange.send(dst, f"{tag}/orph/{owner}", get_blob(owner, it))
-                elif rank == dst:
-                    received[owner] = (
-                        it,
-                        self.exchange.recv(primary, f"{tag}/orph/{owner}"),
-                    )
+            dsts = [d for d in grp if d != primary and (owner, it) not in peer_held[d]]
+            if rank == primary:
+                _fan_out(self._shard_senders(
+                    dsts, f"{tag}/orph/{owner}", owner, it, get_blob, get_path
+                ))
+            elif rank in dsts:
+                received[owner] = (
+                    it,
+                    self.exchange.recv(primary, f"{tag}/orph/{owner}"),
+                )
         return received
+
+    def _shard_senders(
+        self, peers: Sequence[int], tag: str, owner: int, iteration: int,
+        get_blob, get_path,
+    ) -> list:
+        """Per-peer send thunks for one locally-held shard: ``sendfile`` splices
+        straight from disk when the caller names the path; otherwise the blob is
+        loaded ONCE and shared across the fan-out."""
+        if not peers:
+            return []
+        if get_path is not None:
+            path = get_path(owner, iteration)
+            return [
+                (lambda p=peer: self.exchange.send_file(p, tag, path))
+                for peer in peers
+            ]
+        blob = get_blob(owner, iteration)
+        return [(lambda p=peer: self.exchange.send(p, tag, blob)) for peer in peers]
 
     @property
     def enabled(self) -> bool:
@@ -289,19 +342,47 @@ class CliqueReplicationStrategy:
     def replicate(self, blob: bytes) -> dict[int, bytes]:
         """Exchange shard blobs within the clique. Returns {owner_rank: blob}."""
         self._ensure_groups()
+        held = {self.comm.rank: blob}
+        held.update(self.replicate_parts([blob]))
+        return held
+
+    def replicate_parts(self, parts: Sequence[Any]) -> dict[int, Any]:
+        """Exchange this rank's shard (as its constituent buffers) within the
+        clique; returns ``{peer_owner: received_payload}`` — this rank's own
+        entry is NOT included (the caller already holds the parts).
+
+        The streaming hot path: sends scatter-gather ``parts`` straight from the
+        caller's buffers (no joined blob ever exists), fan out over a thread
+        pool so a round costs ~one shard transfer regardless of clique size, and
+        overlap with the receives draining concurrently on this thread. Received
+        payloads are single receive buffers (`bytes`-like) ready for
+        ``format.write_parts`` / ``deserialize_from_buffer``.
+        """
+        self._ensure_groups()
         rank = self.comm.rank
-        held = {rank: blob}
         if not self.enabled:
-            return held
+            return {}
         tag = f"repl/{self._round}"
         self._round += 1
-        for peer in self.my_group:
-            if peer != rank:
-                self.exchange.send(peer, tag, blob)
-        for peer in self.my_group:
-            if peer != rank:
-                held[peer] = self.exchange.recv(peer, tag)
-        return held
+        peers = [p for p in self.my_group if p != rank]
+        if not peers:
+            return {}
+        nbytes = sum(memoryview(p).cast("B").nbytes for p in parts)
+        received: dict[int, Any] = {}
+        with span(
+            "checkpoint", "ckpt.replicate.fanout",
+            round=self._round - 1, peers=len(peers), bytes=nbytes,
+        ):
+            with cf.ThreadPoolExecutor(max_workers=len(peers)) as pool:
+                futs = [
+                    pool.submit(self.exchange.send_parts, peer, tag, parts)
+                    for peer in peers
+                ]
+                for peer in peers:
+                    received[peer] = self.exchange.recv(peer, tag)
+                for f in futs:
+                    f.result()
+        return received
 
     def _ensure_groups(self) -> None:
         """Hook for the lazy subclass; the eager strategy's groups always exist."""
@@ -312,14 +393,17 @@ class CliqueReplicationStrategy:
         my_held_owners: set[int],
         get_blob,
         avoid: frozenset[int] | set[int] = frozenset(),
+        get_path=None,
     ) -> Optional[bytes]:
         """Global shard routing after rank loss / reassignment.
 
         ``my_needed_owner``: owner-rank of the shard this rank needs but does not hold
         (``None`` if satisfied locally). ``my_held_owners``: owner-ranks of shards held
-        locally. ``get_blob(owner)`` loads a held shard's bytes for sending. All ranks
-        must call this collectively with the same ``avoid`` set (degraded ranks are
-        deprioritized as senders). Returns the received blob, or ``None``.
+        locally. ``get_blob(owner)`` loads a held shard's bytes for sending;
+        ``get_path(owner)`` (optional) names its on-disk file so sends splice
+        file→socket via ``sendfile``. All ranks must call this collectively with
+        the same ``avoid`` set (degraded ranks are deprioritized as senders).
+        Returns the received blob, or ``None``.
         """
         self._ensure_groups()
         gathered = self.comm.all_gather(
@@ -332,8 +416,21 @@ class CliqueReplicationStrategy:
         plan = ExchangePlan.build(wanted, holders, avoid=avoid)
         tag = f"retr/{self._round}"
         self._round += 1
+        sends = []
         for dst, owner in plan.sends.get(self.comm.rank, []):
-            self.exchange.send(dst, f"{tag}/{owner}", get_blob(owner))
+            if get_path is not None:
+                sends.append(
+                    lambda d=dst, o=owner, p=get_path(owner): self.exchange.send_file(
+                        d, f"{tag}/{o}", p
+                    )
+                )
+            else:
+                sends.append(
+                    lambda d=dst, o=owner, b=get_blob(owner): self.exchange.send(
+                        d, f"{tag}/{o}", b
+                    )
+                )
+        _fan_out(sends)
         blob = None
         for src, owner in plan.recvs.get(self.comm.rank, []):
             blob = self.exchange.recv(src, f"{tag}/{owner}")

@@ -44,6 +44,12 @@ DEFAULT_BUCKETS = (
 #: multi-day run; the Prometheus buckets are exact regardless).
 RESERVOIR_SIZE = 8192
 
+#: Bucket bounds (MB/s) for shard-transfer throughput histograms: spans a
+#: congested cross-host DCN link up through loopback/NVMe-class rates.
+THROUGHPUT_BUCKETS_MBPS = (
+    1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 25000,
+)
+
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -364,6 +370,19 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
         reg.counter(
             "tpu_ckpt_save_failures_total", "coverage-failed checkpoint saves"
         ).inc()
+    elif kind == "p2p_transfer":
+        d = str(rec.get("direction", "?"))
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_replication_bytes_total",
+                "checkpoint shard bytes moved over p2p links",
+                direction=d,
+            ).inc(rec["bytes"])
+        if isinstance(rec.get("mbps"), (int, float)):
+            reg.histogram(
+                "tpu_replication_mbps", "p2p shard transfer throughput (MB/s)",
+                THROUGHPUT_BUCKETS_MBPS, direction=d,
+            ).observe(rec["mbps"])
     elif kind == "heartbeat_stats":
         if isinstance(rec.get("max_gap_s"), (int, float)):
             reg.histogram(
