@@ -1,0 +1,277 @@
+"""Benchmark the pipelined snapshot engine: foreground stall vs the sync-D2H path.
+
+Drives the exact save paths a training loop uses, on a loopback clique of
+``--world`` ranks (threads against one KVServer, the repo's standard multi-rank
+harness), at each ``--mb`` tree size:
+
+- **sync**: ``LocalCheckpointManager.save(pipelined=False)`` — the legacy
+  engine: blocking batched ``jax.device_get``, whole-tree serialize, the full
+  replication fan-out, all inside the caller-visible window; only file writes
+  are async.
+- **pipelined**: the snapshot engine — the caller-visible window is enqueue +
+  skeleton pickle; D2H resolution, peer sends, and the shard write stream leaf
+  by leaf in the background out of the pooled staging buffers.
+
+Reported per size: **foreground-blocked ms** (what the train loop feels — the
+time ``save()`` holds the caller) and **end-to-end ms** (save + blocking
+finalize with coverage agreement), max-across-ranks per round, median across
+rounds; plus the staging-pool stats proving the steady-state save allocated
+nothing. A single-rank ``AsyncCheckpointer`` comparison and a steady-state
+tracemalloc probe (peak transient alloc during a warm pipelined save) complete
+the picture.
+
+    python scripts/bench_ckpt_save.py [--mb 256 1024] [--world 3] [--rounds 3] \
+        [--out BENCH_ckpt_save.json]
+    python scripts/bench_ckpt_save.py --smoke   # tiny run + assert spans/metrics
+
+The committed ``BENCH_ckpt_save.json`` comes from the default invocation; the
+slow-marked regression test runs ``--mb 48 --world 2`` and enforces
+``fg_ratio <= 0.25``.
+"""
+
+import argparse
+import concurrent.futures as cf
+import json
+import os
+import platform
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+import tracemalloc
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer  # noqa: E402
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm  # noqa: E402
+from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager  # noqa: E402
+from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy  # noqa: E402
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict  # noqa: E402
+from tpu_resiliency.platform.store import CoordStore, KVServer  # noqa: E402
+
+LEAF_MB = 16
+
+
+def make_tree(mb: int, seed: float):
+    """A checkpoint-shaped tree: 16 MB float32 leaves plus scalar state."""
+    n = max(1, mb // LEAF_MB)
+    leaf = (mb * (1 << 20)) // (4 * n)
+    tree = {
+        "params": {f"w{i}": jnp.full((leaf,), seed + i, jnp.float32) for i in range(n)},
+        "step": int(seed),
+    }
+    jax.block_until_ready(tree)
+    return tree
+
+
+def bench_clique(world: int, mb: int, rounds: int, pipelined: bool, root: str):
+    """Per-round (foreground_s, e2e_s) as max across ranks; returns medians."""
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=300.0)
+        stores.append(s)
+        return s
+
+    staging_stats = {}
+
+    def body(rank):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=300.0)
+        ex = PeerExchange(mk(), rank, timeout=300.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world
+            )
+            mgr = LocalCheckpointManager(
+                root, rank=rank, comm=comm, replication=strat, pipelined=pipelined
+            )
+            tree = make_tree(mb, float(rank))
+            out = []
+            for it in range(1, rounds + 1):
+                sd = PyTreeStateDict(dict(tree, step=it))
+                comm.barrier("round-in")
+                t0 = time.perf_counter()
+                mgr.save(it, sd)
+                fg = time.perf_counter() - t0
+                mgr.maybe_finalize(blocking=True)
+                e2e = time.perf_counter() - t0
+                comm.barrier("round-out")
+                out.append((fg, e2e))
+            if rank == 0:
+                staging_stats.update(mgr.staging.stats())
+            mgr.close()
+            return out
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            per_rank = [
+                f.result(timeout=3600.0)
+                for f in [pool.submit(body, r) for r in range(world)]
+            ]
+    finally:
+        for s in stores:
+            s.close()
+        srv.close()
+    fg_rounds = [max(t[0] for t in rnd) for rnd in zip(*per_rank)]
+    e2e_rounds = [max(t[1] for t in rnd) for rnd in zip(*per_rank)]
+    return (
+        statistics.median(fg_rounds),
+        statistics.median(e2e_rounds),
+        staging_stats,
+    )
+
+
+def bench_checkpointer(mb: int, root: str):
+    """Single-rank AsyncCheckpointer foreground: sync-D2H engine vs pipelined."""
+    out = {}
+    for label, pipelined in (("sync", False), ("pipelined", True)):
+        ckpt = AsyncCheckpointer(pipelined=pipelined)
+        tree = make_tree(mb, 3.0)
+        fgs = []
+        for it in range(3):
+            path = os.path.join(root, f"ckpt_{label}_{it}.ckpt")
+            t0 = time.perf_counter()
+            ckpt.async_save(dict(tree, step=it), path)
+            fgs.append(time.perf_counter() - t0)
+            ckpt.finalize_all()
+        ckpt.close()
+        out[f"{label}_fg_ms"] = round(statistics.median(fgs) * 1e3, 3)
+    return out
+
+
+def steady_state_alloc_probe(mb: int, root: str) -> float:
+    """Peak transient host allocation (MB) during a WARM pipelined save —
+    the staging-pool claim is that this stays under 1 MB at any tree size."""
+    ckpt = AsyncCheckpointer()
+    tree = make_tree(mb, 5.0)
+    for it in range(2):  # warm both double-buffer slots
+        ckpt.async_save(dict(tree, step=it), os.path.join(root, f"warm{it}.ckpt"))
+        ckpt.finalize_all()
+    tracemalloc.start()
+    base, _ = tracemalloc.get_traced_memory()
+    ckpt.async_save(dict(tree, step=9), os.path.join(root, "steady.ckpt"))
+    ckpt.finalize_all()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    ckpt.close()
+    return (peak - base) / (1 << 20)
+
+
+def run_smoke() -> int:
+    """Tiny end-to-end run asserting the new spans/metrics actually appear in
+    the event stream (wired from scripts/smoke_observability.sh)."""
+    from tpu_resiliency.utils import events as events_mod
+    from tpu_resiliency.utils.metrics import aggregate
+
+    captured = []
+    sink = captured.append
+    events_mod.add_sink(sink)
+    root = tempfile.mkdtemp(prefix="ckpt_save_smoke.")
+    try:
+        fg, e2e, staging = bench_clique(2, LEAF_MB, 2, pipelined=True, root=root)
+        records = [
+            {"ts": e.ts, "source": e.source, "kind": e.kind, **e.payload}
+            for e in captured
+        ]
+        kinds = {r["kind"] for r in records}
+        spans = {
+            r.get("span") for r in records if r["kind"] in ("span_begin", "span_end")
+        }
+        assert "ckpt.save.enqueue" in spans, f"missing enqueue span: {sorted(spans)}"
+        assert "ckpt.replicate.fanout" in spans, sorted(spans)
+        assert "ckpt_foreground_blocked" in kinds, sorted(kinds)
+        assert "staging_pool" in kinds, sorted(kinds)
+        assert "ckpt_saved" in kinds, sorted(kinds)
+        reg = aggregate(records)
+        prom = reg.to_prometheus()
+        for metric in (
+            "tpu_ckpt_foreground_blocked_seconds",
+            "tpu_ckpt_staging_pool_bytes",
+            "tpu_ckpt_staging_requests_total",
+        ):
+            assert metric in prom, f"{metric} missing from aggregated metrics"
+        assert staging.get("hits", 0) >= 1, staging
+        print(
+            f"bench_ckpt_save smoke OK: fg={fg*1e3:.2f} ms, e2e={e2e*1e3:.1f} ms, "
+            f"staging={staging}"
+        )
+        return 0
+    finally:
+        events_mod.remove_sink(sink)
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=int, nargs="+", default=[256, 1024],
+                    help="tree sizes (MiB)")
+    ap.add_argument("--world", type=int, default=3, help="clique size")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--out", default=None, help="write results JSON here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny run asserting the new spans/metrics appear")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke()
+
+    sizes = []
+    workdir = tempfile.mkdtemp(prefix="bench_ckpt_save.")
+    try:
+        for mb in args.mb:
+            root_s = os.path.join(workdir, f"sync{mb}")
+            root_p = os.path.join(workdir, f"pipe{mb}")
+            sync_fg, sync_e2e, _ = bench_clique(
+                args.world, mb, args.rounds, pipelined=False, root=root_s
+            )
+            pipe_fg, pipe_e2e, staging = bench_clique(
+                args.world, mb, args.rounds, pipelined=True, root=root_p
+            )
+            sizes.append({
+                "mb": mb,
+                "sync_fg_ms": round(sync_fg * 1e3, 3),
+                "pipelined_fg_ms": round(pipe_fg * 1e3, 3),
+                "fg_ratio": round(pipe_fg / sync_fg, 4),
+                "sync_e2e_ms": round(sync_e2e * 1e3, 1),
+                "pipelined_e2e_ms": round(pipe_e2e * 1e3, 1),
+                "staging": staging,
+            })
+            shutil.rmtree(root_s, ignore_errors=True)
+            shutil.rmtree(root_p, ignore_errors=True)
+        probe_mb = min(args.mb)
+        results = {
+            "world": args.world,
+            "rounds": args.rounds,
+            "sizes": sizes,
+            "checkpointer_256": bench_checkpointer(
+                probe_mb, os.path.join(workdir, "single")
+            ),
+            "steady_state_peak_alloc_mb": round(
+                steady_state_alloc_probe(probe_mb, os.path.join(workdir, "probe")), 3
+            ),
+            "host": platform.node(),
+            "cpus": os.cpu_count(),
+            "python": platform.python_version(),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(results, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+            f.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,4 +61,7 @@ print(f"metrics OK: {len(m)} families, restarts={int(restarts)}")
 PY
 python -m tpu_resiliency.tools.metrics_dump "$EVENTS" | sed 's/^/    /'
 
+echo "== smoke: pipelined checkpoint save (spans + staging metrics)"
+python scripts/bench_ckpt_save.py --smoke
+
 echo "smoke_observability: PASS ($WORKDIR)"

@@ -1,0 +1,160 @@
+"""Host staging buffer pool: signature keying, double-buffer recycling, and the
+zero-large-allocation steady state the pipelined save engine rides on."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.staging import (
+    HostStagingPool,
+    leaf_signature,
+)
+from tpu_resiliency.checkpoint.state_dict import leaf_specs
+from tpu_resiliency.exceptions import CheckpointError
+
+
+def specs_for(*arrays):
+    return leaf_specs(list(arrays))
+
+
+class TestSignature:
+    def test_signature_covers_shape_and_dtype(self):
+        a = specs_for(np.zeros((4, 4), np.float32), np.zeros(3, np.int32))
+        b = specs_for(np.zeros((4, 4), np.float32), np.zeros(3, np.int32))
+        c = specs_for(np.zeros((4, 4), np.float64), np.zeros(3, np.int32))
+        assert leaf_signature(a) == leaf_signature(b)
+        assert leaf_signature(a) != leaf_signature(c)
+
+
+class TestPoolAccounting:
+    def test_first_acquire_is_miss_then_hits(self):
+        pool = HostStagingPool(depth=2)
+        specs = specs_for(np.zeros((8, 8), np.float32))
+        lease = pool.acquire(specs)
+        assert (pool.hits, pool.misses) == (0, 1)
+        lease.release()
+        lease2 = pool.acquire(specs)
+        assert (pool.hits, pool.misses) == (1, 1)
+        # Leased accounting covers payload + alignment padding.
+        assert pool.stats()["in_use_bytes"] >= lease2.nbytes
+        lease2.release()
+        assert pool.stats()["in_use_bytes"] == 0
+
+    def test_steady_state_never_allocates(self):
+        """The acceptance check: after warmup, saves of the same tree signature
+        are pure pool hits — the pool's total byte footprint stops growing."""
+        pool = HostStagingPool(depth=2)
+        specs = specs_for(np.zeros((1 << 18,), np.float32), np.zeros(7, np.int64))
+        # Warmup: both double-buffer slots get allocated.
+        a, b = pool.acquire(specs), pool.acquire(specs)
+        a.release(), b.release()
+        allocated = pool.stats()["total_bytes"]
+        misses = pool.misses
+        for _ in range(6):
+            lease = pool.acquire(specs)
+            lease.release()
+        assert pool.misses == misses, "steady state hit an allocation"
+        assert pool.stats()["total_bytes"] == allocated
+
+    def test_distinct_signatures_pool_separately(self):
+        pool = HostStagingPool(depth=1)
+        s1 = specs_for(np.zeros(4, np.float32))
+        s2 = specs_for(np.zeros(8, np.float32))
+        l1, l2 = pool.acquire(s1), pool.acquire(s2)
+        assert pool.misses == 2
+        l1.release(), l2.release()
+
+    def test_depth_exhaustion_blocks_until_release(self):
+        pool = HostStagingPool(depth=1)
+        specs = specs_for(np.zeros(16, np.float32))
+        lease = pool.acquire(specs)
+        got = []
+
+        def taker():
+            got.append(pool.acquire(specs))
+
+        t = threading.Thread(target=taker, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert not got, "third lease must wait for a release"
+        lease.release()
+        t.join(timeout=5.0)
+        assert got and got[0].nbytes == lease.nbytes
+        got[0].release()
+
+    def test_depth_exhaustion_times_out(self):
+        pool = HostStagingPool(depth=1)
+        specs = specs_for(np.zeros(16, np.float32))
+        pool.acquire(specs)  # never released
+        with pytest.raises(CheckpointError, match="still leased"):
+            pool.acquire(specs, timeout=0.1)
+
+    def test_release_is_idempotent(self):
+        pool = HostStagingPool(depth=2)
+        lease = pool.acquire(specs_for(np.zeros(4, np.float32)))
+        lease.release()
+        lease.release()
+        assert pool.stats()["in_use_bytes"] == 0
+
+    def test_trim_drops_idle_buffers(self):
+        pool = HostStagingPool(depth=2)
+        specs = specs_for(np.zeros((64,), np.float32))
+        pool.acquire(specs).release()
+        assert pool.stats()["total_bytes"] > 0
+        freed = pool.trim()
+        assert freed > 0 and pool.stats()["total_bytes"] == 0
+        # The signature can allocate again after a trim.
+        pool.acquire(specs).release()
+
+
+class TestLeaseViews:
+    def test_fill_round_trips_through_container(self, tmp_path):
+        pool = HostStagingPool()
+        arrays = [
+            np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.arange(5, dtype=np.int64),
+        ]
+        lease = pool.acquire(specs_for(*arrays))
+        for i, a in enumerate(arrays):
+            staged = lease.fill(i, a)
+            np.testing.assert_array_equal(staged, a)
+        # Staged views feed the zero-copy container path unchanged.
+        prefix, views = ckpt_format.serialize_parts(b"h", lease.views)
+        path = str(tmp_path / "staged.ckpt")
+        ckpt_format.write_parts(path, [prefix, *views])
+        hollow, tensors, _ = ckpt_format.read_payload(path)
+        assert hollow == b"h"
+        for got, want in zip(tensors, arrays):
+            np.testing.assert_array_equal(got, want)
+        lease.release()
+
+    def test_fill_bfloat16(self):
+        import jax.numpy as jnp
+
+        arr = np.asarray(jnp.astype(jnp.arange(8), jnp.bfloat16))
+        pool = HostStagingPool()
+        lease = pool.acquire(leaf_specs([arr]))
+        staged = lease.fill(0, arr)
+        np.testing.assert_array_equal(
+            np.asarray(staged, np.float32), np.arange(8, dtype=np.float32)
+        )
+        lease.release()
+
+    def test_fill_rejects_size_mismatch(self):
+        pool = HostStagingPool()
+        lease = pool.acquire(specs_for(np.zeros(8, np.float32)))
+        with pytest.raises(CheckpointError, match="signature says"):
+            lease.fill(0, np.zeros(9, np.float32))
+        lease.release()
+
+    def test_views_are_aligned(self):
+        pool = HostStagingPool()
+        # Odd-sized first leaf must not misalign the second.
+        lease = pool.acquire(specs_for(np.zeros(3, np.int8), np.zeros(4, np.float64)))
+        for v in lease.views:
+            addr = v.__array_interface__["data"][0]
+            assert addr % 64 == 0
+        lease.release()

@@ -32,7 +32,12 @@ from tpu_resiliency.checkpoint.replication import (
     group_sequence_for,
     parse_group_sequence,
 )
-from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict, TensorPlaceholder
+from tpu_resiliency.checkpoint.staging import HostStagingPool, StagingLease
+from tpu_resiliency.checkpoint.state_dict import (
+    HostSnapshot,
+    PyTreeStateDict,
+    TensorPlaceholder,
+)
 
 __all__ = [
     "AsyncCheckpointer",
@@ -50,6 +55,9 @@ __all__ = [
     "ExchangePlan",
     "group_sequence_for",
     "parse_group_sequence",
+    "HostSnapshot",
+    "HostStagingPool",
+    "StagingLease",
     "PyTreeStateDict",
     "TensorPlaceholder",
 ]

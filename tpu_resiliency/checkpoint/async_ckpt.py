@@ -19,6 +19,7 @@ job of orbax-style global checkpointing; local resiliency needs the per-rank for
 
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 import time
@@ -26,11 +27,13 @@ from typing import Any, Optional
 
 from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
+from tpu_resiliency.checkpoint.staging import HostStagingPool
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
 from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.timers import debug_time
+from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
 
@@ -38,41 +41,20 @@ log = get_logger(__name__)
 def _payload_bytes(writes) -> int:
     """Total bytes a write set will put on disk (hollow pickles + tensor data)."""
     total = 0
-    for _, hollow_bytes, tensors, _ in writes:
+    for _, hollow_bytes, tensors, _, _ in writes:
         total += len(hollow_bytes)
         for t in tensors:
             total += int(getattr(t, "nbytes", 0) or 0)
     return total
 
 
-def _write_containers(writes, cleanup=()) -> None:
-    """Async-part worker (module-level: picklable). Order matters for
-    separation_hint pairs: the LAST write's rename is the commit point.
-
-    ``cleanup``: ``(glob_pattern, keep_path)`` pairs processed only AFTER every
-    write committed — prunes superseded token-named hint files. Best-effort: a
-    crash mid-cleanup strands stale files (harmless; next save prunes them),
-    never a loadable generation."""
+def _prune_stale(cleanup) -> None:
+    """``(glob_pattern, keep_path)`` pairs, processed only AFTER every write
+    committed — prunes superseded token-named hint files. Best-effort: a crash
+    mid-cleanup strands stale files (harmless; next save prunes them), never a
+    loadable generation."""
     import glob as _glob
 
-    t0 = time.perf_counter()
-    try:
-        for path, hollow_bytes, tensors, meta in writes:
-            ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
-    except BaseException as e:
-        record_event(
-            "checkpoint", "timing", name="ckpt.async_write",
-            duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
-            bytes=_payload_bytes(writes), files=len(writes),
-        )
-        raise
-    # The background-half latency + volume: with the foreground
-    # ``ckpt.async_save`` timing this decomposes a save end to end.
-    record_event(
-        "checkpoint", "timing", name="ckpt.async_write",
-        duration_s=time.perf_counter() - t0, ok=True,
-        bytes=_payload_bytes(writes), files=len(writes),
-    )
     for pattern, keep in cleanup:
         for stale in _glob.glob(pattern):
             if stale != keep:
@@ -80,6 +62,90 @@ def _write_containers(writes, cleanup=()) -> None:
                     os.unlink(stale)
                 except OSError:
                     pass
+
+
+def _write_containers(writes, cleanup=()) -> None:
+    """Async-part worker (module-level: picklable). Order matters for
+    separation_hint pairs: the LAST write's rename is the commit point.
+
+    Emits one ``ckpt_write_file`` record per container (leaf count + bytes,
+    labeled main/hint) so ``metrics_dump`` can attribute save volume to the
+    separation-hint container vs the main one, plus the aggregate
+    ``ckpt.async_write`` timing."""
+    # One pass up front — the success and failure events report the same
+    # volume, so computing it twice (once per event path) was pure waste.
+    total_bytes = _payload_bytes(writes)
+    t0 = time.perf_counter()
+    try:
+        for path, hollow_bytes, tensors, meta, container in writes:
+            written = ckpt_format.write_payload(path, hollow_bytes, tensors, meta=meta)
+            record_event(
+                "checkpoint", "ckpt_write_file",
+                file=os.path.basename(path), container=container,
+                bytes=written, leaves=len(tensors),
+            )
+    except BaseException as e:
+        record_event(
+            "checkpoint", "timing", name="ckpt.async_write",
+            duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
+            bytes=total_bytes, files=len(writes),
+        )
+        raise
+    # The background-half latency + volume: with the foreground
+    # ``ckpt.async_save`` timing this decomposes a save end to end.
+    record_event(
+        "checkpoint", "timing", name="ckpt.async_write",
+        duration_s=time.perf_counter() - t0, ok=True,
+        bytes=total_bytes, files=len(writes),
+    )
+    _prune_stale(cleanup)
+
+
+def _write_containers_stream(writes, snapshot, cleanup=()) -> None:
+    """Pipelined async-part worker: leaf-STREAMING container writes.
+
+    ``writes`` entries carry leaf INDICES into ``snapshot`` instead of
+    materialized tensors; each leaf hits the file the moment its D2H transfer
+    resolves (``HostSnapshot.resolve_view``), so device copies and disk IO
+    overlap instead of serializing behind a full-tree ``device_get`` barrier.
+    Write order still commits separation-hint pairs correctly (last rename is
+    the commit point). Thread-caller only — the snapshot holds live device
+    references and pool-leased buffers, neither of which crosses a process
+    boundary."""
+    total_bytes = sum(
+        len(hollow_bytes) + sum(snapshot.specs[i]["nbytes"] for i in indices)
+        for _, hollow_bytes, indices, _, _ in writes
+    )
+    t0 = time.perf_counter()
+    try:
+        for path, hollow_bytes, indices, meta, container in writes:
+            prefix = ckpt_format.header_prefix(
+                hollow_bytes, [snapshot.specs[i] for i in indices], meta
+            )
+            written = ckpt_format.write_stream(
+                path,
+                itertools.chain(
+                    (prefix,), (snapshot.resolve_view(i) for i in indices)
+                ),
+            )
+            record_event(
+                "checkpoint", "ckpt_write_file",
+                file=os.path.basename(path), container=container,
+                bytes=written, leaves=len(indices),
+            )
+    except BaseException as e:
+        record_event(
+            "checkpoint", "timing", name="ckpt.async_write",
+            duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
+            bytes=total_bytes, files=len(writes),
+        )
+        raise
+    record_event(
+        "checkpoint", "timing", name="ckpt.async_write",
+        duration_s=time.perf_counter() - t0, ok=True,
+        bytes=total_bytes, files=len(writes),
+    )
+    _prune_stale(cleanup)
 
 
 def _split_hollow(full: dict, tensors: list, hint: str):
@@ -116,29 +182,83 @@ class AsyncCheckpointer:
     ``finalize_all()`` before exit.
     """
 
-    def __init__(self, caller: str = "thread", sync_fn=None):
+    #: Bounded-backoff schedule for :meth:`_serialize_conflicting`: start at
+    #: 1 ms (a local write usually clears within a few), cap at 250 ms so a
+    #: long cross-rank finalize isn't hammered with all-reduces.
+    CONFLICT_BACKOFF_INITIAL = 0.001
+    CONFLICT_BACKOFF_MAX = 0.25
+
+    def __init__(
+        self,
+        caller: str = "thread",
+        sync_fn=None,
+        pipelined: Optional[bool] = None,
+        staging: Optional[HostStagingPool] = None,
+        conflict_timeout: float = 600.0,
+    ):
+        """``pipelined`` (default: auto — on for the thread caller) runs the
+        snapshot engine: ``async_save``'s caller-visible window is enqueue +
+        skeleton pickle; D2H resolution and container writes stream leaf by
+        leaf in the background, staged through ``staging`` (a
+        :class:`HostStagingPool`, created double-buffered when omitted) so
+        steady-state saves allocate no large host buffers. Process/fork
+        callers can't share the snapshot (live device refs + pooled buffers)
+        and keep the materialize-then-schedule path.
+
+        ``conflict_timeout``: seconds :meth:`async_save` will wait for an
+        in-flight save to the same path before raising ``CheckpointError``.
+        """
         self.queue = AsyncCallsQueue(caller=caller, sync_fn=sync_fn)
+        self.pipelined = caller == "thread" if pipelined is None else pipelined
+        if self.pipelined and caller != "thread":
+            raise CheckpointError(
+                "pipelined snapshots require caller='thread' (the snapshot "
+                "holds live device references and pool-leased buffers that "
+                "cannot cross a process boundary)"
+            )
+        self.staging = staging if staging is not None else HostStagingPool()
+        self.conflict_timeout = conflict_timeout
         #: schedule idx → the file paths that save touches. Two in-flight saves
         #: to one path would race on the shared ``.dirty`` tmp file AND the
         #: hint-file cleanup (one save pruning the other's just-written hint),
         #: so overlapping targets serialize on the earlier save.
         self._inflight_paths: dict[int, frozenset] = {}
 
-    def _serialize_conflicting(self, targets: frozenset) -> None:
+    def _serialize_conflicting(
+        self, targets: frozenset, timeout: Optional[float] = None
+    ) -> None:
+        timeout = self.conflict_timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        delay = self.CONFLICT_BACKOFF_INITIAL
         while True:
             live = set(self.queue.unfinalized_indices)
             self._inflight_paths = {
                 i: p for i, p in self._inflight_paths.items() if i in live
             }
-            if not any(targets & paths for paths in self._inflight_paths.values()):
+            conflicting = sorted(
+                set().union(
+                    *(targets & paths for paths in self._inflight_paths.values()),
+                    frozenset(),
+                )
+            )
+            if not conflicting:
                 return
+            if time.monotonic() >= deadline:
+                # A save that can never clear (peer rank dead mid-finalize, a
+                # wedged writer) must surface, not spin the train loop forever.
+                raise CheckpointError(
+                    f"timed out after {timeout:g}s waiting for in-flight save(s) "
+                    f"to finalize before reusing path(s): {conflicting}"
+                )
             self.queue.maybe_finalize_async_calls(blocking=True)
             # One blocking call need not drain: a cross-rank sync_fn vetoes
             # finalization until EVERY rank's write finished, so keep retrying
-            # (briefly backing off the all-reduce) until the conflicting save
-            # is truly gone — scheduling anyway would race on the shared
-            # .dirty tmp file.
-            time.sleep(0.01)
+            # until the conflicting save is truly gone — scheduling anyway
+            # would race on the shared .dirty tmp file. Exponential backoff
+            # (1 ms → 250 ms cap) instead of a hot 10 ms spin: the all-reduce
+            # behind a cross-rank sync_fn is not free to hammer.
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, self.CONFLICT_BACKOFF_MAX)
 
     @staticmethod
     def _hollow_bytes(sd: PyTreeStateDict) -> bytes:
@@ -172,11 +292,24 @@ class AsyncCheckpointer:
         leaves the previous generation's main+hint pair fully loadable — the old
         token-named hint file is pruned only after the new main file committed.
         """
-        # Foreground half (D2H + pickle + conflict serialization + schedule):
-        # the caller-visible stall a train loop pays per save; the background
-        # half is ``ckpt.async_write`` (in ``_write_containers``).
-        with debug_time("ckpt.async_save", source="checkpoint"):
-            return self._async_save(tree, path, meta, rank, separation_hint)
+        # Foreground half: the caller-visible stall a train loop pays per
+        # save. Pipelined, that is enqueue + skeleton pickle + schedule (D2H
+        # resolution happens leaf-streaming in the background); legacy, it
+        # includes the blocking whole-tree D2H. Both are measured here — the
+        # ``ckpt.save.enqueue`` span and ``ckpt_foreground_blocked`` record are
+        # what the foreground-window regression gate and
+        # ``tpu_ckpt_foreground_blocked_seconds`` aggregate.
+        t0 = time.perf_counter()
+        with span("checkpoint", "ckpt.save.enqueue", path=os.path.basename(path)):
+            with debug_time("ckpt.async_save", source="checkpoint"):
+                req = self._async_save(tree, path, meta, rank, separation_hint)
+        record_event(
+            "checkpoint", "ckpt_foreground_blocked",
+            duration_s=time.perf_counter() - t0,
+            engine="pipelined" if self.pipelined else "sync",
+            path=os.path.basename(path),
+        )
+        return req
 
     def _async_save(
         self,
@@ -190,24 +323,34 @@ class AsyncCheckpointer:
             sd = tree
             if not sd.is_hollow:
                 sd.pop_tensors()
-            sd.copy_tensors_to_host()
         else:
             sd = PyTreeStateDict(tree)
             sd.pop_tensors()
+        if self.pipelined:
+            # Enqueue every leaf's D2H without blocking; the background worker
+            # resolves + writes leaf by leaf out of the pooled staging buffers.
+            snapshot = sd.copy_tensors_to_host_async(pool=self.staging)
+            payload = list(range(len(snapshot)))
+        else:
             sd.copy_tensors_to_host()
+            snapshot = None
+            payload = sd.tensors()
         if separation_hint is None:
             writes = [
                 (
                     self._rank_path(path, rank),
                     self._hollow_bytes(sd),
-                    sd.tensors(),
+                    payload,
                     meta or {},
+                    "main",
                 )
             ]
-            req = AsyncRequest(async_fn=_write_containers, async_fn_args=(writes,))
+            cleanup = ()
         else:
             full = sd.hollow_tree
             if not isinstance(full, dict) or separation_hint not in full:
+                if snapshot is not None:
+                    snapshot.release()
                 raise CheckpointError(
                     f"separation_hint {separation_hint!r} is not a top-level "
                     f"mapping key of the tree "
@@ -223,8 +366,10 @@ class AsyncCheckpointer:
             token = secrets.token_hex(8)
             meta_w = {**(meta or {}), "_pair_token": token}
             # Hinted file FIRST: the main file's rename is the commit point.
-            (hint_tree, hint_tensors), (rest_tree, rest_tensors) = _split_hollow(
-                full, sd.tensors(), separation_hint
+            # Splitting over the identity payload (pipelined: leaf indices)
+            # routes each file's leaves without materializing anything.
+            (hint_tree, hint_payload), (rest_tree, rest_payload) = _split_hollow(
+                full, payload, separation_hint
             )
             hint_target = self._rank_path(
                 self._hint_path(path, separation_hint, token), rank
@@ -233,23 +378,37 @@ class AsyncCheckpointer:
                 (
                     hint_target,
                     pickle.dumps(hint_tree, protocol=pickle.HIGHEST_PROTOCOL),
-                    hint_tensors,
+                    hint_payload,
                     meta_w,
+                    "hint",
                 ),
                 (
                     self._rank_path(path, rank),
                     pickle.dumps(rest_tree, protocol=pickle.HIGHEST_PROTOCOL),
-                    rest_tensors,
+                    rest_payload,
                     meta_w,
+                    "main",
                 ),
             ]
             cleanup = ((self._hint_glob(path, separation_hint, rank), hint_target),)
+        if snapshot is not None:
+            req = AsyncRequest(
+                async_fn=_write_containers_stream,
+                async_fn_args=(writes, snapshot, cleanup),
+                cleanup_fns=(snapshot.release,),
+            )
+        else:
             req = AsyncRequest(
                 async_fn=_write_containers, async_fn_args=(writes, cleanup)
             )
         targets = frozenset(w[0] for w in writes)
-        self._serialize_conflicting(targets)
-        idx = self.queue.schedule_async_request(req)
+        try:
+            self._serialize_conflicting(targets)
+            idx = self.queue.schedule_async_request(req)
+        except BaseException:
+            if snapshot is not None:
+                snapshot.release()
+            raise
         self._inflight_paths[idx] = targets
         return req
 
@@ -265,6 +424,7 @@ class AsyncCheckpointer:
                     pickle.dumps(sd.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL),
                     sd.tensors(),
                     meta or {},
+                    "main",
                 )
             ]
         )

@@ -46,6 +46,13 @@ class AsyncRequest:
     background caller; ``finalize_fns`` run synchronously on every rank once all ranks'
     async parts are done; ``preload_fn`` (if any) runs synchronously *before* the async
     part is scheduled (D2H staging).
+
+    ``cleanup_fns`` run in the SAME context as the async part, immediately after
+    it, on success AND on failure — resource reclamation that must not depend on
+    finalization happening (a staging-lease release must fire even when the save
+    failed or the queue was ``abandon``\\ ed, or the pool leaks a full-tree
+    buffer per incident). Process/fork callers require them picklable, like
+    ``async_fn`` itself.
     """
 
     async_fn: Optional[Callable]
@@ -53,16 +60,29 @@ class AsyncRequest:
     async_fn_kwargs: dict = dataclasses.field(default_factory=dict)
     finalize_fns: tuple = ()
     preload_fn: Optional[Callable] = None
+    cleanup_fns: tuple = ()
 
     def add_finalize_fn(self, fn: Callable) -> "AsyncRequest":
         return dataclasses.replace(self, finalize_fns=tuple(self.finalize_fns) + (fn,))
+
+    def run_async_part(self) -> None:
+        """``async_fn`` then ``cleanup_fns`` (unconditionally) — the one body
+        every caller executes in its background context."""
+        try:
+            if self.async_fn is not None:
+                self.async_fn(*self.async_fn_args, **self.async_fn_kwargs)
+        finally:
+            for fn in self.cleanup_fns:
+                try:
+                    fn()
+                except Exception:
+                    log.warning("async-save cleanup_fn failed", exc_info=True)
 
     def execute_sync(self) -> None:
         """Debug/fallback path: run everything inline."""
         if self.preload_fn is not None:
             self.preload_fn()
-        if self.async_fn is not None:
-            self.async_fn(*self.async_fn_args, **self.async_fn_kwargs)
+        self.run_async_part()
         for fn in self.finalize_fns:
             fn()
 
@@ -100,8 +120,7 @@ class ThreadAsyncCaller(AsyncCaller):
 
         def run() -> None:
             try:
-                if req.async_fn is not None:
-                    req.async_fn(*req.async_fn_args, **req.async_fn_kwargs)
+                req.run_async_part()
             except BaseException as e:  # propagated from raise_if_failed
                 self._error = e
 
@@ -129,9 +148,16 @@ def _worker_loop(req_q, done_q) -> None:
         item = req_q.get()
         if item is None:
             return
-        idx, fn, args, kwargs = item
+        idx, fn, args, kwargs, cleanups = item
         try:
-            fn(*args, **kwargs)
+            try:
+                fn(*args, **kwargs)
+            finally:
+                for c in cleanups:
+                    try:
+                        c()
+                    except Exception:
+                        pass
             done_q.put((idx, None))
         except BaseException as e:
             done_q.put((idx, repr(e)))
@@ -163,7 +189,10 @@ class ProcessAsyncCaller(AsyncCaller):
             raise CheckpointError("checkpoint worker process died")
         idx = self._next_idx
         self._next_idx += 1
-        self._req_q.put((idx, req.async_fn, req.async_fn_args, req.async_fn_kwargs))
+        self._req_q.put(
+            (idx, req.async_fn, req.async_fn_args, req.async_fn_kwargs,
+             tuple(req.cleanup_fns))
+        )
         self._pending = idx
 
     def _drain(self, timeout: Optional[float]) -> None:
@@ -261,9 +290,7 @@ class ForkAsyncCaller(AsyncCaller):
             )
         ctx = multiprocessing.get_context("fork")
         self._proc = ctx.Process(
-            target=req.async_fn,
-            args=req.async_fn_args,
-            kwargs=req.async_fn_kwargs,
+            target=req.run_async_part,
             daemon=True,
             name="ckpt-fork-save",
         )

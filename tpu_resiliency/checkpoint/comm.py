@@ -428,6 +428,27 @@ class PeerExchange:
         _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
         return nbytes
 
+    def open_send_stream(self, dst: int, tag: str, nbytes: int) -> "StreamSend":
+        """Open a send whose payload is pushed in chunks as it materializes —
+        the pipelined-save primitive: the bulk preamble (total ``nbytes``)
+        goes out immediately, then each checkpoint leaf hits the socket the
+        moment its D2H transfer lands, overlapping device copies with the wire.
+
+        On a v2 link the chunks stream straight onto the open connection; a v1
+        peer can only accept whole pickled frames, so chunks are buffered and
+        sent as one legacy frame at ``close()`` (compatibility, not speed).
+        Always ``close()`` (success) or ``abort()`` (failure) the handle — an
+        under-sent bulk frame otherwise desyncs the peer's stream."""
+        conn, peer_v = self._dial(dst)
+        use_bulk = self._use_bulk(peer_v)
+        try:
+            if use_bulk:
+                framing.send_bulk_start(conn, {"src": self.rank, "tag": tag}, nbytes)
+        except OSError as e:
+            conn.close()
+            raise CheckpointError(f"p2p: stream open to rank {dst} failed: {e!r}") from e
+        return StreamSend(self, conn, use_bulk, dst, tag, nbytes)
+
     def send_file(self, dst: int, tag: str, path: str) -> int:
         """Stream an on-disk payload to a peer.
 
@@ -532,3 +553,104 @@ class PeerExchange:
         if n:
             log.info(f"p2p: purged {n} stale frame(s) under tag prefix {tag_prefix!r}")
         return n
+
+
+class StreamSend:
+    """One open, chunked payload send (see :meth:`PeerExchange.open_send_stream`).
+
+    ``send_chunk`` pushes raw bytes onto the wire (v2) or buffers them (v1
+    peer). ``close()`` completes the frame — it verifies exactly the promised
+    byte count was sent (an under-sent bulk frame would desync the receiver's
+    stream) and emits the ``p2p_transfer`` event. ``abort()`` tears the
+    connection down so the peer sees EOF instead of a stuck partial frame.
+    """
+
+    def __init__(
+        self,
+        ex: PeerExchange,
+        conn: socket.socket,
+        use_bulk: bool,
+        dst: int,
+        tag: str,
+        nbytes: int,
+    ):
+        self._ex = ex
+        self._conn = conn
+        self._use_bulk = use_bulk
+        self.dst = dst
+        self.tag = tag
+        self.nbytes = nbytes
+        self._sent = 0
+        self._t0 = time.perf_counter()
+        self._chunks: list[bytes] = []  # v1 fallback buffer
+        self._closed = False
+
+    def send_chunk(self, chunk) -> int:
+        v = memoryview(chunk).cast("B")
+        if self._sent + v.nbytes > self.nbytes:
+            raise CheckpointError(
+                f"p2p: stream to rank {self.dst} overran its declared size "
+                f"({self._sent + v.nbytes} > {self.nbytes})"
+            )
+        try:
+            if self._use_bulk:
+                self._conn.sendall(v)
+            else:
+                self._chunks.append(bytes(v))
+        except OSError as e:
+            self.abort()
+            raise CheckpointError(
+                f"p2p: stream send to rank {self.dst} failed: {e!r}"
+            ) from e
+        self._sent += v.nbytes
+        return v.nbytes
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            if self._sent != self.nbytes:
+                raise CheckpointError(
+                    f"p2p: stream to rank {self.dst} closed after {self._sent} of "
+                    f"{self.nbytes} bytes"
+                )
+            if not self._use_bulk:
+                framing.send_obj(
+                    self._conn,
+                    {"src": self._ex.rank, "tag": self.tag,
+                     "blob": b"".join(self._chunks)},
+                )
+        except OSError as e:
+            raise CheckpointError(
+                f"p2p: stream close to rank {self.dst} failed: {e!r}"
+            ) from e
+        finally:
+            self._chunks = []
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+        _transfer_event(
+            "send", self.nbytes, time.perf_counter() - self._t0,
+            dst=self.dst, frame="bulk" if self._use_bulk else "obj",
+        )
+
+    def abort(self) -> None:
+        """Drop the connection mid-frame; the peer's receive loop sees EOF and
+        discards the partial payload."""
+        self._closed = True
+        self._chunks = []
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "StreamSend":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.abort()

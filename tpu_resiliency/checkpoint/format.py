@@ -255,6 +255,32 @@ def read_payload(path: str) -> tuple[bytes, list[np.ndarray], dict]:
     return header["hollow"], tensors, header.get("meta", {})
 
 
+def header_prefix(
+    hollow_bytes: bytes, specs: Sequence[dict], meta: dict | None = None
+) -> bytes:
+    """The ``MAGIC | header_len | header`` container head built from leaf SPECS
+    alone (``{"shape", "dtype", "nbytes"}`` per leaf) — no host arrays needed.
+
+    This is what lets the pipelined save commit to the container layout while
+    every leaf's D2H transfer is still in flight: specs come straight off the
+    device arrays' metadata, the prefix goes out to files and peer streams
+    first, and the payload bytes follow as they resolve."""
+    header = {
+        "hollow": hollow_bytes,
+        "leaves": [
+            {
+                "shape": tuple(s["shape"]),
+                "dtype": str(s["dtype"]),
+                "nbytes": int(s["nbytes"]),
+            }
+            for s in specs
+        ],
+        "meta": meta or {},
+    }
+    header_bytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+    return MAGIC + _LEN.pack(len(header_bytes)) + header_bytes
+
+
 def serialize_parts(
     hollow_bytes: bytes, tensors: Sequence[Any], meta: dict | None = None
 ) -> tuple[bytes, list[memoryview]]:
@@ -269,15 +295,14 @@ def serialize_parts(
     (and unmutated) until the parts are consumed.
     """
     arrays = [_leaf_to_numpy(t) for t in tensors]
-    header = {
-        "hollow": hollow_bytes,
-        "leaves": [
-            {"shape": a.shape, "dtype": _dtype_name(a.dtype), "nbytes": a.nbytes} for a in arrays
+    prefix = header_prefix(
+        hollow_bytes,
+        [
+            {"shape": a.shape, "dtype": _dtype_name(a.dtype), "nbytes": a.nbytes}
+            for a in arrays
         ],
-        "meta": meta or {},
-    }
-    header_bytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
-    prefix = MAGIC + _LEN.pack(len(header_bytes)) + header_bytes
+        meta,
+    )
     return prefix, [_raw_view(a) for a in arrays]
 
 
@@ -293,17 +318,32 @@ def serialize_to_bytes(hollow_bytes: bytes, tensors: Sequence[Any], meta: dict |
     return b"".join([prefix, *views])
 
 
-def write_parts(path: str, parts: Sequence[Any], fsync: bool = True) -> int:
-    """Atomically stream already-serialized container parts to ``path`` — the
-    ``.dirty``-then-rename protocol of :func:`write_blob` without requiring a
-    joined blob (a receive buffer, a :func:`serialize_parts` result, or any mix
-    of bytes-likes). Returns bytes written."""
+def _chunk_view(chunk: Any) -> memoryview:
+    """Flat uint8 view of any stream chunk — bytes-likes directly, numpy arrays
+    through the extension-dtype-safe reinterpret (bfloat16 has no buffer
+    protocol)."""
+    if isinstance(chunk, np.ndarray):
+        return _raw_view(chunk)
+    return memoryview(chunk).cast("B")
+
+
+def write_stream(path: str, chunks, fsync: bool = True) -> int:
+    """Atomically stream container chunks to ``path`` as they become available.
+
+    ``chunks`` is any iterable of bytes-likes or numpy arrays — typically a
+    header prefix followed by leaves resolving off the D2H queue, which is how
+    the pipelined save overlaps disk IO with the device transfers: each leaf
+    hits the file the moment its DMA lands, not after a full-tree barrier.
+    Same ``.dirty``-then-rename commit as every other writer: a producer
+    raising mid-stream leaves only the ``.dirty`` temp file (the crash contract
+    startup cleanup already handles), never a torn visible container. Returns
+    bytes written."""
     tmp = path + DIRTY_SUFFIX
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     written = 0
     with open(tmp, "wb") as f:
-        for p in parts:
-            v = memoryview(p).cast("B")
+        for chunk in chunks:
+            v = _chunk_view(chunk)
             f.write(v)
             written += v.nbytes
         f.flush()
@@ -311,6 +351,14 @@ def write_parts(path: str, parts: Sequence[Any], fsync: bool = True) -> int:
             os.fsync(f.fileno())
     _commit_atomic(tmp, path, fsync)
     return written
+
+
+def write_parts(path: str, parts: Sequence[Any], fsync: bool = True) -> int:
+    """Atomically stream already-serialized container parts to ``path`` — the
+    ``.dirty``-then-rename protocol of :func:`write_blob` without requiring a
+    joined blob (a receive buffer, a :func:`serialize_parts` result, or any mix
+    of bytes-likes). Returns bytes written."""
+    return write_stream(path, parts, fsync=fsync)
 
 
 def deserialize_from_buffer(buf) -> tuple[bytes, list[np.ndarray], dict]:

@@ -23,16 +23,19 @@ import dataclasses
 import os
 import re
 import shutil
+import time
 from typing import Any, Optional
 
 from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
 from tpu_resiliency.checkpoint.comm import StoreComm
 from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
+from tpu_resiliency.checkpoint.staging import HostStagingPool
 from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
 from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.timers import debug_time
+from tpu_resiliency.utils.tracing import span
 from tpu_resiliency.utils.logging import get_logger
 
 import pickle
@@ -106,6 +109,8 @@ class LocalCheckpointManager:
         comm: Optional[StoreComm] = None,
         replication: Optional[CliqueReplicationStrategy] = None,
         caller: str = "thread",
+        pipelined: Optional[bool] = None,
+        staging: Optional[HostStagingPool] = None,
     ):
         self.root = root
         self.rank = rank
@@ -113,6 +118,17 @@ class LocalCheckpointManager:
         self.comm = comm
         self.replication = replication
         self._caller_kind = caller
+        #: Pipelined snapshot engine (default: on for the thread caller): the
+        #: caller-visible window of an async save is enqueue + skeleton pickle;
+        #: D2H resolution, the replication fan-out, and the shard write all
+        #: stream leaf by leaf in the background, staged through the pool.
+        self.pipelined = caller == "thread" if pipelined is None else pipelined
+        if self.pipelined and caller != "thread":
+            raise CheckpointError(
+                "pipelined saves require caller='thread' (the snapshot holds "
+                "live device references and pool-leased buffers)"
+            )
+        self.staging = staging if staging is not None else HostStagingPool()
         self.queue = AsyncCallsQueue(
             caller=caller, sync_fn=comm.make_sync_fn() if comm is not None else None
         )
@@ -153,10 +169,129 @@ class LocalCheckpointManager:
     ) -> Optional[AsyncRequest]:
         """Replicate + persist this rank's shard for ``iteration``.
 
-        Synchronous on the caller: pop tensors → one batched D2H → clique exchange
-        (host TCP). Asynchronous: file writes. Finalization (all ranks): coverage
-        verification + pruning of older iterations (``base_manager.py:236-318``).
+        Pipelined (default, async + thread caller): synchronous on the caller
+        is only enqueue-D2H + skeleton pickle + replication-round bookkeeping;
+        the background worker resolves each leaf as its DMA lands and streams
+        it simultaneously to the local shard file and every clique peer — D2H,
+        disk IO, and peer sockets overlap leaf by leaf. Legacy (sync saves,
+        process/fork callers): pop tensors → one blocking batched D2H → clique
+        exchange → async file writes. Finalization (all ranks) is identical:
+        coverage verification + pruning of older iterations
+        (``base_manager.py:236-318``).
         """
+        if self.pipelined and is_async:
+            return self._save_pipelined(iteration, state_dict, meta)
+        return self._save_materialized(iteration, state_dict, is_async, meta)
+
+    def _save_pipelined(
+        self, iteration: int, state_dict: PyTreeStateDict, meta: Optional[dict]
+    ) -> AsyncRequest:
+        t0 = time.perf_counter()
+        with span("checkpoint", "ckpt.save.enqueue", iteration=iteration):
+            if not state_dict.is_hollow:
+                state_dict.pop_tensors()
+            snapshot = state_dict.copy_tensors_to_host_async(pool=self.staging)
+            hollow_bytes = pickle.dumps(
+                state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
+            )
+            prefix = ckpt_format.header_prefix(
+                hollow_bytes, snapshot.specs,
+                meta={"iteration": iteration, **(meta or {})},
+            )
+            total = len(prefix) + snapshot.nbytes
+            # Round tag minted HERE, in save-call order, so concurrent
+            # background rounds stay aligned across ranks.
+            stream = (
+                self.replication.start_stream(total)
+                if self.replication is not None and self.replication.enabled
+                else None
+            )
+            own_path = self._path(CkptID(iteration, self.rank, self.session))
+            # The worker fills in the final on-disk volume (own shard +
+            # received mirrors); finalize reads it after the async part is done.
+            sizes: dict = {}
+            req = AsyncRequest(
+                async_fn=self._pipelined_worker,
+                async_fn_args=(own_path, prefix, snapshot, stream, iteration, sizes),
+                cleanup_fns=(snapshot.release,),
+                finalize_fns=(
+                    lambda: self._finalize_save(iteration, sizes.get("bytes")),
+                ),
+            )
+            try:
+                self.queue.schedule_async_request(req)
+            except BaseException:
+                snapshot.release()
+                if stream is not None:
+                    stream.abort()
+                raise
+        record_event(
+            "checkpoint", "ckpt_foreground_blocked",
+            duration_s=time.perf_counter() - t0,
+            engine="pipelined", iteration=iteration,
+        )
+        return req
+
+    def _pipelined_worker(
+        self, own_path: str, prefix: bytes, snapshot, stream, iteration: int,
+        sizes: dict,
+    ) -> None:
+        """Background half of a pipelined save: one pass over the leaves in
+        D2H order, each resolved leaf going to the local shard file and every
+        clique peer before the next is touched."""
+        t0 = time.perf_counter()
+        total = len(prefix) + snapshot.nbytes
+        try:
+            if stream is not None:
+                stream.open()
+
+            def chunks():
+                if stream is not None:
+                    stream.send_chunk(prefix)
+                yield prefix
+                for i in range(len(snapshot)):
+                    view = snapshot.resolve_view(i)
+                    if stream is not None:
+                        stream.send_chunk(view)
+                    yield view
+
+            ckpt_format.write_stream(own_path, chunks())
+            received = stream.finish() if stream is not None else {}
+            mirror_writes = [
+                (self._path(CkptID(iteration, owner, self.session)), blob)
+                for owner, blob in received.items()
+            ]
+            if mirror_writes:
+                _write_blobs(mirror_writes)
+        except BaseException as e:
+            if stream is not None:
+                stream.abort()
+            record_event(
+                "checkpoint", "timing", name="ckpt.save.stream",
+                duration_s=time.perf_counter() - t0, ok=False, error=repr(e),
+                bytes=total, files=1,
+            )
+            raise
+        sizes["bytes"] = total + sum(
+            memoryview(b).cast("B").nbytes for b in received.values()
+        )
+        # The whole pipelined background half (d2h-resolve + fan-out + writes):
+        # with the foreground ``ckpt.save.enqueue`` span this decomposes a
+        # pipelined save end to end; mirror writes inside still emit their own
+        # ``ckpt.save.write``.
+        record_event(
+            "checkpoint", "timing", name="ckpt.save.stream",
+            duration_s=time.perf_counter() - t0, ok=True,
+            bytes=sizes["bytes"], files=1 + len(received),
+        )
+
+    def _save_materialized(
+        self,
+        iteration: int,
+        state_dict: PyTreeStateDict,
+        is_async: bool,
+        meta: Optional[dict],
+    ) -> Optional[AsyncRequest]:
         with debug_time("ckpt.save.d2h", source="checkpoint"):
             if not state_dict.is_hollow:
                 state_dict.pop_tensors()

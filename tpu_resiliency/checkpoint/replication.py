@@ -384,6 +384,28 @@ class CliqueReplicationStrategy:
                     f.result()
         return received
 
+    def start_stream(self, nbytes: int) -> "ReplicationStream":
+        """Foreground half of a leaf-streaming replication round.
+
+        Allocates the round tag (call ORDER is the cross-rank agreement — do
+        this on the caller thread, in save order, before handing the stream to
+        a background worker; concurrent background rounds then stay aligned
+        across ranks because their tags were minted in matching order) and
+        captures the clique fan-out. ``nbytes`` is the total container size,
+        known from the leaf specs before any D2H byte lands. All transfer work
+        happens on the returned :class:`ReplicationStream`; with replication
+        disabled or no peers it is an inert no-op handle.
+        """
+        self._ensure_groups()
+        rank = self.comm.rank
+        if not self.enabled:
+            return ReplicationStream(self, None, [], nbytes, -1)
+        tag = f"repl/{self._round}"
+        rnd = self._round
+        self._round += 1
+        peers = [p for p in self.my_group if p != rank]
+        return ReplicationStream(self, tag, peers, nbytes, rnd)
+
     def _ensure_groups(self) -> None:
         """Hook for the lazy subclass; the eager strategy's groups always exist."""
 
@@ -435,6 +457,108 @@ class CliqueReplicationStrategy:
         for src, owner in plan.recvs.get(self.comm.rank, []):
             blob = self.exchange.recv(src, f"{tag}/{owner}")
         return blob
+
+
+class ReplicationStream:
+    """One in-flight leaf-streaming replication round (see
+    :meth:`CliqueReplicationStrategy.start_stream`).
+
+    ``open()`` dials every clique peer and sends the bulk preambles;
+    ``send_chunk(view)`` fans one resolved leaf out to all peers concurrently
+    (per-chunk thread fan-out keeps per-peer byte order while overlapping the
+    wires); ``finish()`` closes the sends, drains the matching receives, and
+    returns ``{peer_owner: payload}`` exactly like ``replicate_parts``. The
+    whole object lives on the background save thread after ``start_stream``
+    minted its tag on the caller thread.
+    """
+
+    def __init__(self, strategy, tag, peers: Sequence[int], nbytes: int, rnd: int):
+        self._strategy = strategy
+        self.tag = tag
+        self.peers = list(peers)
+        self.nbytes = nbytes
+        self._round = rnd
+        self._streams: list = []
+        self._pool = None
+        self._span = None
+
+    @property
+    def active(self) -> bool:
+        return bool(self.peers) and self.tag is not None
+
+    def open(self) -> "ReplicationStream":
+        if not self.active:
+            return self
+        self._span = span(
+            "checkpoint", "ckpt.replicate.fanout",
+            round=self._round, peers=len(self.peers), bytes=self.nbytes,
+            streaming=True,
+        )
+        self._span.__enter__()
+        try:
+            ex = self._strategy.exchange
+            self._streams = [
+                ex.open_send_stream(p, self.tag, self.nbytes) for p in self.peers
+            ]
+            if len(self._streams) > 1:
+                self._pool = cf.ThreadPoolExecutor(max_workers=len(self._streams))
+        except BaseException as e:
+            self._teardown(e)
+            raise
+        return self
+
+    def send_chunk(self, view) -> None:
+        if not self._streams:
+            return
+        try:
+            if self._pool is None:
+                self._streams[0].send_chunk(view)
+            else:
+                # One leaf, all peers at once; waiting per chunk preserves each
+                # peer's byte order while the wires overlap.
+                for f in [
+                    self._pool.submit(s.send_chunk, view) for s in self._streams
+                ]:
+                    f.result()
+        except BaseException as e:
+            self._teardown(e)
+            raise
+
+    def finish(self) -> dict[int, Any]:
+        """Complete sends, collect every peer's mirror; returns {owner: payload}."""
+        if not self.active:
+            return {}
+        received: dict[int, Any] = {}
+        try:
+            for s in self._streams:
+                s.close()
+            for peer in self.peers:
+                received[peer] = self._strategy.exchange.recv(peer, self.tag)
+        except BaseException as e:
+            self._teardown(e)
+            raise
+        self._teardown(None)
+        return received
+
+    def abort(self) -> None:
+        self._teardown(RuntimeError("replication stream aborted"))
+
+    def _teardown(self, exc) -> None:
+        for s in self._streams:
+            try:
+                s.abort()
+            except Exception:
+                pass
+        self._streams = []
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        if self._span is not None:
+            sp, self._span = self._span, None
+            if exc is None:
+                sp.__exit__(None, None, None)
+            else:
+                sp.__exit__(type(exc), exc, None)
 
 
 class LazyCliqueReplicationStrategy(CliqueReplicationStrategy):

@@ -1,0 +1,229 @@
+"""Host staging buffer pool for pipelined checkpoint snapshots.
+
+Every async save needs a full-model-size set of host arrays to land the D2H
+copies in. Allocating them fresh per save (what ``jax.device_get`` does) costs
+an allocator round trip plus first-touch page faults over the whole payload on
+EVERY checkpoint interval — the reference amortizes this with pinned-memory
+tensors it reuses across saves (``checkpointing/utils.py:85``). This pool is
+the TPU-host analogue: buffers are keyed by the tree's **leaf signature**
+(shape/dtype per leaf, in pop order) and recycled across saves, so the
+steady-state save performs no large host allocations at all.
+
+Double buffering is the default (``depth=2``): save N+1 can acquire a second
+buffer set while save N's background half is still writing/replicating out of
+the first, so the train loop never waits on the previous save's IO to reclaim
+staging memory. A third concurrent save of the same signature blocks in
+``acquire`` until a lease frees — bounding staging memory at
+``depth × tree_bytes`` instead of growing with queue depth.
+
+Leaf views are aligned, typed numpy windows over one contiguous backing
+``bytearray`` per lease, ready to feed the zero-copy
+``format.serialize_parts`` / ``PeerExchange.send_parts`` path without any
+fresh per-leaf arrays. Pool traffic is narrated to the event stream
+(``staging_pool`` records → ``tpu_ckpt_staging_pool_bytes`` gauge and
+``tpu_ckpt_staging_requests_total{outcome}``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.events import record as record_event
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: Leaf offsets within a lease's backing buffer are rounded up to this, so every
+#: staged view is cacheline/SIMD aligned regardless of its neighbors' sizes.
+_ALIGN = 64
+
+
+def leaf_signature(specs: Sequence[dict]) -> tuple:
+    """Hashable pool key for a leaf-spec list (shape + dtype per leaf, in order)."""
+    return tuple((tuple(s["shape"]), str(s["dtype"]), int(s["nbytes"])) for s in specs)
+
+
+def _aligned(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class StagingLease:
+    """One leased buffer set: typed views + raw uint8 windows over one backing
+    bytearray. Release returns it to the pool (idempotent); the views must not
+    be used after release — the next save of the same signature will overwrite
+    them."""
+
+    def __init__(self, pool: "HostStagingPool", key: tuple, backing: np.ndarray):
+        from tpu_resiliency.checkpoint.format import resolve_dtype
+
+        self._pool = pool
+        self.key = key
+        self._backing = backing
+        self.views: list[np.ndarray] = []
+        self.raw_views: list[memoryview] = []
+        # The backing allocation's payload is not 64-aligned; skew the first
+        # offset so every leaf view lands on an aligned ADDRESS (the buffer is
+        # overallocated by one alignment quantum for exactly this).
+        base_addr = backing.__array_interface__["data"][0]
+        mv = memoryview(backing)
+        off = (-base_addr) % _ALIGN
+        for shape, dtype, nbytes in key:
+            window = mv[off : off + nbytes]
+            self.raw_views.append(window)
+            self.views.append(
+                np.frombuffer(window, dtype=resolve_dtype(dtype)).reshape(shape)
+            )
+            off += _aligned(nbytes)
+        self.nbytes = sum(n for _, _, n in key)
+        self._released = False
+
+    def fill(self, index: int, arr: Any) -> np.ndarray:
+        """Copy one host leaf into its staged window; returns the staged typed
+        view. Same-dtype copies go through ``np.copyto`` — numpy's raw array
+        assignment drops the GIL for the memcpy, so background staging never
+        stalls the train-loop thread — with a raw uint8 fallback for any
+        dtype/layout combination numpy refuses."""
+        src = np.asarray(arr)
+        dst = self.views[index]
+        if src.nbytes != dst.nbytes:
+            raise CheckpointError(
+                f"staging lease leaf {index}: got {src.nbytes} B, "
+                f"signature says {dst.nbytes} B"
+            )
+        try:
+            np.copyto(dst, src, casting="no")
+        except (TypeError, ValueError):
+            from tpu_resiliency.checkpoint.format import _raw_view
+
+            self.raw_views[index][:] = _raw_view(src)
+        return dst
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._pool._release(self.key, self._backing)
+
+
+class HostStagingPool:
+    """Signature-keyed pool of reusable host snapshot buffers.
+
+    ``acquire(specs)`` returns a :class:`StagingLease` — a pooled buffer on a
+    hit, a freshly allocated one while fewer than ``depth`` leases of that
+    signature exist, and otherwise blocks until a lease releases (``timeout``
+    seconds, then :class:`CheckpointError`). Thread-safe; leases release from
+    background writer threads.
+    """
+
+    def __init__(self, depth: int = 2, timeout: float = 600.0):
+        if depth < 1:
+            raise ValueError("staging pool depth must be >= 1")
+        self.depth = depth
+        self.timeout = timeout
+        self._cond = threading.Condition()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._count: dict[tuple, int] = {}
+        #: cumulative stats — the pool-hit acceptance check reads these
+        self.hits = 0
+        self.misses = 0
+        self.total_bytes = 0
+        self.in_use_bytes = 0
+
+    def _lease_bytes(self, key: tuple) -> int:
+        # One extra alignment quantum: the lease skews its first offset so leaf
+        # views sit on 64-aligned addresses regardless of the bytearray's base.
+        return sum(_aligned(n) for _, _, n in key) + _ALIGN
+
+    def acquire(
+        self, specs: Sequence[dict], timeout: Optional[float] = None
+    ) -> StagingLease:
+        key = leaf_signature(specs)
+        need = self._lease_bytes(key)
+        deadline = None
+        outcome = "hit"
+        with self._cond:
+            while True:
+                free = self._free.get(key)
+                if free:
+                    backing = free.pop()
+                    break
+                if self._count.get(key, 0) < self.depth:
+                    # np.empty, not bytearray: no O(bytes) zeroing on the miss
+                    # path (pages fault in lazily as fill() first touches
+                    # them). Misses run once per signature per depth slot —
+                    # never steady state.
+                    backing = np.empty(need, dtype=np.uint8)
+                    self._count[key] = self._count.get(key, 0) + 1
+                    self.total_bytes += need
+                    outcome = "miss"
+                    break
+                if deadline is None:
+                    import time as _time
+
+                    deadline = _time.monotonic() + (
+                        self.timeout if timeout is None else timeout
+                    )
+                    outcome = "wait"
+                import time as _time
+
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise CheckpointError(
+                        f"staging pool: all {self.depth} buffer(s) for this tree "
+                        f"signature still leased after {self.timeout if timeout is None else timeout}s "
+                        f"(previous saves' background halves have not released)"
+                    )
+                self._cond.wait(timeout=min(remaining, 1.0))
+            if outcome == "miss":
+                self.misses += 1
+            else:
+                self.hits += 1
+            self.in_use_bytes += need
+            pool_bytes, in_use = self.total_bytes, self.in_use_bytes
+        record_event(
+            "checkpoint", "staging_pool",
+            outcome=outcome, nbytes=need, pool_bytes=pool_bytes,
+            in_use_bytes=in_use,
+        )
+        return StagingLease(self, key, backing)
+
+    def _release(self, key: tuple, backing: np.ndarray) -> None:
+        with self._cond:
+            self._free.setdefault(key, []).append(backing)
+            self.in_use_bytes -= self._lease_bytes(key)
+            pool_bytes, in_use = self.total_bytes, self.in_use_bytes
+            self._cond.notify_all()
+        record_event(
+            "checkpoint", "staging_pool",
+            outcome="release", nbytes=self._lease_bytes(key),
+            pool_bytes=pool_bytes, in_use_bytes=in_use,
+        )
+
+    def trim(self) -> int:
+        """Drop every idle buffer (e.g. after the tree signature changed for
+        good — a resharding restart). Returns bytes freed; leased buffers are
+        untouched and rejoin the pool on release."""
+        with self._cond:
+            freed = 0
+            for key, bufs in self._free.items():
+                freed += self._lease_bytes(key) * len(bufs)
+                self._count[key] = self._count.get(key, 0) - len(bufs)
+            self._free.clear()
+            self.total_bytes -= freed
+        if freed:
+            log.info(f"staging pool trimmed {freed} idle bytes")
+        return freed
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "total_bytes": self.total_bytes,
+                "in_use_bytes": self.in_use_bytes,
+                "signatures": len(self._count),
+            }

@@ -61,6 +61,92 @@ def _is_array(leaf: Any) -> bool:
     return isinstance(leaf, (jax.Array, np.ndarray)) and not np.isscalar(leaf)
 
 
+def leaf_specs(tensors: Sequence[Any]) -> list[dict]:
+    """Container-format leaf specs (shape/dtype/nbytes) straight from device
+    arrays — no host copy, no blocking: the pipelined save pickles the header
+    and sizes the staging lease before any D2H byte has landed."""
+    specs = []
+    for t in tensors:
+        dt = np.dtype(t.dtype)
+        nbytes = int(np.prod(t.shape, dtype=np.int64)) * dt.itemsize
+        specs.append({"shape": tuple(t.shape), "dtype": dt.name, "nbytes": nbytes})
+    return specs
+
+
+class HostSnapshot:
+    """Leaf-by-leaf D2H resolver: the handle the pipelined save's background
+    half consumes.
+
+    Created by :meth:`PyTreeStateDict.copy_tensors_to_host_async`, which has
+    already enqueued every leaf's ``copy_to_host_async()`` — all DMAs are in
+    flight before this object reaches the background thread. ``resolve(i)``
+    blocks only until leaf ``i``'s transfer lands (the analogue of the
+    reference's per-tensor pinned-memory D2H events), stages it into the
+    pooled lease when one is attached, and drops the device reference so
+    device memory frees as the pipeline advances. Single-consumer: the
+    background writer resolves leaves in order; no internal locking.
+    """
+
+    def __init__(self, tensors: Sequence[Any], pool: Any = None):
+        self._tensors: list = list(tensors)
+        self.specs = leaf_specs(self._tensors)
+        self.nbytes = sum(s["nbytes"] for s in self.specs)
+        #: Lease acquisition is LAZY (first resolve, i.e. on the background
+        #: thread): the foreground enqueue path never pays the miss-path
+        #: allocation, nor blocks when both double-buffer slots are still
+        #: leased to earlier saves' background halves.
+        self._pool = pool
+        self._lease = None
+        self._released = False
+        self._resolved: list[Optional[np.ndarray]] = [None] * len(self._tensors)
+
+    def __len__(self) -> int:
+        return len(self._resolved)
+
+    def _ensure_lease(self):
+        if self._lease is None and self._pool is not None and not self._released:
+            self._lease = self._pool.acquire(self.specs)
+        return self._lease
+
+    def resolve(self, i: int) -> np.ndarray:
+        """Materialize leaf ``i`` on host (blocking only on ITS transfer)."""
+        out = self._resolved[i]
+        if out is None:
+            t = self._tensors[i]
+            lease = self._ensure_lease()
+            if lease is not None:
+                out = lease.fill(i, t)
+            else:
+                out = np.asarray(t)
+            self._resolved[i] = out
+            self._tensors[i] = None
+        return out
+
+    def resolve_view(self, i: int) -> memoryview:
+        """Leaf ``i`` as the flat uint8 window writers and senders consume."""
+        self.resolve(i)
+        if self._lease is not None:
+            return self._lease.raw_views[i]
+        from tpu_resiliency.checkpoint.format import _raw_view
+
+        return _raw_view(self._resolved[i])
+
+    def resolve_all(self) -> list[np.ndarray]:
+        return [self.resolve(i) for i in range(len(self))]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.resolve(i)
+
+    def release(self) -> None:
+        """Return the staging lease to its pool (idempotent). Call only after
+        every consumer (file writer, peer sends) is done with the views."""
+        self._released = True
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+
+
 class PyTreeStateDict:
     """A pytree with pop/insert tensor semantics for local checkpointing.
 
@@ -183,6 +269,33 @@ class PyTreeStateDict:
         self._shardings = [getattr(t, "sharding", None) for t in self._tensors]
         # device_get on the whole list queues all transfers before blocking on any.
         self._tensors = [np.asarray(x) for x in jax.device_get(self._tensors)]
+
+    def copy_tensors_to_host_async(self, pool: Any = None) -> HostSnapshot:
+        """Non-blocking counterpart of :meth:`copy_tensors_to_host`: enqueue
+        every leaf's D2H DMA and return a :class:`HostSnapshot` that resolves
+        leaves as their transfers complete.
+
+        The caller-visible cost is "enqueue": one ``copy_to_host_async()`` call
+        per leaf (microseconds) instead of one barrier over the whole payload.
+        ``pool`` (a :class:`~tpu_resiliency.checkpoint.staging.HostStagingPool`)
+        stages resolved leaves into recycled buffers so steady-state saves
+        allocate nothing large; the lease is acquired lazily at first resolve
+        (on the background thread) and the snapshot owns it — ``release()``
+        when the background half is done. ``self`` keeps its device tensors
+        untouched (shardings are recorded for a later restore)."""
+        if self._tensors is None:
+            raise CheckpointError("pop_tensors() before copy_tensors_to_host_async()")
+        self._shardings = [getattr(t, "sharding", None) for t in self._tensors]
+        for t in self._tensors:
+            start = getattr(t, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    # Enqueue is an optimization; resolve() still blocks
+                    # correctly on backends without the async entry point.
+                    pass
+        return HostSnapshot(self._tensors, pool=pool)
 
     def _align_shardings_pytree(self, shardings) -> list:
         """Flatten a shardings pytree that mirrors the saved tree's structure into a

@@ -94,7 +94,13 @@ class HierarchicalCheckpointCallback(Callback):
             return
         sd = PyTreeStateDict(self.to_state_dict(state))
         sd.pop_tensors()
-        sd.copy_tensors_to_host()
+        if local_due and global_due:
+            # Both tiers consume the same payload: one shared blocking D2H
+            # beats two independent async snapshots of the same tree.
+            sd.copy_tensors_to_host()
+        # Single-tier steps hand the device tensors straight to the engine —
+        # pipelined savers enqueue their own async D2H, so the loop never
+        # blocks on the copy.
         if local_due:
             self.local_manager.save(step, sd, is_async=True)
         if global_due:

@@ -184,6 +184,21 @@ def send_bulk(sock: socket.socket, header: dict, parts: Sequence[Any]) -> int:
     return nbytes
 
 
+def send_bulk_start(sock: socket.socket, header: dict, nbytes: int) -> None:
+    """Open a bulk frame whose payload will be streamed in chunks.
+
+    Sends the preamble (magic + pickled ``header`` stamped with the TOTAL
+    ``nbytes``) and returns; the caller then pushes exactly ``nbytes`` payload
+    bytes with plain ``sendall`` as they become available — e.g. checkpoint
+    leaves resolving off the D2H queue. The receiver cannot tell a streamed
+    frame from a :func:`send_bulk` one: ``recv_any`` just fills its buffer as
+    bytes arrive, so the two ends pipeline naturally. Under-sending desyncs
+    the stream — the caller must either complete the payload or close the
+    socket (the receiver sees EOF and drops the frame)."""
+    pre, _ = _bulk_preamble(header, nbytes)
+    sock.sendall(pre)
+
+
 def send_bulk_file(
     sock: socket.socket,
     header: dict,

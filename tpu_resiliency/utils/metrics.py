@@ -50,6 +50,14 @@ THROUGHPUT_BUCKETS_MBPS = (
     1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 25000,
 )
 
+#: Bucket bounds (seconds) for the checkpoint foreground-blocked window: the
+#: pipelined engine targets sub-millisecond, the legacy blocking D2H path sits
+#: in the tens-of-ms-to-seconds range — both must resolve on one histogram.
+FOREGROUND_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -370,6 +378,46 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
         reg.counter(
             "tpu_ckpt_save_failures_total", "coverage-failed checkpoint saves"
         ).inc()
+    elif kind == "ckpt_foreground_blocked":
+        if isinstance(rec.get("duration_s"), (int, float)):
+            reg.histogram(
+                "tpu_ckpt_foreground_blocked_seconds",
+                "caller-visible train-loop stall per checkpoint save",
+                FOREGROUND_BUCKETS_S, engine=str(rec.get("engine", "?")),
+            ).observe(rec["duration_s"])
+    elif kind == "staging_pool":
+        if isinstance(rec.get("pool_bytes"), (int, float)):
+            reg.gauge(
+                "tpu_ckpt_staging_pool_bytes",
+                "host staging buffer pool size (allocated bytes)",
+            ).set(rec["pool_bytes"])
+        if isinstance(rec.get("in_use_bytes"), (int, float)):
+            reg.gauge(
+                "tpu_ckpt_staging_inuse_bytes",
+                "host staging bytes currently leased to in-flight saves",
+            ).set(rec["in_use_bytes"])
+        outcome = rec.get("outcome")
+        if outcome in ("hit", "miss", "wait"):
+            reg.counter(
+                "tpu_ckpt_staging_requests_total",
+                "staging lease acquisitions by outcome",
+                outcome=str(outcome),
+            ).inc()
+    elif kind == "ckpt_write_file":
+        container = str(rec.get("container", "?"))
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_write_bytes_total",
+                "container bytes written by content class (main vs "
+                "separation-hint file)",
+                container=container,
+            ).inc(rec["bytes"])
+        if isinstance(rec.get("leaves"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_write_leaves_total",
+                "tensor leaves written by content class",
+                container=container,
+            ).inc(rec["leaves"])
     elif kind == "p2p_transfer":
         d = str(rec.get("direction", "?"))
         if isinstance(rec.get("bytes"), (int, float)):
