@@ -64,4 +64,7 @@ python -m tpu_resiliency.tools.metrics_dump "$EVENTS" | sed 's/^/    /'
 echo "== smoke: pipelined checkpoint save (spans + staging metrics)"
 python scripts/bench_ckpt_save.py --smoke
 
+echo "== smoke: chaos (seeded fault injection across store/p2p/ipc channels)"
+python scripts/chaos_soak.py --smoke
+
 echo "smoke_observability: PASS ($WORKDIR)"

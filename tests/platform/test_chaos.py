@@ -1,0 +1,208 @@
+"""Chaos layer unit tests: plan grammar, deterministic scheduling, socket
+wrapper fault semantics, env wiring."""
+
+import os
+import socket
+import threading
+
+import pytest
+
+from tpu_resiliency.platform import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear_plan()
+    yield
+    chaos.clear_plan()
+
+
+# -- grammar -----------------------------------------------------------------
+
+
+def test_parse_full_spec():
+    plan = chaos.ChaosPlan.parse(
+        "42:store.send.reset@at=3;p2p.*.truncate@at=1+5,n=1;"
+        "ipc.connect.delay@p=0.25,delay=0.2,jitter=0.1;"
+        "p2p.connect.partition@peer=2,n=4"
+    )
+    assert plan.seed == 42
+    r0, r1, r2, r3 = plan.rules
+    assert (r0.channel, r0.op, r0.kind, r0.at, r0.n) == (
+        "store", "send", "reset", frozenset({3}), 1)
+    assert r1.op == "*" and r1.at == frozenset({1, 5}) and r1.n == 1
+    assert r2.p == 0.25 and r2.delay == 0.2 and r2.jitter == 0.1 and r2.n is None
+    assert r3.kind == "partition" and r3.peer == "2" and r3.n == 4
+
+
+@pytest.mark.parametrize("bad", [
+    "noseed",                      # missing seed separator
+    "1:store.send",                # missing kind
+    "1:bogus.send.reset@at=1",     # unknown channel
+    "1:store.bogus.reset@at=1",    # unknown op
+    "1:store.send.bogus@at=1",     # unknown kind
+    "1:store.send.reset",          # no at=/p=
+    "1:store.send.reset@wat=1",    # unknown param
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        chaos.ChaosPlan.parse(bad)
+
+
+def test_malformed_env_is_ignored_not_fatal(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, "not a spec")
+    assert chaos.active_plan() is None
+
+
+def test_env_wiring_and_precedence(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_ENV, "5:store.send.reset@at=0")
+    plan = chaos.active_plan()
+    assert plan is not None and plan.seed == 5
+    # programmatic install overrides env until cleared
+    mine = chaos.ChaosPlan.parse("6:ipc.send.eof@at=0")
+    chaos.install_plan(mine)
+    assert chaos.active_plan() is mine
+    chaos.clear_plan()
+    assert chaos.active_plan().seed == 5
+
+
+# -- deterministic scheduling ------------------------------------------------
+
+
+def test_at_rules_fire_at_exact_indices():
+    plan = chaos.ChaosPlan.parse("0:store.send.reset@at=2+4")
+    hits = [plan.check("store", "send") is not None for _ in range(6)]
+    assert hits == [False, False, True, False, True, False]
+    assert plan.schedule() == [
+        ("store", "send", "reset", 2), ("store", "send", "reset", 4)]
+
+
+def test_counters_are_per_channel_op():
+    plan = chaos.ChaosPlan.parse("0:store.send.reset@at=1")
+    assert plan.check("store", "recv") is None   # separate counter
+    assert plan.check("p2p", "send") is None     # separate channel
+    assert plan.check("store", "send") is None   # index 0
+    assert plan.check("store", "send") is not None  # index 1
+
+
+def test_budget_n_bounds_probabilistic_rule():
+    plan = chaos.ChaosPlan.parse("0:store.send.reset@p=1.0,n=2")
+    fired = sum(plan.check("store", "send") is not None for _ in range(10))
+    assert fired == 2
+
+
+def test_peer_scoped_rule_only_hits_that_peer():
+    plan = chaos.ChaosPlan.parse("0:p2p.connect.partition@peer=3,p=1.0,n=10")
+    assert plan.check("p2p", "connect", peer="1") is None
+    assert plan.check("p2p", "connect", peer="3") is not None
+    assert plan.check("p2p", "connect") is None  # unknown peer never matches
+
+
+def test_schedule_is_reproducible_across_threads():
+    def run():
+        plan = chaos.ChaosPlan.parse("0:store.send.reset@at=5+11;store.recv.eof@at=3")
+        def worker():
+            for _ in range(10):
+                plan.check("store", "send")
+                plan.check("store", "recv")
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return plan.schedule()
+
+    assert run() == run() == [
+        ("store", "recv", "eof", 3),
+        ("store", "send", "reset", 5),
+        ("store", "send", "reset", 11),
+    ]
+
+
+def test_random_spec_deterministic_and_covering():
+    a, b = chaos.random_spec(99), chaos.random_spec(99)
+    assert a == b
+    plan = chaos.ChaosPlan.parse(a)
+    per_channel = {}
+    for r in plan.rules:
+        per_channel.setdefault(r.channel, []).append(r.kind)
+    assert set(per_channel) == set(chaos.CHANNELS)
+    assert all(len(ks) == 2 for ks in per_channel.values())
+    assert chaos.random_spec(99) != chaos.random_spec(100)
+
+
+# -- socket wrapper ----------------------------------------------------------
+
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_wrap_is_identity_without_plan():
+    a, b = _pair()
+    try:
+        assert chaos.wrap(a, "store") is a
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reset_raises_and_closes():
+    plan = chaos.ChaosPlan.parse("0:store.send.reset@at=1")
+    a, b = _pair()
+    wa = chaos.ChaosSocket(a, plan, "store")
+    try:
+        wa.sendall(b"ok")                 # index 0 passes through
+        assert b.recv(16) == b"ok"
+        with pytest.raises(ConnectionResetError):
+            wa.sendall(b"boom")           # index 1 injected
+        assert b.recv(16) == b""          # peer observes the close
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncate_delivers_partial_bytes_then_dies():
+    plan = chaos.ChaosPlan.parse("0:store.send.truncate@at=0")
+    a, b = _pair()
+    wa = chaos.ChaosSocket(a, plan, "store")
+    try:
+        with pytest.raises(ConnectionResetError):
+            wa.sendall(b"0123456789")
+        got = b.recv(64)
+        assert 1 <= len(got) <= 5          # a genuine partial frame
+        assert b"0123456789".startswith(got)
+        assert b.recv(64) == b""           # then EOF
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_eof_and_stall():
+    plan = chaos.ChaosPlan.parse("0:store.recv.stall@at=0,delay=0.01;store.recv.eof@at=2")
+    a, b = _pair()
+    wb = chaos.ChaosSocket(b, plan, "store")
+    try:
+        a.sendall(b"abcdef")
+        assert wb.recv(1024) == b"a"       # stall: short single-byte read
+        assert wb.recv(1024) == b"bcdef"   # index 1: clean
+        assert wb.recv(1024) == b""        # index 2: injected EOF
+    finally:
+        a.close()
+        b.close()
+
+
+def test_connect_and_accept_hooks():
+    plan = chaos.ChaosPlan.parse("0:ipc.connect.reset@at=0;ipc.accept.eof@at=0")
+    chaos.install_plan(plan)
+    with pytest.raises(ConnectionRefusedError):
+        chaos.check_connect("ipc", peer="/tmp/x")
+    assert chaos.check_accept("ipc") is True
+    assert chaos.check_accept("ipc") is False
+    assert plan.schedule() == [
+        ("ipc", "accept", "eof", 0), ("ipc", "connect", "reset", 0)]

@@ -1,0 +1,119 @@
+"""IPC channel hardening: connect cancellation, chaos absorption by the
+connect retry loop and the receiver, monitor-client self-healing."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.platform import chaos, ipc
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear_plan()
+    yield
+    chaos.clear_plan()
+
+
+def test_connect_honors_cancel_during_retry(tmp_uds_path):
+    """A caller shutting down while connect() retries against an absent server
+    must get out promptly — not sleep out the full timeout."""
+    cancel = threading.Event()
+    errors = {}
+
+    def dial():
+        try:
+            ipc.connect(tmp_uds_path, timeout=30.0, cancel=cancel)
+        except Exception as e:
+            errors["e"] = e
+            errors["t"] = time.monotonic()
+
+    t = threading.Thread(target=dial)
+    t.start()
+    time.sleep(0.3)  # solidly inside the retry loop
+    t0 = time.monotonic()
+    cancel.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "connect still retrying after cancel"
+    assert isinstance(errors["e"], ConnectionAbortedError)
+    assert errors["t"] - t0 < 1.0
+
+
+def test_connect_retry_absorbs_injected_dial_faults(tmp_uds_path):
+    """Injected resets at dial time are the same transient class the loop
+    already retries — the connect still lands."""
+    chaos.install_plan(chaos.ChaosPlan.parse("0:ipc.connect.reset@at=0+1"))
+    rx = ipc.IpcReceiver(tmp_uds_path)
+    rx.start()
+    try:
+        sock = ipc.connect(tmp_uds_path, timeout=10.0)
+        sock.close()
+    finally:
+        rx.stop()
+    plan = chaos.active_plan()
+    assert [k for _, _, k, _ in plan.schedule()] == ["reset", "reset"]
+
+
+def test_receiver_survives_truncated_and_eof_frames(tmp_uds_path):
+    """Mid-frame truncation and EOF-on-accept drop only the affected message;
+    the receiver keeps serving."""
+    chaos.install_plan(chaos.ChaosPlan.parse(
+        "0:ipc.accept.eof@at=1;ipc.send.truncate@at=3"
+    ))
+    rx = ipc.IpcReceiver(tmp_uds_path)
+    rx.start()
+    got = []
+    try:
+        for i in range(6):
+            try:
+                ipc.send_to(tmp_uds_path, {"i": i}, timeout=5.0)
+            except (OSError, ConnectionError):
+                pass  # the injected fault's victim
+        deadline = time.time() + 5.0
+        while len(got) < 4 and time.time() < deadline:
+            got += rx.fetch()
+            time.sleep(0.01)
+    finally:
+        rx.stop()
+    indices = sorted(m["i"] for m in got)
+    assert len(indices) >= 4, indices  # at most the 2 chaosed sends lost
+    assert indices == sorted(set(indices))  # no duplicates
+
+
+def test_monitor_client_heals_across_link_faults(tmp_uds_path):
+    """The rank monitor link is self-healing: a reset or truncated reply
+    reconnects + re-inits + replays, so heartbeats survive injected faults
+    that previously would have crashed the rank."""
+    from tpu_resiliency.watchdog.config import FaultToleranceConfig
+    from tpu_resiliency.watchdog.monitor_client import RankMonitorClient
+    from tpu_resiliency.watchdog.monitor_server import RankMonitorServer
+
+    cfg = FaultToleranceConfig(
+        initial_rank_heartbeat_timeout=60.0,
+        rank_heartbeat_timeout=60.0,
+        workload_check_interval=0.5,
+    )
+    proc = RankMonitorServer.run_in_subprocess(cfg, tmp_uds_path,
+                                               start_method="spawn")
+    try:
+        # Faults on the worker side of the link: one send reset, one reply
+        # truncation, well inside the heartbeat sequence.
+        chaos.install_plan(chaos.ChaosPlan.parse(
+            "0:ipc.send.reset@at=2;ipc.recv.truncate@at=9"
+        ))
+        c = RankMonitorClient()
+        c.init_workload_monitoring(socket_path=tmp_uds_path)
+        for _ in range(6):
+            c.send_heartbeat()  # must not raise
+            time.sleep(0.02)
+        c.shutdown_workload_monitoring()
+        plan = chaos.active_plan()
+        kinds = sorted(k for _, _, k, _ in plan.schedule())
+        assert kinds == ["reset", "truncate"], plan.schedule()
+    finally:
+        chaos.clear_plan()
+        proc.terminate()
+        proc.join(timeout=10)

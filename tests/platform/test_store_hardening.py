@@ -1,0 +1,325 @@
+"""Store-channel hardening: transparent retry of idempotent ops, at-most-once
+req_id dedup for non-idempotent ops, close()-during-retry, try_get on a dead
+transport."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import StoreError, StoreTimeoutError, StoreTransportError
+from tpu_resiliency.platform import chaos, framing
+from tpu_resiliency.platform.store import (
+    CoordStore,
+    KVClient,
+    KVServer,
+    _client_hello,
+)
+from tpu_resiliency.utils import events
+from tpu_resiliency.utils.metrics import aggregate
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear_plan()
+    yield
+    chaos.clear_plan()
+
+
+def _raw_conn(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+    _client_hello(s, None)
+    return s
+
+
+# -- transparent retry (idempotent ops) -------------------------------------
+
+
+@pytest.mark.chaos
+def test_idempotent_ops_survive_one_reset_each(kv_server):
+    """Acceptance: a single injected connection reset per op class surfaces NO
+    caller-visible exception."""
+    ops = [
+        lambda st: st.set("k", 1),
+        lambda st: st.get("k", timeout=1.0),
+        lambda st: st.touch("hb/0"),
+        lambda st: st.check(["k"]),
+        lambda st: st.prefix_get(""),
+        lambda st: st.client.stale_keys("hb/", 9999.0),
+        lambda st: st.barrier_status("nope"),
+        lambda st: st.ping(),
+    ]
+    seed_store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+    seed_store.set("k", 1)
+    seed_store.close()
+    for i, op in enumerate(ops):
+        # Plan installed BEFORE dialing: sockets are chaos-wrapped at connect
+        # time. The first send frame of the fresh client is the op itself.
+        plan = chaos.ChaosPlan.parse(f"{i}:store.send.reset@at=0")
+        chaos.install_plan(plan)
+        st = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        op(st)  # must not raise
+        assert plan.schedule() == [("store", "send", "reset", 0)]
+        chaos.clear_plan()
+        st.close()
+
+
+@pytest.mark.chaos
+def test_retry_survives_truncated_response(kv_server):
+    """Mid-frame truncation of a RESPONSE (recv side) reconnects and reissues."""
+    seed_store = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+    seed_store.set("x", "v0")
+    seed_store.close()
+    # recv op indices on a fresh client: hello(0,1), set resp — none here, so
+    # the get's response reads are ops 2,3; truncate the length prefix read.
+    chaos.install_plan(chaos.ChaosPlan.parse("0:store.recv.truncate@at=2"))
+    st = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+    assert st.get("x", timeout=2.0) == "v0"
+    st.close()
+
+
+@pytest.mark.chaos
+def test_retry_emits_store_retry_events(kv_server):
+    seen = []
+    events.add_sink(seen.append)
+    try:
+        # Plan installed BEFORE the client dials: sockets are wrapped at
+        # connect time, so a pre-existing connection is never chaosed.
+        chaos.install_plan(chaos.ChaosPlan.parse("0:store.send.reset@at=0"))
+        st = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+        st.set("k", 1)
+        chaos.clear_plan()
+        st.close()
+    finally:
+        events.remove_sink(seen.append)
+    kinds = [(e.kind, e.payload.get("outcome")) for e in seen if e.kind == "store_retry"]
+    assert ("store_retry", "retried") in kinds
+    assert ("store_retry", "recovered") in kinds
+    # ...and the events→metrics bridge aggregates them into the counter.
+    recs = [
+        {"kind": e.kind, **e.payload} for e in seen if e.kind == "store_retry"
+    ]
+    reg = aggregate(recs)
+    prom = reg.to_prometheus()
+    assert 'tpu_store_retries_total{op="set",outcome="recovered"} 1' in prom
+
+
+def test_breaker_makes_later_calls_fail_fast_and_recovers():
+    """One exhausted retry budget opens the per-endpoint breaker: subsequent
+    calls (any client of that endpoint) fail in milliseconds instead of each
+    burning a fresh budget. A server coming back closes it again."""
+    server = KVServer(host="127.0.0.1", port=0)
+    port = server.port
+    c1 = CoordStore("127.0.0.1", port, timeout=5.0, retry_budget=0.6)
+    c2 = CoordStore("127.0.0.1", port, timeout=5.0, retry_budget=0.6)
+    server.close()
+    time.sleep(0.1)
+    t0 = time.monotonic()
+    with pytest.raises(StoreError):
+        c1.set("k", 1)  # pays the full budget, trips the breaker
+    first = time.monotonic() - t0
+    assert first >= 0.4
+    t0 = time.monotonic()
+    for c in (c1, c2, c1):
+        with pytest.raises(StoreError):
+            c.set("k", 1)  # breaker open: fail fast, shared across clients
+    assert time.monotonic() - t0 < 0.5 * 3
+    # Same port comes back: breaker closes on the first success after cooldown.
+    server2 = KVServer(host="127.0.0.1", port=port)
+    try:
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                c1.set("k", 2)
+                break
+            except StoreError:
+                assert time.monotonic() < deadline, "breaker never recovered"
+                time.sleep(0.2)
+        assert c2.get("k", timeout=2.0) == 2
+    finally:
+        c1.close()
+        c2.close()
+        server2.close()
+
+
+def test_retry_budget_exhaustion_raises_transport_error():
+    """No server at all: the retry budget must bound the stall and surface a
+    StoreTransportError (a StoreError subclass — existing handlers still work)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listening here
+    t0 = time.monotonic()
+    with pytest.raises(StoreTransportError):
+        KVClient("127.0.0.1", port, connect_retries=1, retry_budget=0.5)
+    assert time.monotonic() - t0 < 10.0
+
+
+# -- satellite: close() during _connect retry --------------------------------
+
+
+def test_connect_retry_loop_honors_close():
+    """close() while the client is reconnect-looping against a dead server must
+    abort the loop promptly instead of sleeping out the remaining retries."""
+    server = KVServer(host="127.0.0.1", port=0)
+    client = CoordStore("127.0.0.1", server.port, timeout=5.0)
+    server.close()
+
+    errors = {}
+
+    def call():
+        try:
+            # Dead server: _call retries _connect (many slow attempts).
+            client.client._call({"op": "ping"})
+        except Exception as e:
+            errors["e"] = e
+            errors["t"] = time.monotonic()
+
+    t = threading.Thread(target=call)
+    t.start()
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    client.close()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "call still retrying after close()"
+    assert isinstance(errors["e"], StoreError)
+    assert errors["t"] - t0 < 3.0, "close() did not interrupt the retry loop"
+
+
+# -- satellite: try_get returns default on transport failure -----------------
+
+
+def test_try_get_returns_default_on_dead_transport():
+    server = KVServer(host="127.0.0.1", port=0)
+    client = CoordStore("127.0.0.1", server.port, timeout=5.0,
+                        retry_budget=0.3)
+    assert client.try_get("missing") is None  # normal miss
+    server.close()
+    time.sleep(0.1)
+    # Dead persistent socket + dead server: transport-level StoreError inside;
+    # the opportunistic read must still just report the default.
+    assert client.try_get("anything", default="fallback") == "fallback"
+    client.close()
+    # ...but a CLOSED client is a caller bug, not a transport blip.
+    with pytest.raises(StoreError):
+        client.try_get("anything")
+
+
+# -- satellite: server-side req_id dedup -------------------------------------
+
+
+def test_retried_add_with_req_id_applies_once(kv_server):
+    """First attempt lands, response is lost, retry must replay — counter 1."""
+    s1 = _raw_conn(kv_server.port)
+    framing.send_obj(s1, {"op": "add", "key": "c", "amount": 1, "req_id": "r:1"})
+    assert framing.recv_obj(s1)["value"] == 1
+    s1.close()  # response "lost"; client reconnects
+    s2 = _raw_conn(kv_server.port)
+    framing.send_obj(s2, {"op": "add", "key": "c", "amount": 1, "req_id": "r:1"})
+    assert framing.recv_obj(s2)["value"] == 1, "retried add double-applied"
+    framing.send_obj(s2, {"op": "get", "key": "c", "timeout": 1.0})
+    assert framing.recv_obj(s2)["value"] == 1
+    # A DIFFERENT req_id is a genuinely new request.
+    framing.send_obj(s2, {"op": "add", "key": "c", "amount": 1, "req_id": "r:2"})
+    assert framing.recv_obj(s2)["value"] == 2
+    s2.close()
+
+
+def test_retried_list_append_and_cas_apply_once(kv_server):
+    s = _raw_conn(kv_server.port)
+    for _ in range(2):  # same req_id twice (retry)
+        framing.send_obj(
+            s, {"op": "list_append", "key": "l", "value": "x", "req_id": "r:la"})
+        framing.recv_obj(s)
+    framing.send_obj(s, {"op": "list_get", "key": "l"})
+    assert framing.recv_obj(s)["value"] == ["x"], "retried list_append duplicated"
+    # CAS: retry of a succeeded CAS must replay success, not observe-own-write.
+    for _ in range(2):
+        framing.send_obj(s, {"op": "cas", "key": "st", "expected": None,
+                             "desired": "v1", "req_id": "r:cas"})
+        ok, val = framing.recv_obj(s)["value"]
+        assert ok and val == "v1", "retried CAS saw its own write as failure"
+    s.close()
+
+
+def test_retried_barrier_join_counts_one_arrival_across_reconnect(kv_server):
+    """A blocking join arrives + parks; its connection dies; the retried join
+    (same req_id, new connection) must re-wait — not overflow, not double-count
+    — and release when the one missing rank arrives."""
+    sA = _raw_conn(kv_server.port)
+    framing.send_obj(sA, {"op": "barrier", "name": "b", "rank": 0,
+                          "world_size": 2, "timeout": 20.0, "wait": True,
+                          "req_id": "r:b0"})
+    time.sleep(0.2)  # parked server-side
+    sA.close()       # connection dies; arrival must stay
+    sA2 = _raw_conn(kv_server.port)
+    framing.send_obj(sA2, {"op": "barrier", "name": "b", "rank": 0,
+                           "world_size": 2, "timeout": 20.0, "wait": True,
+                           "req_id": "r:b0"})
+    time.sleep(0.2)
+    # Arrival count must still be 1 (not 2, which would release a 2-world round
+    # with rank 1 missing).
+    sQ = _raw_conn(kv_server.port)
+    framing.send_obj(sQ, {"op": "barrier_status", "name": "b"})
+    status = framing.recv_obj(sQ)["value"]
+    assert status["arrived"] == {0}, status
+    assert status["generation"] == 0, status
+    # Rank 1 arrives: round releases; the retried join gets the generation.
+    framing.send_obj(sQ, {"op": "barrier", "name": "b", "rank": 1,
+                          "world_size": 2, "timeout": 20.0, "wait": True})
+    assert framing.recv_obj(sQ)["value"] == 1
+    got = framing.recv_obj(sA2)
+    assert got == {"status": "ok", "value": 1}, got
+    sA2.close()
+    sQ.close()
+
+
+def test_barrier_retry_after_release_replays_generation(kv_server):
+    """Retry arriving AFTER the round released replays the recorded response."""
+    sA = _raw_conn(kv_server.port)
+    sB = _raw_conn(kv_server.port)
+    framing.send_obj(sA, {"op": "barrier", "name": "b2", "rank": 0,
+                          "world_size": 2, "timeout": 20.0, "wait": True,
+                          "req_id": "r:x"})
+    time.sleep(0.1)
+    framing.send_obj(sB, {"op": "barrier", "name": "b2", "rank": 1,
+                          "world_size": 2, "timeout": 20.0, "wait": True})
+    assert framing.recv_obj(sB)["value"] == 1
+    assert framing.recv_obj(sA)["value"] == 1  # original response delivered
+    sA.close()
+    # Late retry (the response above could have been lost in transit).
+    sA2 = _raw_conn(kv_server.port)
+    framing.send_obj(sA2, {"op": "barrier", "name": "b2", "rank": 0,
+                           "world_size": 2, "timeout": 20.0, "wait": True,
+                           "req_id": "r:x"})
+    assert framing.recv_obj(sA2)["value"] == 1, "replay after release broken"
+    sA2.close()
+    sB.close()
+
+
+@pytest.mark.chaos
+def test_nonidempotent_ops_exact_under_injected_resets(kv_server):
+    """End to end: adds through the real client under injected send resets and
+    response truncations land exactly once each."""
+    chaos.install_plan(chaos.ChaosPlan.parse(
+        "0:store.send.reset@at=3;store.recv.truncate@at=8;store.send.truncate@at=12"
+    ))
+    st = CoordStore("127.0.0.1", kv_server.port, timeout=10.0)
+    for _ in range(10):
+        st.add("ctr", 1)
+    chaos.clear_plan()
+    assert st.get("ctr", timeout=2.0) == 10
+    st.close()
+
+
+def test_dedup_lru_is_bounded(kv_server):
+    from tpu_resiliency.platform.store import _DEDUP_MAX
+
+    s = _raw_conn(kv_server.port)
+    for i in range(_DEDUP_MAX + 64):
+        framing.send_obj(s, {"op": "add", "key": "n", "amount": 1,
+                             "req_id": f"r:{i}"})
+        framing.recv_obj(s)
+    assert len(kv_server._dedup) <= _DEDUP_MAX
+    s.close()

@@ -26,7 +26,7 @@ import time
 from typing import Any, Optional, Sequence
 
 from tpu_resiliency.exceptions import CheckpointError, StoreTimeoutError
-from tpu_resiliency.platform import framing
+from tpu_resiliency.platform import chaos, framing
 from tpu_resiliency.platform.store import AUTH_KEY_ENV, StoreView, _hmac
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
@@ -205,10 +205,16 @@ class PeerExchange:
         timeout: float = 300.0,
         auth_key: Optional[str] = None,
         protocol: Optional[int] = None,
+        send_retries: int = 3,
     ):
         self.store = store.scoped("p2p")
         self.rank = rank
         self.timeout = timeout
+        #: dial-and-send attempts per peer before a send surfaces
+        #: :class:`CheckpointError`. Each retry re-resolves the peer's address
+        #: from the store and re-runs the hello handshake, so a peer that
+        #: restarted (new ephemeral port) is picked up mid-round.
+        self.send_retries = max(1, send_retries)
         if auth_key is None:
             auth_key = os.environ.get(AUTH_KEY_ENV) or None
         self.auth_key = auth_key
@@ -281,6 +287,10 @@ class PeerExchange:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            if chaos.check_accept("p2p"):
+                conn.close()  # injected EOF-on-accept; the sender retries
+                continue
+            conn = chaos.wrap(conn, "p2p")
             threading.Thread(
                 target=self._recv_conn, args=(conn,), daemon=True, name="p2p-recv"
             ).start()
@@ -385,7 +395,9 @@ class PeerExchange:
     def _dial(self, dst: int) -> tuple[socket.socket, int]:
         """Connect + handshake; returns ``(socket, peer_protocol_version)``."""
         host, port = self._peer_addr(dst)
+        chaos.check_connect("p2p", peer=str(dst))
         conn = socket.create_connection((host, port), timeout=self.timeout)
+        conn = chaos.wrap(conn, "p2p", peer=str(dst))
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             peer_v = self._handshake_client(conn)
@@ -397,6 +409,41 @@ class PeerExchange:
     def _use_bulk(self, peer_v: int) -> bool:
         return peer_v >= framing.PROTO_V2 and self.protocol >= framing.PROTO_V2
 
+    def _retry_send(self, dst: int, what: str, attempt_fn):
+        """Run one dial-and-send attempt factory under the per-peer retry
+        policy: a transport fault (reset, EOF mid-handshake, truncated frame)
+        invalidates the cached peer address — the peer may have restarted on a
+        new port — backs off, re-dials with a fresh hello, and reissues the
+        whole send. Frames are delivered whole or not at all (a truncated bulk
+        frame reads as EOF and is dropped by the receiver), so a re-send can
+        duplicate a frame but never corrupt one; receivers treat a duplicate
+        (src, tag) frame as inbox surplus that ``purge`` reclaims.
+        """
+        delay = 0.05
+        last: Exception | None = None
+        for attempt in range(self.send_retries):
+            try:
+                return attempt_fn()
+            except (OSError, EOFError) as e:
+                last = e
+                self._addr_cache.pop(dst, None)
+                if attempt + 1 >= self.send_retries:
+                    break
+                log.warning(
+                    f"p2p: {what} to rank {dst} failed ({e!r}); "
+                    f"retry {attempt + 1}/{self.send_retries - 1}"
+                )
+                record_event(
+                    "checkpoint", "p2p_retry", dst=dst, what=what,
+                    attempt=attempt + 1, error=repr(e),
+                )
+                time.sleep(delay)
+                delay = min(delay * 2.0, 1.0)
+        raise CheckpointError(
+            f"p2p: {what} to rank {dst} failed after "
+            f"{self.send_retries} attempt(s): {last!r}"
+        ) from last
+
     def send(self, dst: int, tag: str, blob) -> None:
         """Push one bytes-like payload to a peer (sugar over :meth:`send_parts`)."""
         self.send_parts(dst, tag, [blob])
@@ -407,24 +454,27 @@ class PeerExchange:
         On a v2 link the parts go out as one bulk frame, scatter-gathered from
         the caller's buffers (``socket.sendmsg``) — zero userspace copies. A v1
         peer gets the legacy pickled ``{"src", "tag", "blob"}`` frame (one join,
-        the price of compatibility). Returns payload bytes sent.
+        the price of compatibility). Transient transport faults are absorbed by
+        the per-peer retry policy (``send_retries``). Returns payload bytes sent.
         """
+        return self._retry_send(
+            dst, f"send({tag!r})", lambda: self._send_parts_once(dst, tag, parts)
+        )
+
+    def _send_parts_once(self, dst: int, tag: str, parts: Sequence[Any]) -> int:
         conn, peer_v = self._dial(dst)
         t0 = time.perf_counter()
-        try:
-            with conn:
-                if self._use_bulk(peer_v):
-                    nbytes = framing.send_bulk(
-                        conn, {"src": self.rank, "tag": tag}, parts
-                    )
-                    frame = "bulk"
-                else:
-                    blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
-                    framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
-                    nbytes = len(blob)
-                    frame = "obj"
-        except OSError as e:
-            raise CheckpointError(f"p2p: send to rank {dst} failed: {e!r}") from e
+        with conn:
+            if self._use_bulk(peer_v):
+                nbytes = framing.send_bulk(
+                    conn, {"src": self.rank, "tag": tag}, parts
+                )
+                frame = "bulk"
+            else:
+                blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)
+                framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+                nbytes = len(blob)
+                frame = "obj"
         _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
         return nbytes
 
@@ -438,43 +488,54 @@ class PeerExchange:
         peer can only accept whole pickled frames, so chunks are buffered and
         sent as one legacy frame at ``close()`` (compatibility, not speed).
         Always ``close()`` (success) or ``abort()`` (failure) the handle — an
-        under-sent bulk frame otherwise desyncs the peer's stream."""
-        conn, peer_v = self._dial(dst)
-        use_bulk = self._use_bulk(peer_v)
-        try:
-            if use_bulk:
-                framing.send_bulk_start(conn, {"src": self.rank, "tag": tag}, nbytes)
-        except OSError as e:
-            conn.close()
-            raise CheckpointError(f"p2p: stream open to rank {dst} failed: {e!r}") from e
-        return StreamSend(self, conn, use_bulk, dst, tag, nbytes)
+        under-sent bulk frame otherwise desyncs the peer's stream. The open
+        (dial + preamble) is retried like any send; once chunks are flowing a
+        fault aborts the stream (the caller's leaves are transient — replaying
+        them is the save engine's call, not this layer's)."""
+
+        def attempt():
+            conn, peer_v = self._dial(dst)
+            use_bulk = self._use_bulk(peer_v)
+            try:
+                if use_bulk:
+                    framing.send_bulk_start(
+                        conn, {"src": self.rank, "tag": tag}, nbytes
+                    )
+            except BaseException:
+                conn.close()
+                raise
+            return StreamSend(self, conn, use_bulk, dst, tag, nbytes)
+
+        return self._retry_send(dst, f"stream open({tag!r})", attempt)
 
     def send_file(self, dst: int, tag: str, path: str) -> int:
         """Stream an on-disk payload to a peer.
 
         On a v2 link the file is spliced kernel-side with ``os.sendfile`` — the
         shard never enters userspace. A v1 peer forces the legacy whole-blob
-        frame (read + pickle). Returns payload bytes sent.
+        frame (read + pickle). Transient transport faults are absorbed by the
+        per-peer retry policy (the file is still there — a re-send is free).
+        Returns payload bytes sent.
         """
+        return self._retry_send(
+            dst, f"send_file({path!r})", lambda: self._send_file_once(dst, tag, path)
+        )
+
+    def _send_file_once(self, dst: int, tag: str, path: str) -> int:
         conn, peer_v = self._dial(dst)
         t0 = time.perf_counter()
-        try:
-            with conn:
-                if self._use_bulk(peer_v):
-                    nbytes = framing.send_bulk_file(
-                        conn, {"src": self.rank, "tag": tag}, path
-                    )
-                    frame = "file"
-                else:
-                    with open(path, "rb") as f:
-                        blob = f.read()
-                    framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
-                    nbytes = len(blob)
-                    frame = "obj"
-        except OSError as e:
-            raise CheckpointError(
-                f"p2p: send_file({path!r}) to rank {dst} failed: {e!r}"
-            ) from e
+        with conn:
+            if self._use_bulk(peer_v):
+                nbytes = framing.send_bulk_file(
+                    conn, {"src": self.rank, "tag": tag}, path
+                )
+                frame = "file"
+            else:
+                with open(path, "rb") as f:
+                    blob = f.read()
+                framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
+                nbytes = len(blob)
+                frame = "obj"
         _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
         return nbytes
 

@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import concurrent.futures as cf
 import dataclasses
+import time
 from typing import Any, Callable, Optional, Sequence
 
 from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
 from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.tracing import span
 
@@ -193,6 +195,12 @@ class CliqueReplicationStrategy:
         #: wait on tags that are never sent. ``rebuild`` resets it so survivors
         #: and freshly constructed joiners re-align at 0.
         self._round = 0
+        #: Peers that exhausted their transfer retries in the LAST replicate
+        #: round — that round saved with reduced redundancy instead of failing.
+        #: Callers feed this into :meth:`retrieve`'s ``avoid`` set (the
+        #: ``ExchangePlan`` deprioritizes degraded senders) and should treat a
+        #: persistently non-empty set as a health signal.
+        self.last_degraded: set[int] = set()
         if comm is not None:
             self._set_groups(comm.ranks)
         else:
@@ -219,6 +227,7 @@ class CliqueReplicationStrategy:
         # at 0. Tags must agree across the new group, and rebuild is the one
         # moment every member is provably at the same point — re-align here.
         self._round = 0
+        self.last_degraded = set()  # the old world's degradations are history
         # Tags restart at 0, so frames from abandoned pre-rebuild rounds (a peer
         # died mid-replicate; nobody will ever recv them) must not linger: they
         # pin multi-GB payloads in the exchange inbox forever AND would be
@@ -357,32 +366,65 @@ class CliqueReplicationStrategy:
         overlap with the receives draining concurrently on this thread. Received
         payloads are single receive buffers (`bytes`-like) ready for
         ``format.write_parts`` / ``deserialize_from_buffer``.
+
+        **Degraded peers do not fail the save.** A peer whose send exhausted
+        its retries, or whose mirror never arrived within the round deadline,
+        is dropped from the returned map and recorded in :attr:`last_degraded`
+        (one ``peer_degraded`` event each → ``tpu_replication_peer_degraded_total``):
+        this round's shard simply has fewer mirrors — strictly better than
+        aborting the checkpoint because one clique member's NIC blipped. All
+        receive waits share ONE round deadline (``exchange.timeout``), so k
+        degraded peers cost one timeout, not k.
         """
         self._ensure_groups()
         rank = self.comm.rank
         if not self.enabled:
             return {}
         tag = f"repl/{self._round}"
+        rnd = self._round
         self._round += 1
         peers = [p for p in self.my_group if p != rank]
         if not peers:
             return {}
         nbytes = sum(memoryview(p).cast("B").nbytes for p in parts)
         received: dict[int, Any] = {}
+        degraded: set[int] = set()
+        deadline = time.monotonic() + self.exchange.timeout
         with span(
             "checkpoint", "ckpt.replicate.fanout",
-            round=self._round - 1, peers=len(peers), bytes=nbytes,
+            round=rnd, peers=len(peers), bytes=nbytes,
         ):
             with cf.ThreadPoolExecutor(max_workers=len(peers)) as pool:
-                futs = [
-                    pool.submit(self.exchange.send_parts, peer, tag, parts)
+                futs = {
+                    peer: pool.submit(self.exchange.send_parts, peer, tag, parts)
                     for peer in peers
-                ]
+                }
                 for peer in peers:
-                    received[peer] = self.exchange.recv(peer, tag)
-                for f in futs:
-                    f.result()
+                    try:
+                        received[peer] = self.exchange.recv(
+                            peer, tag,
+                            timeout=max(0.05, deadline - time.monotonic()),
+                        )
+                    except CheckpointError:
+                        degraded.add(peer)
+                for peer, f in futs.items():
+                    try:
+                        f.result()
+                    except CheckpointError:
+                        degraded.add(peer)
+        self._mark_degraded(degraded, rnd)
         return received
+
+    def _mark_degraded(self, degraded: set[int], rnd: int) -> None:
+        self.last_degraded = set(degraded)
+        for peer in sorted(degraded):
+            log.warning(
+                f"replication round {rnd}: peer {peer} degraded "
+                f"(transfer retries exhausted); saving with reduced redundancy"
+            )
+            record_event(
+                "checkpoint", "peer_degraded", peer=peer, round=rnd,
+            )
 
     def start_stream(self, nbytes: int) -> "ReplicationStream":
         """Foreground half of a leaf-streaming replication round.

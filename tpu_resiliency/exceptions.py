@@ -15,6 +15,14 @@ class StoreError(ResiliencyError):
     """Coordination-store protocol or transport failure."""
 
 
+class StoreTransportError(StoreError):
+    """The store connection died mid-operation (reset, EOF, socket error).
+
+    Distinct from :class:`StoreError` proper so the client's retry layer can
+    tell a recoverable transport blip (reconnect and reissue) from a server-side
+    failure (an error *response* — retrying would repeat the same answer)."""
+
+
 class StoreTimeoutError(StoreError, TimeoutError):
     """A blocking store operation (get/wait/barrier) timed out."""
 

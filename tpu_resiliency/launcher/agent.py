@@ -27,7 +27,7 @@ import time
 import uuid
 from typing import Optional
 
-from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.exceptions import FaultToleranceError, StoreError
 from tpu_resiliency.launcher.proc import GroupState, WorkerGroup
 from tpu_resiliency.launcher.rendezvous import (
     RendezvousOutcome,
@@ -147,7 +147,28 @@ class ElasticAgent:
                     preload=self.cfg.warm_spare_preload,
                 )
             while True:
-                outcome = self.rdzv.next_round(prev_round)
+                try:
+                    outcome = self.rdzv.next_round(prev_round)
+                except (StoreError, FaultToleranceError):
+                    # Store lost while re-entering rendezvous. If we carry no
+                    # failure of our own — our last round's workers all
+                    # succeeded, or we were a spare that never ran any — the
+                    # likeliest story is "the job finished and the
+                    # store-hosting agent left while a late restart request
+                    # was pulling us back in": the same benign race
+                    # _await_group_completion and _spare_loop already treat
+                    # as completion. A node re-rendezvousing to retry its own
+                    # FAILED round keeps this fatal.
+                    if prev_round >= 0 and all(
+                        c == 0 for c in self._last_exitcodes.values()
+                    ):
+                        log.info(
+                            f"[{self.cfg.node_id}] store gone while "
+                            f"re-rendezvousing after round {prev_round} with no "
+                            f"local failure; treating job as complete"
+                        )
+                        return self._last_exitcodes
+                    raise
                 # The restart budget is charged once per restart *round*, whoever
                 # caused it — a job whose failures rotate across N nodes must not
                 # get N × max_restarts rounds, and a correlated k-node failure that

@@ -189,7 +189,14 @@ class StoreRendezvous:
 
     def _next_round(self, prev_round: int) -> RendezvousOutcome:
         self.start_keepalive()
-        self.store.touch(f"ka/{self.node_id}")
+        try:
+            self.store.touch(f"ka/{self.node_id}")
+        except StoreError:
+            # The store host may be mid-teardown (its job finished while we
+            # were between rounds). The keep-alive is advisory; the state read
+            # below owns the store-lost decision (idle-spare exit vs fatal),
+            # so a dead store here must not crash the agent one line early.
+            pass
         deadline = time.monotonic() + self.s.join_timeout
         min_reached_at: Optional[float] = None
         me = self.node_id

@@ -5,6 +5,11 @@ local UDS IPC (``platform/ipc.py``), and the checkpoint peer-exchange links
 (``checkpoint/comm.py``) so the wire protocol evolves in one place. The length prefix
 is 64-bit because peer-exchange frames carry whole checkpoint shards (multi-GB).
 
+Because every channel funnels through these helpers, this is also the boundary
+where deterministic network fault injection applies: ``platform/chaos.py`` wraps
+the sockets handed to these functions (resets, mid-frame truncation, stalls —
+see ``docs/chaos.md``), and the channels' retry layers are tested against it.
+
 Two frame kinds share one stream (version 2 of the p2p protocol):
 
 - **object frame** (v1, unchanged): ``len(!Q) | pickle`` — control messages and

@@ -19,7 +19,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
-from tpu_resiliency.platform import framing
+from tpu_resiliency.platform import chaos, framing
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -37,7 +37,9 @@ read_object_stream = functools.partial(framing.read_obj_stream, max_frame=_MAX_F
 write_object_stream = framing.write_obj_stream
 
 
-def connect(path: str, timeout: float = 30.0) -> socket.socket:
+def connect(
+    path: str, timeout: float = 30.0, cancel: Optional[threading.Event] = None
+) -> socket.socket:
     """Connect to a UDS server, retrying within ``timeout``.
 
     Retry matters even when the caller has seen the socket file: the file
@@ -45,20 +47,28 @@ def connect(path: str, timeout: float = 30.0) -> socket.socket:
     server between bind() and listen() — a one-shot connect then dies on
     ECONNREFUSED for a server that is milliseconds from ready (observed as a
     1-in-4 suite flake under 2x concurrency). FileNotFoundError is retried
-    for the same reason one step earlier (file not yet created)."""
+    for the same reason one step earlier (file not yet created).
+
+    ``cancel``: optional event checked every iteration — a caller shutting
+    down mid-retry (worker teardown racing monitor startup) aborts the loop
+    promptly with ``ConnectionAbortedError`` instead of sleeping out the
+    remaining budget against a server that will never appear."""
     deadline = time.monotonic() + timeout
     while True:
+        if cancel is not None and cancel.is_set():
+            raise ConnectionAbortedError(f"ipc connect to {path!r} cancelled")
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         # Remaining budget, not the full timeout: a blocking connect on the
         # final attempt must not stretch the caller's deadline to ~2x.
         sock.settimeout(max(0.001, deadline - time.monotonic()))
         try:
+            chaos.check_connect("ipc", peer=path)
             sock.connect(path)
             # The clipped timeout governed only the connect attempt; the
             # returned socket keeps the caller's full I/O timeout (a late
             # connect must not bequeath a milliseconds recv budget).
             sock.settimeout(timeout)
-            return sock
+            return chaos.wrap(sock, "ipc", peer=path)
         except (ConnectionRefusedError, FileNotFoundError, BlockingIOError):
             # BlockingIOError: Linux AF_UNIX connect returns EAGAIN when the
             # listener's accept backlog is full — same transient class.
@@ -101,6 +111,10 @@ class IpcReceiver:
                 conn, _ = self._server.accept()
             except OSError:
                 return
+            if chaos.check_accept("ipc"):
+                conn.close()  # injected EOF-on-accept; sender sees a clean close
+                continue
+            conn = chaos.wrap(conn, "ipc")
             threading.Thread(
                 target=self._drain_conn, args=(conn,), name="ipc-receiver-conn", daemon=True
             ).start()

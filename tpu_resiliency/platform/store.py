@@ -34,10 +34,12 @@ collectives instead (see ``telemetry``).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import errno
 import hashlib
 import hmac
+import itertools
 import os
 import secrets
 import selectors
@@ -51,8 +53,10 @@ from tpu_resiliency.exceptions import (
     BarrierTimeout,
     StoreError,
     StoreTimeoutError,
+    StoreTransportError,
 )
-from tpu_resiliency.platform import framing
+from tpu_resiliency.platform import chaos, framing
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
@@ -64,6 +68,61 @@ AUTH_KEY_ENV = "TPU_RESILIENCY_STORE_KEY"
 _BLOCKING_THRESHOLD_S = 5.0
 
 _LOOPBACK_HOSTS = ("127.0.0.1", "localhost", "::1", "")
+
+#: Ops whose server-side effect is safe to apply twice: the client transparently
+#: reconnect-and-retries these on a transport failure (a lost *response* just
+#: repeats the read/overwrite). Mutations here are last-writer-wins (set/touch)
+#: or set-union (set_add) — reapplication is a no-op.
+_IDEMPOTENT_OPS = frozenset({
+    "ping", "get", "getv", "check", "set", "delete", "touch", "stale",
+    "prefix_get", "prefix_clear", "num_keys", "keys", "barriers",
+    "wait_changed", "list_get", "list_clear", "set_get", "set_add",
+    "barrier_status", "barrier_del",
+})
+
+#: Ops where a blind retry double-applies (increment, append, CAS, barrier
+#: arrival): the client mints a per-call ``req_id`` nonce and the server dedups
+#: (bounded LRU), giving at-most-once application under the same retry loop.
+_NONIDEMPOTENT_OPS = frozenset({"add", "cas", "list_append", "barrier"})
+assert not (_IDEMPOTENT_OPS & _NONIDEMPOTENT_OPS)
+
+#: Server-side request-dedup LRU capacity. Sized for in-flight retries, not
+#: history: an entry is only ever consulted within one client call's retry
+#: budget (seconds), and each entry is a small response dict.
+_DEDUP_MAX = 4096
+
+
+def _retry_event(op: str, outcome: str) -> None:
+    """One ``store_retry`` record per retry decision (→
+    ``tpu_store_retries_total{op,outcome}`` via the events→metrics bridge).
+    Retries only happen on transport faults, so the volume is per-fault, not
+    per-op."""
+    record_event("store", "store_retry", op=op, outcome=outcome)
+
+
+#: Process-wide circuit breakers, keyed by (host, port): the monotonic instant
+#: until which calls to that endpoint fail fast instead of burning a retry
+#: budget. An agent holds several clients to one store (rendezvous, jobs
+#: registry, restart watcher); when the store host legitimately exits, ONE of
+#: them paying one budget is diagnosis enough — teardown must not serialize
+#: N × retry_budget of sleeps. Shared state, not per-client, for that reason.
+_breakers: dict[tuple[str, int], float] = {}
+_breakers_lock = threading.Lock()
+
+
+def _breaker_open(host: str, port: int) -> bool:
+    with _breakers_lock:
+        return time.monotonic() < _breakers.get((host, port), 0.0)
+
+
+def _breaker_trip(host: str, port: int, cooldown: float) -> None:
+    with _breakers_lock:
+        _breakers[(host, port)] = time.monotonic() + cooldown
+
+
+def _breaker_clear(host: str, port: int) -> None:
+    with _breakers_lock:
+        _breakers.pop((host, port), None)
 
 
 def _hmac(key: str, nonce: bytes) -> bytes:
@@ -182,6 +241,14 @@ class KVServer:
         self._sets: dict[str, set] = {}
         self._barriers: dict[str, _Barrier] = {}
         self._stale_cache: dict[tuple[str, float], tuple[float, dict]] = {}
+        #: request-dedup LRU: req_id → ("resp", response_dict) once the
+        #: response exists, or ("barrier", (name, gen)) while a blocking join
+        #: that already *applied* its arrival is still parked. Gives retried
+        #: non-idempotent ops (add/cas/list_append/barrier) at-most-once
+        #: application across reconnects: apply + cache happen atomically on
+        #: the single loop thread, so a retry either replays the cached
+        #: response or finds nothing applied at all.
+        self._dedup: collections.OrderedDict[str, tuple] = collections.OrderedDict()
         self._shutdown = threading.Event()
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -346,6 +413,11 @@ class KVServer:
                             self._reserve_fd = None
                     continue
                 return
+            if chaos.check_accept("store"):
+                # Injected EOF-on-accept: the client sees a clean close before
+                # any frame and retries its connect.
+                sock.close()
+                continue
             sock.setblocking(False)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             conn = _Conn(sock)
@@ -526,7 +598,42 @@ class KVServer:
         handler = getattr(self, f"_op_{op}", None)
         if handler is None:
             return {"status": "error", "error": f"unknown op {op!r}"}
-        return handler(req)
+        req_id = req.get("req_id")
+        if req_id is not None:
+            hit = self._dedup.get(req_id)
+            if hit is not None and hit[0] == "resp":
+                # Retry of a request that fully applied; replay the recorded
+                # response instead of re-applying the mutation.
+                self._dedup.move_to_end(req_id)
+                return hit[1]
+        resp = handler(req)
+        if req_id is not None:
+            if isinstance(resp, _Park):
+                resp = self._park_caching(req_id, resp)
+            else:
+                self._dedup_put(req_id, ("resp", resp))
+        return resp
+
+    def _dedup_put(self, req_id: str, entry: tuple) -> None:
+        od = self._dedup
+        od[req_id] = entry
+        od.move_to_end(req_id)
+        while len(od) > _DEDUP_MAX:
+            od.popitem(last=False)
+
+    def _park_caching(self, req_id: str, park: _Park) -> _Park:
+        """Wrap a park so its eventual response is recorded under ``req_id``
+        the moment it materializes (release via ``_notify``) — a retry arriving
+        after the release replays it instead of re-joining."""
+        inner = park.ready
+
+        def ready() -> Optional[dict]:
+            r = inner()
+            if r is not None:
+                self._dedup_put(req_id, ("resp", r))
+            return r
+
+        return _Park(ready=ready, deadline=park.deadline, wait_key=park.wait_key)
 
     @staticmethod
     def _ok(value: Any = None) -> dict:
@@ -689,6 +796,29 @@ class KVServer:
         name, rank = req["name"], req["rank"]
         world_size = int(req["world_size"])
         deadline = time.monotonic() + req.get("timeout", 0.0)
+        req_id = req.get("req_id")
+        if req_id is not None:
+            hit = self._dedup.get(req_id)
+            if hit is not None and hit[0] == "barrier":
+                # Retry of a blocking join whose arrival already landed (the
+                # first attempt's connection died while parked). Re-wait on
+                # the same round without re-applying — a blind re-join would
+                # surface as a spurious "joined twice" overflow.
+                bname, gen0 = hit[1]
+                b0 = self._barriers.get(bname)
+                if b0 is None:
+                    return self._ok(None)
+                if b0.generation != gen0:
+                    return self._ok(b0.generation)
+
+                def replay_ready() -> Optional[dict]:
+                    if b0.generation != gen0:
+                        return self._ok(b0.generation)
+                    return None
+
+                return _Park(
+                    ready=replay_ready, deadline=deadline, wait_key=("b", id(b0))
+                )
         b = self._barriers.setdefault(name, _Barrier())
         if b.world_size and b.world_size != world_size:
             # Mismatch within an in-progress round is a protocol error.
@@ -731,6 +861,11 @@ class KVServer:
                 f"barrier {name!r}: {len(b.arrived | b.absent)} arrivals > "
                 f"world {world_size}"
             )
+        if req_id is not None and req.get("wait", True):
+            # Arrival applied but the response may be a long way off (park):
+            # mark it so a retried join re-waits instead of double-arriving.
+            # Overwritten with the real response when it materializes.
+            self._dedup_put(req_id, ("barrier", (name, gen)))
         if self._barrier_maybe_release(b):
             self._notify(("b", id(b)))
             return self._ok(b.generation)
@@ -827,31 +962,52 @@ class KVClient:
         timeout: float = 300.0,
         connect_retries: int = 60,
         auth_key: str | None = None,
+        retry_budget: float = 8.0,
     ):
         self.host, self.port = host, port
         self.default_timeout = timeout
+        #: total wall-clock budget for transparent transport-failure retries of
+        #: one call (exponential backoff 50ms → 1s). 0 disables retrying.
+        self.retry_budget = retry_budget
         if auth_key is None:
             auth_key = os.environ.get(AUTH_KEY_ENV) or None
         self.auth_key = auth_key
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._closed = False
+        #: req_id prefix unique to this client instance; the sequence makes
+        #: each non-idempotent call's nonce unique for the server's dedup LRU.
+        self._client_id = secrets.token_hex(8)
+        self._req_seq = itertools.count()
         self._sock = self._connect(connect_retries)
 
     def _connect(self, retries: int = 3) -> socket.socket:
+        # Breaker open: one probe, no sleep ladder. Only clamps the small
+        # in-call reconnect (an explicit high-retry construction — e.g. the
+        # in-process Wrapper waiting out a store re-host — keeps its patience).
+        if retries <= 3 and _breaker_open(self.host, self.port):
+            retries = 1
         delay = 0.05
         last: Exception | None = None
         for _ in range(max(1, retries)):
+            if self._closed:
+                # close() raced the retry loop: stop reconnecting a client
+                # nobody will ever use instead of sleeping out the budget.
+                raise StoreError("store client is closed")
             try:
+                chaos.check_connect("store", peer=f"{self.host}:{self.port}")
                 sock = socket.create_connection((self.host, self.port), timeout=30.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock = chaos.wrap(sock, "store", peer=f"{self.host}:{self.port}")
                 self._client_handshake(sock)
                 return sock
             except (OSError, EOFError, StoreError, ValueError) as e:
                 last = e
                 time.sleep(delay)
                 delay = min(delay * 1.7, 2.0)
-        raise StoreError(f"cannot connect to store at {self.host}:{self.port}: {last!r}")
+        raise StoreTransportError(
+            f"cannot connect to store at {self.host}:{self.port}: {last!r}"
+        )
 
     def _client_handshake(self, sock: socket.socket) -> None:
         _client_hello(sock, self.auth_key)
@@ -866,18 +1022,57 @@ class KVClient:
                     self._sock = None
 
     def _call(self, req: dict, *, op_timeout: float | None = None) -> Any:
-        """One request/response round-trip.
+        """One request/response round-trip, transparently retried across
+        transport faults.
 
         Fast ops share the persistent socket; ops whose server-side wait can be long run
         on their own one-shot connection so they never starve concurrent control traffic
         (e.g. a heartbeat behind a 300 s barrier join). The socket timeout exceeds the
         server-side operation timeout so server waits surface as protocol timeouts.
         Any transport error invalidates the persistent socket — a half-read frame means
-        framing can no longer be trusted — and the next call reconnects.
+        framing can no longer be trusted — and the call reconnect-and-retries under
+        ``retry_budget`` with exponential backoff. Idempotent ops
+        (:data:`_IDEMPOTENT_OPS`) reissue blindly; non-idempotent ops
+        (:data:`_NONIDEMPOTENT_OPS`) carry a client-minted ``req_id`` nonce the
+        server dedups, so a retry whose first attempt *did* land replays the
+        recorded response instead of double-applying. Server-side error
+        responses are never retried — only the wire is.
         """
+        op = req.get("op")
+        if op in _NONIDEMPOTENT_OPS and "req_id" not in req:
+            req = dict(req, req_id=f"{self._client_id}:{next(self._req_seq)}")
         wait_s = op_timeout or 0.0
-        if wait_s > _BLOCKING_THRESHOLD_S:
-            return self._call_oneshot(req, wait_s)
+        breaker_open = _breaker_open(self.host, self.port)
+        deadline = time.monotonic() + (0.0 if breaker_open else self.retry_budget)
+        delay = 0.05
+        failed = False
+        while True:
+            try:
+                if wait_s > _BLOCKING_THRESHOLD_S:
+                    out = self._call_oneshot(req, wait_s)
+                else:
+                    out = self._call_persistent(req, wait_s)
+                if failed or breaker_open:
+                    _breaker_clear(self.host, self.port)
+                if failed:
+                    _retry_event(op, "recovered")
+                return out
+            except StoreTransportError:
+                failed = True
+                if self._closed or time.monotonic() + delay >= deadline:
+                    if not breaker_open:
+                        # A whole budget spent without one successful
+                        # reconnect: open the breaker so subsequent calls (any
+                        # client of this endpoint) fail fast instead of each
+                        # burning a fresh budget against a server that is gone.
+                        _breaker_trip(self.host, self.port, self.retry_budget)
+                        _retry_event(op, "exhausted")
+                    raise
+                _retry_event(op, "retried")
+                time.sleep(delay)
+                delay = min(delay * 1.7, 1.0)
+
+    def _call_persistent(self, req: dict, wait_s: float) -> Any:
         with self._lock:
             if self._closed:
                 raise StoreError("store client is closed")
@@ -893,7 +1088,7 @@ class KVClient:
                 except OSError:
                     pass
                 self._sock = None
-                raise StoreError(f"store transport failure: {e!r}") from e
+                raise StoreTransportError(f"store transport failure: {e!r}") from e
         return self._parse(req, resp)
 
     def _call_oneshot(self, req: dict, wait_s: float) -> Any:
@@ -904,7 +1099,7 @@ class KVClient:
                 framing.send_obj(sock, req)
                 resp = framing.recv_obj(sock)
             except (ConnectionError, EOFError, OSError) as e:
-                raise StoreError(f"store transport failure: {e!r}") from e
+                raise StoreTransportError(f"store transport failure: {e!r}") from e
         finally:
             try:
                 sock.close()
@@ -938,9 +1133,16 @@ class KVClient:
         return self._call({"op": "get", "key": key, "timeout": t}, op_timeout=t)
 
     def try_get(self, key: str, default: Any = None) -> Any:
+        """Opportunistic read: ``default`` on a missing key *or* a transport
+        failure (retry budget exhausted against a dead socket/server). Callers
+        use this for best-effort probes — they must never crash on a blip."""
         try:
             return self.get(key, timeout=0.0)
         except StoreTimeoutError:
+            return default
+        except StoreError:
+            if self._closed:
+                raise
             return default
 
     def check(self, keys: Iterable[str]) -> bool:
@@ -1171,9 +1373,11 @@ class CoordStore(StoreView):
         timeout: float = 300.0,
         connect_retries: int = 60,
         auth_key: str | None = None,
+        retry_budget: float = 8.0,
     ):
         client = KVClient(
-            host, port, timeout=timeout, connect_retries=connect_retries, auth_key=auth_key
+            host, port, timeout=timeout, connect_retries=connect_retries,
+            auth_key=auth_key, retry_budget=retry_budget,
         )
         super().__init__(client, prefix)
 

@@ -431,6 +431,25 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "tpu_replication_mbps", "p2p shard transfer throughput (MB/s)",
                 THROUGHPUT_BUCKETS_MBPS, direction=d,
             ).observe(rec["mbps"])
+    elif kind == "store_retry":
+        reg.counter(
+            "tpu_store_retries_total",
+            "store-client transparent transport retries by op and outcome "
+            "(retried per attempt; recovered/exhausted once per call)",
+            op=str(rec.get("op", "?")), outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "peer_degraded":
+        reg.counter(
+            "tpu_replication_peer_degraded_total",
+            "replication peers dropped for a round after transfer-retry "
+            "exhaustion (the save proceeded with reduced redundancy)",
+        ).inc()
+    elif kind == "chaos_inject":
+        reg.counter(
+            "chaos_faults_injected_total",
+            "network faults injected by the chaos plan",
+            kind=str(rec.get("fault", "?")), channel=str(rec.get("channel", "?")),
+        ).inc()
     elif kind == "heartbeat_stats":
         if isinstance(rec.get("max_gap_s"), (int, float)):
             reg.histogram(

@@ -41,9 +41,15 @@ log = get_logger(__name__)
 
 
 class RankMonitorClient:
+    #: reconnect-and-retry attempts per request on a transport fault (the
+    #: monitor's UDS link is an out-of-band channel: a reset must not crash the
+    #: rank it exists to protect). The server re-inits sessions on reconnect.
+    RECONNECT_RETRIES = 2
+
     def __init__(self):
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._socket_path: Optional[str] = None
         self.rank_info: Optional[RankInfo] = None
         self.cfg = None
         self.hb_timeouts: Optional[HeartbeatTimeouts] = None
@@ -77,6 +83,7 @@ class RankMonitorClient:
             )
         self.rank_info = rank_info
         self.log.rank = rank_info.global_rank
+        self._socket_path = socket_path
         self._sock = ipc.connect(socket_path)
         reply = self._request(InitMsg(rank_info=rank_info, client_state=self._loaded_state))
         if not isinstance(reply, InitReplyMsg):
@@ -97,14 +104,64 @@ class RankMonitorClient:
                     self._sock = None
 
     def _request(self, msg):
+        """One request/reply round trip, self-healing across transport faults.
+
+        A reset or truncated reply on the monitor link reconnects, replays the
+        session ``InitMsg`` (the server rebuilds its ``_RankSession`` — same
+        re-init path a fresh client takes), and reissues ``msg`` — bounded by
+        :data:`RECONNECT_RETRIES`. Heartbeats and section signals are
+        idempotent per-session, so replay is safe; the alternative (raising
+        into the training loop) converts a socket blip into a rank death.
+        """
         with self._lock:
             if self._sock is None:
                 raise FaultToleranceError("monitor client is not initialized")
-            ipc.write_object(self._sock, msg)
-            reply = ipc.read_object(self._sock)
+            for attempt in range(self.RECONNECT_RETRIES + 1):
+                try:
+                    ipc.write_object(self._sock, msg)
+                    reply = ipc.read_object(self._sock)
+                    break
+                except (OSError, EOFError) as e:
+                    if attempt >= self.RECONNECT_RETRIES:
+                        raise FaultToleranceError(
+                            f"monitor link failed after {attempt + 1} attempts: {e!r}"
+                        ) from e
+                    self.log.warning(
+                        f"monitor link fault ({e!r}); reconnecting "
+                        f"({attempt + 1}/{self.RECONNECT_RETRIES})"
+                    )
+                    try:
+                        self._reconnect_locked()
+                    except (OSError, EOFError):
+                        # Reconnect itself faulted: the next attempt's write
+                        # fails fast on the dead socket and burns one retry.
+                        pass
         if isinstance(reply, ErrorMsg):
             raise FaultToleranceError(f"monitor error: {reply.error}")
         return reply
+
+    def _reconnect_locked(self) -> None:
+        """Dial a fresh connection and re-init the session (lock held). If the
+        caller's message WAS an InitMsg the follow-up resend is a harmless
+        second re-init."""
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        # Short dial budget: a monitor that is genuinely gone should surface
+        # within the retry window, not block a train step for 30 s per attempt.
+        self._sock = ipc.connect(self._socket_path, timeout=5.0)
+        if self.rank_info is not None and self.cfg is not None:
+            # Re-establish the session the dead connection carried; skipped
+            # during the very first init (no reply processed yet) where the
+            # retried InitMsg itself re-inits.
+            ipc.write_object(
+                self._sock,
+                InitMsg(rank_info=self.rank_info, client_state=self.state_dict()),
+            )
+            reply = ipc.read_object(self._sock)
+            if not isinstance(reply, InitReplyMsg):
+                raise FaultToleranceError(f"bad re-init reply: {reply!r}")
 
     # -- per-step signals --------------------------------------------------
 
