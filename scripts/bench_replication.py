@@ -135,7 +135,10 @@ def bench_alloc(mb: int, streaming: bool) -> float:
             fut.result()
         _, peak = tracemalloc.get_traced_memory()
         tracemalloc.stop()
-        assert memoryview(got).cast("B").nbytes == len(prefix) + nbytes
+        # Container = prefix + payload + v2 integrity trailer.
+        assert memoryview(got).cast("B").nbytes == ckpt_format.parts_nbytes(
+            prefix, views
+        )
         return (peak - base) / nbytes
     finally:
         for ex in exs:

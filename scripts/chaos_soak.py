@@ -1,6 +1,6 @@
-"""Chaos soak: drive the coordination plane through seeded network fault plans.
+"""Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Three scenarios, each asserting the job converges to a CORRECT final state
+Four scenarios, each asserting the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -11,6 +11,14 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
 - **replication**: a 3-clique ``replicate()`` + ``retrieve()`` round under p2p
   faults. Convergence = every surviving mirror and every routed shard is
   byte-identical to the payload its owner saved.
+- **disk**: two ranks save two replicated checkpoint iterations while a seeded
+  ``disk.write.bitflip`` plan corrupts one rank's newest shard at write time;
+  ``LocalCheckpointManager.load()`` must climb the recovery ladder. With only
+  the rank's own copy corrupt: quarantine → peer retrieve → byte-identical
+  tree, no exception. With the clique mirror ALSO corrupt (``--fallback``
+  variant): every rank agrees on and loads the older iteration. Both variants
+  assert ``ckpt_quarantined`` events and ``tpu_ckpt_integrity_failures_total``
+  in the aggregated metrics.
 - **launcher**: the real ``tpu-ft-launcher`` restart chain (worker fails round
   0, succeeds round 1) with FT monitors on, under env-propagated chaos hitting
   the store AND ipc channels. Convergence = exit 0 + the events file shows at
@@ -177,6 +185,124 @@ def scenario_replication(seed: int, world: int = 3, mb: int = 1,
     return plan.schedule()
 
 
+# -- scenario: disk integrity + recovery ladder ------------------------------
+
+#: Corrupt rank 0's OWN copy of its iteration-2 shard at write time; the
+#: clique mirror in r1's dir (same filename, different holder dir) stays
+#: intact, so load() must recover via peer retrieve.
+DISK_SPEC_OWN = "{seed}:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+#: Corrupt BOTH copies (own shard and the r1-held mirror): the only rung left
+#: is the group-agreed fallback to iteration 1.
+DISK_SPEC_BOTH = (
+    DISK_SPEC_OWN + ";disk.write.bitflip@peer=r1/iter_0000002_0_local.ckpt"
+)
+
+
+def scenario_disk(seed: int, fallback: bool = False, spec: str | None = None):
+    """Seeded disk corruption of rank 0's newest shard under real saves, then
+    a collective ``load()`` exercising the recovery ladder end to end.
+    Returns the injection schedule; raises on any divergence from the
+    expected recovery (byte-identical peer retrieve, or group-agreed
+    fallback when the replica is corrupt too)."""
+    import shutil
+    import numpy as np
+
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils.metrics import aggregate
+
+    world = 2
+    plan = chaos.ChaosPlan.parse(
+        spec or (DISK_SPEC_BOTH if fallback else DISK_SPEC_OWN).format(seed=seed)
+    )
+    chaos.install_plan(plan)
+    seen: list = []
+    tpu_events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0)
+    root = tempfile.mkdtemp(prefix="chaos_disk.")
+    stores: list = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=30.0)
+        stores.append(s)
+        return s
+
+    def tree(rank: int, it: int):
+        return {"w": np.full((2048,), rank * 10.0 + it, np.float32), "step": it}
+
+    def body(rank: int, gen: int, do_save: bool):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=60.0,
+                         generation=gen)
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world
+            )
+            mgr = LocalCheckpointManager(
+                root, rank=rank, comm=comm, replication=strat, keep=2
+            )
+            if do_save:
+                # Materialized saves: deterministic per-file write sequences,
+                # which is what makes the injection schedule reproducible.
+                mgr.save(1, PyTreeStateDict(tree(rank, 1)), is_async=False)
+                mgr.save(2, PyTreeStateDict(tree(rank, 2)), is_async=False)
+            it_loaded, tensors = None, None
+            if not do_save:
+                hollow, tensors, meta = mgr.load()
+                it_loaded = meta["iteration"]
+                tensors = np.asarray(tensors[0]).copy()
+            mgr.close()
+            return it_loaded, tensors
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(body, r, 0, True) for r in range(world)]:
+                f.result(timeout=120)
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            loaded = [
+                f.result(timeout=120)
+                for f in [pool.submit(body, r, 1, False) for r in range(world)]
+            ]
+        want_iter = 1 if fallback else 2
+        for rank, (it, w) in enumerate(loaded):
+            assert it == want_iter, (
+                f"rank {rank} resumed from iteration {it}, wanted {want_iter} "
+                f"(ladder {'fallback' if fallback else 'peer retrieve'} failed)"
+            )
+            expect = np.full((2048,), rank * 10.0 + want_iter, np.float32)
+            assert np.array_equal(w, expect), (
+                f"rank {rank}: recovered tree not byte-identical @ iter {it}"
+            )
+        quarantined = [e for e in seen if e.kind == "ckpt_quarantined"]
+        assert quarantined, "corrupt shard was never quarantined"
+        rdir = os.path.join(root, "s0", "r0")
+        assert any(".corrupt" in n for n in os.listdir(rdir)), (
+            "no *.corrupt forensics file in the holder dir"
+        )
+        if fallback:
+            assert any(e.kind == "ckpt_fallback" for e in seen), (
+                "group never recorded the fallback decision"
+            )
+        # The acceptance surface: the same aggregation the metrics-dump CLI
+        # runs must show the integrity counters.
+        reg = aggregate([{"kind": e.kind, **e.payload} for e in seen])
+        prom = reg.to_prometheus()
+        assert "tpu_ckpt_integrity_failures_total" in prom, prom[:2000]
+        assert 'kind="ckpt_quarantined"' in prom, prom[:2000]
+    finally:
+        chaos.clear_plan()
+        tpu_events.remove_sink(seen.append)
+        for s in stores:
+            s.close()
+        srv.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return plan.schedule()
+
+
 # -- scenario: launcher restart chain ---------------------------------------
 
 LAUNCHER_SPEC = (
@@ -281,6 +407,16 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     r2 = scenario_replication(seed, spec=repl_spec)
     assert r1 == r2, f"replication schedule not reproducible:\n{r1}\n{r2}"
     out["replication_injections"] = [list(i) for i in r1]
+    # Disk-fault ladder, both rungs, each run twice per seed: the injection
+    # schedule (per-file write indices) must reproduce exactly.
+    d1 = scenario_disk(seed)
+    d2 = scenario_disk(seed)
+    assert d1 == d2, f"disk schedule not reproducible:\n{d1}\n{d2}"
+    f1 = scenario_disk(seed, fallback=True)
+    f2 = scenario_disk(seed, fallback=True)
+    assert f1 == f2, f"disk-fallback schedule not reproducible:\n{f1}\n{f2}"
+    out["disk_injections"] = [list(i) for i in d1]
+    out["disk_fallback_injections"] = [list(i) for i in f1]
     if with_launcher:
         counts = scenario_launcher(seed, os.path.join(workdir, f"launcher_{seed}"))
         out["launcher_injections"] = {f"{c}.{k}": n for (c, k), n in counts.items()}

@@ -64,7 +64,34 @@ python -m tpu_resiliency.tools.metrics_dump "$EVENTS" | sed 's/^/    /'
 echo "== smoke: pipelined checkpoint save (spans + staging metrics)"
 python scripts/bench_ckpt_save.py --smoke
 
-echo "== smoke: chaos (seeded fault injection across store/p2p/ipc channels)"
+echo "== smoke: checkpoint integrity (v2 checksums + ckpt_info --verify preflight)"
+python - "$WORKDIR" <<'PY'
+import os, sys
+import numpy as np
+from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+
+root = os.path.join(sys.argv[1], "ckpt_root")
+mgr = LocalCheckpointManager(root, rank=0)
+mgr.save(1, PyTreeStateDict({"w": np.arange(4096, dtype=np.float32)}), is_async=False)
+mgr.close()
+PY
+python -m tpu_resiliency.tools.ckpt_info "$WORKDIR/ckpt_root" --verify
+python - "$WORKDIR" <<'PY'
+import os, sys
+rdir = os.path.join(sys.argv[1], "ckpt_root", "s0", "r0")
+path = [os.path.join(rdir, n) for n in os.listdir(rdir) if n.endswith(".ckpt")][0]
+with open(path, "r+b") as f:          # flip one payload bit
+    f.seek(os.path.getsize(path) // 2)
+    b = f.read(1); f.seek(-1, 1); f.write(bytes([b[0] ^ 1]))
+PY
+if python -m tpu_resiliency.tools.ckpt_info "$WORKDIR/ckpt_root" --verify; then
+    echo "FAIL: ckpt_info --verify missed an injected bit flip"; exit 1
+else
+    echo "integrity OK: --verify caught the flipped bit (exit 1 as designed)"
+fi
+
+echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels)"
 python scripts/chaos_soak.py --smoke
 
 echo "smoke_observability: PASS ($WORKDIR)"

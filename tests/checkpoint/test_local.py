@@ -126,7 +126,7 @@ class TestPeerExchange:
             ex.start()
             try:
                 ex.send(1 - rank, "t", f"hello-{rank}".encode())
-                return ex.recv(1 - rank, "t").decode()
+                return bytes(ex.recv(1 - rank, "t")).decode()
             finally:
                 ex.close()
 
@@ -159,7 +159,7 @@ class TestPeerExchange:
             ex.start()
             try:
                 ex.send(1 - rank, "t", f"auth-{rank}".encode())
-                got = ex.recv(1 - rank, "t").decode()
+                got = bytes(ex.recv(1 - rank, "t")).decode()
                 if rank == 0:
                     # A keyless client cannot deliver to an authenticated peer.
                     bad = PeerExchange(make_store(), 7, timeout=5.0, auth_key=None)
@@ -196,7 +196,7 @@ class TestCliqueReplication:
                     comm, ex, replication_jump=1, replication_factor=2
                 )
                 held = strat.replicate(f"shard-{rank}".encode())
-                return {owner: blob.decode() for owner, blob in held.items()}
+                return {owner: bytes(blob).decode() for owner, blob in held.items()}
             finally:
                 ex.close()
 
@@ -499,7 +499,7 @@ class TestLazyCliqueReplication:
                 assert strat.comm is None and strat.groups is None
                 held = strat.replicate(f"blob-{rank}".encode())
                 assert strat.my_group == [0, 1]
-                return {o: b.decode() for o, b in held.items()}
+                return {o: bytes(b).decode() for o, b in held.items()}
             finally:
                 ex.close()
 

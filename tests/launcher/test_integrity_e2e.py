@@ -1,0 +1,44 @@
+"""Acceptance: under a seeded disk-fault plan corrupting one rank's newest
+shard at write time, a collective ``load()`` recovers a byte-identical tree
+via peer retrieve without raising; with the replica also corrupted, all ranks
+agree on and load the same older iteration. Both runs show
+``ckpt_quarantined`` events and ``tpu_ckpt_integrity_failures_total`` in the
+aggregated metrics, and the injection schedule reproduces from the seed.
+
+Drives ``scripts/chaos_soak.py``'s disk scenario — the same harness operators
+run by hand — rather than re-implementing it (the scenario itself asserts
+recovery correctness and metric visibility; divergence raises)."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import chaos_soak  # noqa: E402
+
+pytestmark = pytest.mark.chaos
+
+
+def test_disk_fault_recovers_via_peer_and_reproduces():
+    s1 = chaos_soak.scenario_disk(seed=77)
+    s2 = chaos_soak.scenario_disk(seed=77)
+    assert s1 == s2, "same-seed disk runs diverged in injection schedule"
+    assert any(k == "bitflip" for _, _, k, _ in s1)
+    assert all(ch == "disk" and op == "write" for ch, op, _, _ in s1)
+
+
+def test_disk_fault_with_corrupt_replica_falls_back_groupwide():
+    s1 = chaos_soak.scenario_disk(seed=77, fallback=True)
+    s2 = chaos_soak.scenario_disk(seed=77, fallback=True)
+    assert s1 == s2, "same-seed fallback runs diverged in injection schedule"
+    # Both copies' write paths were hit (two distinct per-file index-0 flips).
+    assert [i for _, _, _, i in s1].count(0) >= 2
+
+
+def test_different_seeds_still_converge():
+    """The recovery contract is seed-independent: any bitflip placement must
+    be absorbed by the ladder."""
+    chaos_soak.scenario_disk(seed=123456)

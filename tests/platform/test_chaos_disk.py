@@ -1,0 +1,145 @@
+"""The chaos ``disk`` channel: rule grammar, per-file deterministic schedules,
+and each fault kind's observable effect on checkpoint containers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.platform import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear_plan()
+    yield
+    chaos.clear_plan()
+
+
+def _write(tmp_path, name="r0/iter_0000002_0_local.ckpt", n=1024):
+    path = os.path.join(str(tmp_path), name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    ckpt_format.write_payload(path, b"h", [np.arange(n, dtype=np.float32)])
+    return path
+
+
+class TestGrammar:
+    def test_disk_rules_parse_with_default_p(self):
+        plan = chaos.ChaosPlan.parse(
+            "9:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt;"
+            "disk.commit.torn-rename@at=1;disk.write.enospc@n=2;"
+            "disk.write.slow-io@p=0.5,delay=0.001"
+        )
+        kinds = [r.kind for r in plan.rules]
+        assert kinds == ["bitflip", "torn-rename", "enospc", "slow-io"]
+        assert plan.rules[0].p == 1.0  # always-on kinds default p=1.0
+        assert plan.rules[2].n == 2
+
+    def test_network_kinds_still_require_schedule(self):
+        with pytest.raises(ValueError, match="needs at= or p="):
+            chaos.ChaosPlan.parse("1:store.send.reset")
+
+    def test_disk_peer_names_holder_and_file(self):
+        assert (
+            chaos.disk_peer("/ssd/ckpt/s0/r1/iter_0000002_0_local.ckpt.dirty")
+            == "r1/iter_0000002_0_local.ckpt"
+        )
+
+
+class TestBitflip:
+    def test_deterministic_corruption_and_schedule(self, tmp_path):
+        spec = "9:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+
+        def run(sub):
+            plan = chaos.ChaosPlan.parse(spec)
+            chaos.install_plan(plan)
+            try:
+                path = _write(tmp_path / sub)
+            finally:
+                chaos.clear_plan()
+            return plan.schedule(), open(path, "rb").read(), path
+
+        s1, bytes1, p1 = run("a")
+        s2, bytes2, _ = run("b")
+        assert s1 == s2, "same-seed disk schedules diverged"
+        assert bytes1 == bytes2, "bit-flip offsets not deterministic from seed"
+        assert ckpt_format.verify_file(p1)[0] == "corrupt"
+        with pytest.raises(CheckpointError):
+            ckpt_format.read_payload(p1)
+
+    def test_untargeted_files_untouched(self, tmp_path):
+        chaos.install_plan(chaos.ChaosPlan.parse(
+            "9:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+        ))
+        path = _write(tmp_path, name="r1/iter_0000002_0_local.ckpt")
+        chaos.clear_plan()
+        assert ckpt_format.verify_file(path)[0] == "ok"
+
+    def test_wildcard_network_rules_never_touch_disk(self, tmp_path):
+        chaos.install_plan(chaos.ChaosPlan.parse("5:*.*.reset@p=1.0"))
+        path = _write(tmp_path)
+        chaos.clear_plan()
+        assert ckpt_format.verify_file(path)[0] == "ok"
+
+
+class TestCommitFaults:
+    @pytest.mark.parametrize("kind", ["truncate", "torn-rename"])
+    def test_commit_fault_leaves_detectably_torn_file(self, tmp_path, kind):
+        chaos.install_plan(chaos.ChaosPlan.parse(f"5:disk.commit.{kind}@at=0"))
+        path = _write(tmp_path)
+        chaos.clear_plan()
+        assert os.path.exists(path), "commit faults still produce a visible file"
+        status, detail = ckpt_format.verify_file(path)
+        assert status == "corrupt" and "size mismatch" in detail
+        with pytest.raises(CheckpointError, match="size mismatch"):
+            ckpt_format.read_payload(path)
+
+
+class TestEnospc:
+    def test_enospc_raises_and_leaves_only_dirty(self, tmp_path):
+        chaos.install_plan(chaos.ChaosPlan.parse("3:disk.write.enospc@at=0"))
+        path = os.path.join(str(tmp_path), "r0", "iter_0000001_0_local.ckpt")
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with pytest.raises(OSError) as exc:
+            ckpt_format.write_payload(path, b"h", [np.ones(8, np.float32)])
+        chaos.clear_plan()
+        import errno
+
+        assert exc.value.errno == errno.ENOSPC
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ckpt_format.DIRTY_SUFFIX)
+
+
+class TestSlowIo:
+    def test_slow_io_delays_but_preserves_integrity(self, tmp_path):
+        chaos.install_plan(chaos.ChaosPlan.parse(
+            "4:disk.write.slow-io@n=1,delay=0.01"
+        ))
+        path = _write(tmp_path)
+        chaos.clear_plan()
+        assert ckpt_format.verify_file(path)[0] == "ok"
+
+
+class TestEvents:
+    def test_disk_injections_emit_chaos_events(self, tmp_path):
+        from tpu_resiliency.utils import events
+
+        seen = []
+        events.add_sink(seen.append)
+        chaos.install_plan(chaos.ChaosPlan.parse(
+            "7:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt,n=1"
+        ))
+        try:
+            _write(tmp_path)
+        finally:
+            chaos.clear_plan()
+            events.remove_sink(seen.append)
+        inj = [e for e in seen if e.kind == "chaos_inject"]
+        assert len(inj) == 1
+        assert inj[0].payload["channel"] == "disk"
+        assert inj[0].payload["fault"] == "bitflip"
+        assert inj[0].payload["peer"] == "r0/iter_0000002_0_local.ckpt"

@@ -19,7 +19,6 @@ job of orbax-style global checkpointing; local resiliency needs the per-rank for
 
 from __future__ import annotations
 
-import itertools
 import os
 import pickle
 import time
@@ -116,18 +115,26 @@ def _write_containers_stream(writes, snapshot, cleanup=()) -> None:
         len(hollow_bytes) + sum(snapshot.specs[i]["nbytes"] for i in indices)
         for _, hollow_bytes, indices, _, _ in writes
     )
+
+    def chunks(prefix, indices):
+        # One pass feeds both the file and the integrity trailer: each leaf's
+        # CRC is taken from the same resolved view the writer streams, so the
+        # v2 checksums cost no extra payload read.
+        ck = ckpt_format.Checksummer(prefix)
+        yield prefix
+        for i in indices:
+            view = snapshot.resolve_view(i)
+            ck.add_leaf(view)
+            yield view
+        yield ck.trailer()
+
     t0 = time.perf_counter()
     try:
         for path, hollow_bytes, indices, meta, container in writes:
             prefix = ckpt_format.header_prefix(
                 hollow_bytes, [snapshot.specs[i] for i in indices], meta
             )
-            written = ckpt_format.write_stream(
-                path,
-                itertools.chain(
-                    (prefix,), (snapshot.resolve_view(i) for i in indices)
-                ),
-            )
+            written = ckpt_format.write_stream(path, chunks(prefix, indices))
             record_event(
                 "checkpoint", "ckpt_write_file",
                 file=os.path.basename(path), container=container,

@@ -168,6 +168,13 @@ class StoreComm:
     def all_reduce_max(self, value, tag: str = "max"):
         return max(self.all_gather(value, tag=tag))
 
+    def all_reduce_min(self, value, tag: str = "min"):
+        """Group-wide minimum — the recovery ladder's fallback-iteration
+        agreement: every rank proposes its newest passing iteration and all
+        adopt the smallest, so no rank can resume ahead of a peer whose disk
+        lost more."""
+        return min(self.all_gather(value, tag=tag))
+
     def make_sync_fn(self):
         """Adapter for :class:`AsyncCallsQueue`'s ``sync_fn``."""
 
@@ -206,10 +213,21 @@ class PeerExchange:
         auth_key: Optional[str] = None,
         protocol: Optional[int] = None,
         send_retries: int = 3,
+        wire_checksums: bool = False,
     ):
         self.store = store.scoped("p2p")
         self.rank = rank
         self.timeout = timeout
+        #: Stamp a payload CRC into every ``send_parts`` bulk-frame header;
+        #: the receiving side (``framing.recv_any``) verifies it and drops a
+        #: mismatching frame like any malformed one (the sender-side retry /
+        #: degraded-peer machinery then owns recovery). Off by default: v2
+        #: checkpoint containers already carry end-to-end trailer checksums
+        #: that cover the wire for free, and the extra CRC pass costs a full
+        #: memory read per send. Turn on for non-container payloads or
+        #: belt-and-braces wire auditing. ``send_file``/streamed sends never
+        #: stamp one (the header is gone before the payload is known).
+        self.wire_checksums = bool(wire_checksums)
         #: dial-and-send attempts per peer before a send surfaces
         #: :class:`CheckpointError`. Each retry re-resolves the peer's address
         #: from the store and re-runs the hello handshake, so a peer that
@@ -466,9 +484,19 @@ class PeerExchange:
         t0 = time.perf_counter()
         with conn:
             if self._use_bulk(peer_v):
-                nbytes = framing.send_bulk(
-                    conn, {"src": self.rank, "tag": tag}, parts
-                )
+                header = {"src": self.rank, "tag": tag}
+                if self.wire_checksums:
+                    from tpu_resiliency.checkpoint import format as ckpt_format
+
+                    crc = 0
+                    for p in parts:
+                        crc = ckpt_format.crc32c(p, crc)
+                    # The algo rides along so a receiver built with the OTHER
+                    # checksum implementation skips verification instead of
+                    # dropping every frame as a false mismatch.
+                    header["crc32c"] = crc
+                    header["crc_algo"] = ckpt_format.CRC_ALGO
+                nbytes = framing.send_bulk(conn, header, parts)
                 frame = "bulk"
             else:
                 blob = b"".join(bytes(memoryview(p).cast("B")) for p in parts)

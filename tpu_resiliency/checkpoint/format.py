@@ -29,11 +29,42 @@ Atomicity follows the reference's ``.dirty``-then-rename protocol
 ``<path>.dirty``, fsync, ``os.replace``. A crash leaves only ``.dirty`` files, which
 cleanup removes; a visible file is always complete.
 
-Layout::
+**Integrity (format v2, ``TPURES02``).** Atomic renames protect against torn
+*writes*, not against what storage does to committed bytes: a flipped bit on
+worn NVMe, a post-crash tail loss, a torn rename all yield a structurally
+plausible container that deserializes into silently wrong weights. v2
+containers therefore carry end-to-end checksums, computed streaming in every
+write path and verified streaming on every read path:
 
-    MAGIC(8) | header_len(8 LE) | header pickle | leaf 0 bytes | leaf 1 bytes | ...
+- **per-leaf CRC32C** — recorded in the header leaf specs when the writer has
+  the payload in hand (:func:`write_payload`, :func:`serialize_parts`), and
+  ALWAYS in the trailer (the pipelined save only learns a leaf's CRC as its
+  D2H copy resolves, after the header is long gone down the wire);
+- **a whole-file trailer digest** — CRC over the container head extended with
+  each leaf's packed CRC (a digest-of-digests: every byte of the file is
+  covered in ONE streaming pass over the payload, no second read).
 
-Header: ``{"hollow": bytes, "leaves": [{"shape", "dtype", "nbytes"}, ...], "meta": {}}``.
+``TPURES01`` containers still load — verification is skipped and a
+``ckpt_unverified`` event is recorded, so a fleet can tell "old format" from
+"verified" in its metrics. The CRC implementation is ``google_crc32c`` when
+the host has it, gated down to stdlib ``zlib.crc32`` otherwise; the trailer
+records which algorithm signed the file, and a reader lacking that algorithm
+degrades to unverified-with-event rather than failing the load.
+
+This module is also the **disk-fault injection boundary**: every container
+write and every ``.dirty``→visible commit funnels through a patchable IO shim
+(:func:`_disk_write`, :func:`_commit_atomic`) that consults the chaos plan's
+``disk`` channel (``platform/chaos.py``: seeded bit flips, post-commit
+truncation, torn renames, ENOSPC, slow IO), so corruption scenarios reproduce
+from a seed exactly like network fault plans.
+
+Layout (v2)::
+
+    MAGIC(8) | header_len(8 LE) | header pickle | leaf 0 bytes | ... |
+    TRAILER_MAGIC(8) | algo(4) | nleaves(4 LE) | leaf_crc32c(4 LE)*n | container_crc(4 LE)
+
+Header: ``{"hollow": bytes, "leaves": [{"shape", "dtype", "nbytes"[, "crc32c"]},
+...], "meta": {}}``.
 """
 
 from __future__ import annotations
@@ -46,10 +77,67 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from tpu_resiliency.exceptions import CheckpointError
+from tpu_resiliency.platform import chaos
+from tpu_resiliency.utils.events import record as record_event
 
-MAGIC = b"TPURES01"
+#: Current container version: v2 adds per-leaf CRCs + the integrity trailer.
+MAGIC = b"TPURES02"
+#: v1 containers (pre-integrity) still load, unverified (``ckpt_unverified``).
+MAGIC_V1 = b"TPURES01"
+_MAGICS = (MAGIC, MAGIC_V1)
+TRAILER_MAGIC = b"TPURESCK"
 _LEN = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
 DIRTY_SUFFIX = ".dirty"
+#: Quarantine suffix the recovery ladder renames corrupt containers to.
+CORRUPT_SUFFIX = ".corrupt"
+
+# -- checksum implementation --------------------------------------------------
+#
+# CRC32C (Castagnoli) via google_crc32c when the image ships it; stdlib
+# zlib.crc32 (IEEE) otherwise — no new dependencies either way. The trailer
+# records the signing algorithm, so readers on a host with the OTHER
+# implementation degrade to unverified-with-event instead of false alarms.
+try:
+    import google_crc32c as _crc_impl
+
+    CRC_ALGO = "crc32c"
+    _ALGO_TAG = b"c32c"
+    #: google_crc32c's C binding only accepts ``bytes``; chunk the copy so the
+    #: transient allocation stays bounded at any payload size.
+    _CRC_CHUNK = 4 << 20
+
+    def crc32c(data, crc: int = 0) -> int:
+        """Streaming checksum update over any bytes-like (CRC32C here; the
+        gated zlib fallback keeps the same signature and the trailer's algo
+        tag tells readers which one signed the file)."""
+        if isinstance(data, bytes):
+            return _crc_impl.extend(crc, data)
+        view = memoryview(data)
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
+        for i in range(0, view.nbytes, _CRC_CHUNK):
+            crc = _crc_impl.extend(crc, bytes(view[i : i + _CRC_CHUNK]))
+        return crc
+
+except ImportError:  # pragma: no cover - exercised only on hosts without it
+    import zlib as _crc_impl
+
+    CRC_ALGO = "crc32"
+    _ALGO_TAG = b"zl32"
+
+    def crc32c(data, crc: int = 0) -> int:
+        """Streaming checksum update (stdlib CRC32 fallback — see module doc)."""
+        if not isinstance(data, (bytes, bytearray, memoryview)):
+            data = memoryview(data)
+        if isinstance(data, memoryview) and (data.ndim != 1 or data.itemsize != 1):
+            data = data.cast("B")
+        return _crc_impl.crc32(data, crc) & 0xFFFFFFFF
+
+
+#: algo tag → can THIS host verify it (only its own tag; the two algorithms
+#: are different polynomials, not interchangeable).
+_VERIFIABLE_TAGS = (_ALGO_TAG,)
 
 #: Storage-class knob for writer parallelism (reference analogue: per-bucket
 #: writer fan-out, ``filesystem_async.py:232-334``). Default 1: on this class of
@@ -68,10 +156,117 @@ def _effective_stripes(stripes: Optional[int]) -> int:
     return max(1, int(stripes))
 
 
+# -- integrity trailer --------------------------------------------------------
+
+
+def trailer_size(nleaves: int) -> int:
+    """On-disk size of a v2 integrity trailer for ``nleaves`` leaves — fixed
+    given the leaf count, which is what lets the pipelined save declare its
+    total container size before any payload byte exists."""
+    return len(TRAILER_MAGIC) + 4 + _U32.size * (nleaves + 2)
+
+
+def build_trailer(leaf_crcs: Sequence[int], container_crc: int) -> bytes:
+    """Serialize the trailer: magic, algo tag, leaf count, per-leaf CRCs, and
+    the whole-container digest."""
+    return b"".join(
+        [
+            TRAILER_MAGIC,
+            _ALGO_TAG,
+            _U32.pack(len(leaf_crcs)),
+            *(_U32.pack(c) for c in leaf_crcs),
+            _U32.pack(container_crc),
+        ]
+    )
+
+
+def parse_trailer(buf, source: str = "container") -> tuple[bytes, list[int], int]:
+    """Parse a trailer blob → ``(algo_tag, leaf_crcs, container_crc)``; raises
+    :class:`CheckpointError` naming ``source`` when the trailer is missing or
+    structurally damaged (the usual signature of tail truncation)."""
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    fixed = len(TRAILER_MAGIC) + 4 + _U32.size
+    if mv.nbytes < fixed or bytes(mv[: len(TRAILER_MAGIC)]) != TRAILER_MAGIC:
+        raise CheckpointError(
+            f"{source}: integrity trailer missing or corrupt (truncated file?)"
+        )
+    algo = bytes(mv[len(TRAILER_MAGIC) : len(TRAILER_MAGIC) + 4])
+    (n,) = _U32.unpack(mv[len(TRAILER_MAGIC) + 4 : fixed])
+    if mv.nbytes != trailer_size(n):
+        raise CheckpointError(
+            f"{source}: integrity trailer truncated "
+            f"({mv.nbytes} bytes for {n} leaves, want {trailer_size(n)})"
+        )
+    crcs = (
+        list(struct.unpack(f"<{n}I", mv[fixed : fixed + 4 * n])) if n else []
+    )
+    (container_crc,) = _U32.unpack(mv[fixed + 4 * n :])
+    return algo, crcs, container_crc
+
+
+def _container_crc(prefix, leaf_crcs: Sequence[int]) -> int:
+    """The whole-file digest: CRC over the container head (magic + header len
+    + header pickle) extended with each leaf's packed CRC — a digest of
+    digests, so the entire file is covered by ONE streaming pass over the
+    payload (the leaf CRCs double as the file digest's input)."""
+    crc = crc32c(prefix)
+    for c in leaf_crcs:
+        crc = crc32c(_U32.pack(c), crc)
+    return crc
+
+
+class Checksummer:
+    """Streaming v2 integrity state for writers that see the container as
+    prefix-then-leaves (the pipelined save, the durable stream writer): feed
+    the header prefix at construction and each leaf view exactly once as it
+    resolves, then emit the trailer chunk. One pass, no buffering."""
+
+    def __init__(self, prefix: bytes):
+        self.leaf_crcs: list[int] = []
+        self._crc = crc32c(prefix)
+
+    def add_leaf(self, view) -> int:
+        c = crc32c(view)
+        self.leaf_crcs.append(c)
+        self._crc = crc32c(_U32.pack(c), self._crc)
+        return c
+
+    def trailer(self) -> bytes:
+        return build_trailer(self.leaf_crcs, self._crc)
+
+
+def _record_unverified(source: str, reason: str) -> None:
+    """One ``ckpt_unverified`` event per skipped verification (v1 container or
+    foreign checksum algorithm) → ``tpu_ckpt_unverified_total``."""
+    record_event(
+        "checkpoint", "ckpt_unverified", container=str(source), reason=reason
+    )
+
+
+# -- chaos-injectable IO shim -------------------------------------------------
+
+
+def _disk_write(f, data, path: str) -> int:
+    """Every buffered container write funnels here: the chaos ``disk`` channel
+    may corrupt the buffer (bitflip), stall, or raise ENOSPC. ``path`` is the
+    FINAL path (not the ``.dirty`` temp) so rules target the file a reader
+    would see. Returns bytes written."""
+    data = chaos.on_disk_write(path, data)
+    f.write(data)
+    return memoryview(data).nbytes
+
+
 def _commit_atomic(tmp: str, path: str, fsync: bool) -> None:
     """The ``.dirty``-then-rename commit tail shared by every writer: make the
-    file visible only complete, and persist the rename itself."""
+    file visible only complete, and persist the rename itself. The chaos
+    ``disk.commit`` hook injects torn renames (temp truncated before the
+    rename) and post-commit tail truncation here."""
+    post_fault = chaos.on_disk_commit(tmp, path)
     os.replace(tmp, path)
+    if post_fault is not None:
+        post_fault()
     if fsync:
         dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
         try:
@@ -80,7 +275,12 @@ def _commit_atomic(tmp: str, path: str, fsync: bool) -> None:
             os.close(dfd)
 
 
-def _pwrite_full(fd: int, view: memoryview, offset: int) -> None:
+def _pwrite_full(fd: int, view: memoryview, offset: int, path: Optional[str] = None) -> None:
+    if path is not None:
+        out = chaos.on_disk_write(path, view)
+        view = memoryview(out) if not isinstance(out, memoryview) else out
+        if view.ndim != 1 or view.itemsize != 1:
+            view = view.cast("B")
     while view.nbytes:
         n = os.pwrite(fd, view, offset)
         view = view[n:]
@@ -156,28 +356,39 @@ def write_payload(
     """
     stripes = _effective_stripes(stripes)
     arrays = [_leaf_to_numpy(t) for t in tensors]
+    # Per-leaf CRCs computed from the source buffers BEFORE anything touches
+    # disk: the checksums sign what the caller handed us, so corruption
+    # anywhere downstream (the write path itself included) is detectable.
+    leaf_crcs = [crc32c(_raw_view(a)) for a in arrays]
     header = {
         "hollow": hollow_bytes,
         "leaves": [
-            {"shape": a.shape, "dtype": _dtype_name(a.dtype), "nbytes": a.nbytes} for a in arrays
+            {
+                "shape": a.shape,
+                "dtype": _dtype_name(a.dtype),
+                "nbytes": a.nbytes,
+                "crc32c": c,
+            }
+            for a, c in zip(arrays, leaf_crcs)
         ],
         "meta": meta or {},
     }
     header_bytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+    prefix = MAGIC + _LEN.pack(len(header_bytes)) + header_bytes
+    trailer = build_trailer(leaf_crcs, _container_crc(prefix, leaf_crcs))
     tmp = path + DIRTY_SUFFIX
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    base = len(MAGIC) + _LEN.size + len(header_bytes)
-    written = base + sum(a.nbytes for a in arrays)
+    base = len(prefix)
+    payload = sum(a.nbytes for a in arrays)
+    written = base + payload + len(trailer)
     with open(tmp, "wb") as f:
-        f.write(MAGIC)
-        f.write(_LEN.pack(len(header_bytes)))
-        f.write(header_bytes)
+        _disk_write(f, prefix, path)
         # Byte-range striping splits within leaves, so even a single fused-
         # parameter leaf stripes; an all-empty payload yields no groups.
         groups = _partition_by_bytes(arrays, stripes) if stripes > 1 else []
         if not groups:
             for a in arrays:
-                f.write(_raw_view(a))
+                _disk_write(f, _raw_view(a), path)
         else:
             # Header leaves the buffered stream before any pwrite lands beyond it.
             f.flush()
@@ -187,10 +398,14 @@ def write_payload(
 
             def run(group):
                 for off, view in group:
-                    _pwrite_full(fd, view, base + off)
+                    _pwrite_full(fd, view, base + off, path)
 
             with cf.ThreadPoolExecutor(len(groups)) as pool:
                 list(pool.map(run, groups))
+            # The buffered stream's position is still at the header; land the
+            # trailer after the pwrite-extended payload.
+            f.seek(base + payload)
+        _disk_write(f, trailer, path)
         f.flush()
         if fsync:
             os.fsync(f.fileno())
@@ -199,15 +414,16 @@ def write_payload(
 
 
 def write_blob(path: str, blob: bytes, fsync: bool = True, stripes: Optional[int] = None) -> None:
-    """Atomically write an already-serialized container blob, optionally striped
-    (N threads pwrite-ing byte ranges — same knob and rationale as
-    :func:`write_payload`)."""
+    """Atomically write an already-serialized container blob (its integrity
+    trailer, when it is a v2 container, rides inside the blob verbatim),
+    optionally striped (N threads pwrite-ing byte ranges — same knob and
+    rationale as :func:`write_payload`)."""
     stripes = _effective_stripes(stripes)
     tmp = path + DIRTY_SUFFIX
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     if stripes == 1 or len(blob) < (1 << 20):
         with open(tmp, "wb") as f:
-            f.write(blob)
+            _disk_write(f, blob, path)
             f.flush()
             if fsync:
                 os.fsync(f.fileno())
@@ -220,7 +436,7 @@ def write_blob(path: str, blob: bytes, fsync: bool = True, stripes: Optional[int
             fd = f.fileno()
 
             def run(i: int) -> None:
-                _pwrite_full(fd, view[i * chunk : (i + 1) * chunk], i * chunk)
+                _pwrite_full(fd, view[i * chunk : (i + 1) * chunk], i * chunk, path)
 
             with cf.ThreadPoolExecutor(stripes) as pool:
                 list(pool.map(run, range(stripes)))
@@ -229,28 +445,88 @@ def write_blob(path: str, blob: bytes, fsync: bool = True, stripes: Optional[int
     _commit_atomic(tmp, path, fsync)
 
 
+def _read_prefix(f, source: str) -> tuple[bytes, dict, bytes]:
+    """Read and parse the container head; returns ``(magic, header,
+    raw_prefix_bytes)``. Every structural failure — wrong magic, truncated
+    length field, undecodable header pickle — surfaces as
+    :class:`CheckpointError` naming ``source``, so callers classify disk
+    damage uniformly instead of leaking ``struct``/``pickle`` internals."""
+    magic = f.read(len(MAGIC))
+    if magic not in _MAGICS:
+        raise CheckpointError(
+            f"{source}: bad magic {magic[:8]!r} (not a tpu_resiliency checkpoint)"
+        )
+    raw_len = f.read(_LEN.size)
+    if len(raw_len) != _LEN.size:
+        raise CheckpointError(f"{source}: truncated container (no header length)")
+    (hlen,) = _LEN.unpack(raw_len)
+    header_bytes = f.read(hlen)
+    if len(header_bytes) != hlen:
+        raise CheckpointError(f"{source}: truncated container header")
+    try:
+        header = pickle.loads(header_bytes)
+        for s in header["leaves"]:  # structural sanity before any payload read
+            int(s["nbytes"])
+    except Exception as e:
+        raise CheckpointError(f"{source}: corrupt container header ({e!r})") from e
+    return magic, header, magic + raw_len + header_bytes
+
+
 def read_header(path: str) -> dict:
     with open(path, "rb") as f:
-        if f.read(len(MAGIC)) != MAGIC:
-            raise CheckpointError(f"{path}: bad magic (not a tpu_resiliency checkpoint)")
-        (hlen,) = _LEN.unpack(f.read(_LEN.size))
-        return pickle.loads(f.read(hlen))
+        return _read_prefix(f, path)[1]
 
 
-def read_payload(path: str) -> tuple[bytes, list[np.ndarray], dict]:
-    """Read (hollow_bytes, tensors, meta). Tensors come back as numpy arrays."""
+def read_payload(path: str, verify: bool = True) -> tuple[bytes, list[np.ndarray], dict]:
+    """Read (hollow_bytes, tensors, meta). Tensors come back as numpy arrays.
+
+    v2 containers are verified streaming as they are read: each leaf's CRC is
+    checked the moment its bytes leave the file, then the whole-file trailer
+    digest; any mismatch raises :class:`CheckpointError` naming the path and
+    the failing leaf. v1 containers (and v2 files signed by a checksum
+    algorithm this host lacks) load with verification skipped and a
+    ``ckpt_unverified`` event. ``verify=False`` skips checksum comparison
+    (callers that already verified the same bytes, e.g. after a
+    verify-on-receive retrieve)."""
     with open(path, "rb") as f:
-        if f.read(len(MAGIC)) != MAGIC:
-            raise CheckpointError(f"{path}: bad magic (not a tpu_resiliency checkpoint)")
-        (hlen,) = _LEN.unpack(f.read(_LEN.size))
-        header = pickle.loads(f.read(hlen))
+        magic, header, prefix = _read_prefix(f, path)
+        specs = header["leaves"]
+        payload = sum(int(s["nbytes"]) for s in specs)
+        leaf_crcs = None
+        if magic == MAGIC:
+            tsize = trailer_size(len(specs))
+            expected = len(prefix) + payload + tsize
+            size = os.fstat(f.fileno()).st_size
+            if size != expected:
+                raise CheckpointError(
+                    f"{path}: container size mismatch (want {expected} bytes, "
+                    f"found {size}) — truncated or torn file"
+                )
+            f.seek(len(prefix) + payload)
+            algo, leaf_crcs, container_crc = parse_trailer(f.read(tsize), path)
+            f.seek(len(prefix))
+            if verify and algo not in _VERIFIABLE_TAGS:
+                _record_unverified(path, reason=f"algo:{algo!r}")
+                leaf_crcs = None
+            elif not verify:
+                leaf_crcs = None
+        elif verify:
+            _record_unverified(path, reason="format-v1")
         tensors = []
-        for spec in header["leaves"]:
+        for i, spec in enumerate(specs):
             buf = f.read(spec["nbytes"])
             if len(buf) != spec["nbytes"]:
                 raise CheckpointError(f"{path}: truncated payload")
+            if leaf_crcs is not None and crc32c(buf) != leaf_crcs[i]:
+                raise CheckpointError(
+                    f"{path}: leaf {i} checksum mismatch (payload corrupted)"
+                )
             tensors.append(
                 np.frombuffer(buf, dtype=resolve_dtype(spec["dtype"])).reshape(spec["shape"])
+            )
+        if leaf_crcs is not None and _container_crc(prefix, leaf_crcs) != container_crc:
+            raise CheckpointError(
+                f"{path}: container digest mismatch (header or trailer corrupted)"
             )
     return header["hollow"], tensors, header.get("meta", {})
 
@@ -264,7 +540,11 @@ def header_prefix(
     This is what lets the pipelined save commit to the container layout while
     every leaf's D2H transfer is still in flight: specs come straight off the
     device arrays' metadata, the prefix goes out to files and peer streams
-    first, and the payload bytes follow as they resolve."""
+    first, and the payload bytes follow as they resolve. Writers building a
+    prefix this way learn leaf CRCs only as leaves resolve, so their specs
+    carry no ``crc32c`` keys — the trailer (fed by a :class:`Checksummer`
+    over the same pass) is the authoritative checksum record; specs FROM
+    materialized writers pass their known CRCs through."""
     header = {
         "hollow": hollow_bytes,
         "leaves": [
@@ -272,6 +552,7 @@ def header_prefix(
                 "shape": tuple(s["shape"]),
                 "dtype": str(s["dtype"]),
                 "nbytes": int(s["nbytes"]),
+                **({"crc32c": int(s["crc32c"])} if "crc32c" in s else {}),
             }
             for s in specs
         ],
@@ -287,23 +568,34 @@ def serialize_parts(
     """Container as ``(prefix_bytes, [leaf byte views])`` — the zero-copy form.
 
     The prefix is the small ``MAGIC | header_len | header`` head; the views are
-    raw uint8 windows over each leaf's host buffer. Concatenating
+    raw uint8 windows over each leaf's host buffer, followed by one small
+    ``bytes`` part: the v2 integrity trailer (per-leaf CRCs + whole-file
+    digest, computed here from the source buffers). Concatenating
     ``prefix + views`` yields exactly :func:`serialize_to_bytes`'s blob, but no
     joined copy ever exists: senders scatter-gather the parts straight onto a
     socket (``framing.send_bulk``) and writers stream them to a file
     (:func:`write_parts`). The views alias the input tensors — keep those alive
-    (and unmutated) until the parts are consumed.
+    (and unmutated) until the parts are consumed: the recorded CRCs sign the
+    bytes as they are NOW.
     """
     arrays = [_leaf_to_numpy(t) for t in tensors]
+    views = [_raw_view(a) for a in arrays]
+    leaf_crcs = [crc32c(v) for v in views]
     prefix = header_prefix(
         hollow_bytes,
         [
-            {"shape": a.shape, "dtype": _dtype_name(a.dtype), "nbytes": a.nbytes}
-            for a in arrays
+            {
+                "shape": a.shape,
+                "dtype": _dtype_name(a.dtype),
+                "nbytes": a.nbytes,
+                "crc32c": c,
+            }
+            for a, c in zip(arrays, leaf_crcs)
         ],
         meta,
     )
-    return prefix, [_raw_view(a) for a in arrays]
+    trailer = build_trailer(leaf_crcs, _container_crc(prefix, leaf_crcs))
+    return prefix, [*views, trailer]
 
 
 def parts_nbytes(prefix: bytes, views: Sequence[Any]) -> int:
@@ -336,16 +628,16 @@ def write_stream(path: str, chunks, fsync: bool = True) -> int:
     hits the file the moment its DMA lands, not after a full-tree barrier.
     Same ``.dirty``-then-rename commit as every other writer: a producer
     raising mid-stream leaves only the ``.dirty`` temp file (the crash contract
-    startup cleanup already handles), never a torn visible container. Returns
-    bytes written."""
+    startup cleanup already handles), never a torn visible container. Chunks
+    are written verbatim — a v2 producer appends its own trailer chunk (drive
+    a :class:`Checksummer` over the prefix and leaves, then yield
+    ``ck.trailer()`` last). Returns bytes written."""
     tmp = path + DIRTY_SUFFIX
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     written = 0
     with open(tmp, "wb") as f:
         for chunk in chunks:
-            v = _chunk_view(chunk)
-            f.write(v)
-            written += v.nbytes
+            written += _disk_write(f, _chunk_view(chunk), path)
         f.flush()
         if fsync:
             os.fsync(f.fileno())
@@ -361,7 +653,32 @@ def write_parts(path: str, parts: Sequence[Any], fsync: bool = True) -> int:
     return write_stream(path, parts, fsync=fsync)
 
 
-def deserialize_from_buffer(buf) -> tuple[bytes, list[np.ndarray], dict]:
+def _parse_buffer_prefix(mv: memoryview, source: str) -> tuple[bytes, dict, int]:
+    """Buffer counterpart of :func:`_read_prefix`; returns ``(magic, header,
+    payload_offset)`` with the same uniform :class:`CheckpointError`
+    classification."""
+    if mv.nbytes < len(MAGIC) + _LEN.size:
+        raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+    magic = bytes(mv[: len(MAGIC)])
+    if magic not in _MAGICS:
+        raise CheckpointError(f"{source}: bad magic in serialized checkpoint blob")
+    off = len(MAGIC)
+    (hlen,) = _LEN.unpack(mv[off : off + _LEN.size])
+    off += _LEN.size
+    if off + hlen > mv.nbytes:
+        raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+    try:
+        header = pickle.loads(mv[off : off + hlen])
+        for s in header["leaves"]:
+            int(s["nbytes"])
+    except Exception as e:
+        raise CheckpointError(f"{source}: corrupt container header ({e!r})") from e
+    return magic, header, off + hlen
+
+
+def deserialize_from_buffer(
+    buf, verify: bool = True, source: str = "buffer"
+) -> tuple[bytes, list[np.ndarray], dict]:
     """Zero-copy deserialization: tensors come back as views over ``buf``.
 
     ``buf`` is any bytes-like (typically the single receive buffer a bulk frame
@@ -369,26 +686,51 @@ def deserialize_from_buffer(buf) -> tuple[bytes, list[np.ndarray], dict]:
     no per-leaf copies are made. The arrays alias ``buf`` — they are read-only
     when ``buf`` is, and mutating ``buf`` mutates them. Callers that outlive the
     buffer (or need writable tensors from an immutable source) copy explicitly.
+
+    v2 blobs are checksum-verified against their trailer (one streaming pass;
+    mismatch raises :class:`CheckpointError`); pass ``verify=False`` when the
+    same bytes were already verified (e.g. by a verify-on-receive retrieve).
+    v1 blobs load unverified with a ``ckpt_unverified`` event.
     """
     mv = memoryview(buf).cast("B")
-    if bytes(mv[: len(MAGIC)]) != MAGIC:
-        raise CheckpointError("bad magic in serialized checkpoint blob")
-    off = len(MAGIC)
-    (hlen,) = _LEN.unpack(mv[off : off + _LEN.size])
-    off += _LEN.size
-    header = pickle.loads(mv[off : off + hlen])
-    off += hlen
+    magic, header, off = _parse_buffer_prefix(mv, source)
+    prefix = mv[:off]
+    leaf_crcs = None
+    if magic == MAGIC:
+        payload = sum(int(s["nbytes"]) for s in header["leaves"])
+        tsize = trailer_size(len(header["leaves"]))
+        if off + payload + tsize > mv.nbytes:
+            raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+        algo, leaf_crcs, container_crc = parse_trailer(
+            mv[off + payload : off + payload + tsize], source
+        )
+        if verify and algo not in _VERIFIABLE_TAGS:
+            _record_unverified(source, reason=f"algo:{algo!r}")
+            leaf_crcs = None
+        elif not verify:
+            leaf_crcs = None
+    elif verify:
+        _record_unverified(source, reason="format-v1")
     tensors = []
-    for spec in header["leaves"]:
+    for i, spec in enumerate(header["leaves"]):
         n = spec["nbytes"]
         if off + n > mv.nbytes:
-            raise CheckpointError("truncated serialized checkpoint blob")
+            raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+        window = mv[off : off + n]
+        if leaf_crcs is not None and crc32c(window) != leaf_crcs[i]:
+            raise CheckpointError(
+                f"{source}: leaf {i} checksum mismatch (payload corrupted)"
+            )
         tensors.append(
-            np.frombuffer(mv[off : off + n], dtype=resolve_dtype(spec["dtype"])).reshape(
+            np.frombuffer(window, dtype=resolve_dtype(spec["dtype"])).reshape(
                 spec["shape"]
             )
         )
         off += n
+    if leaf_crcs is not None and _container_crc(prefix, leaf_crcs) != container_crc:
+        raise CheckpointError(
+            f"{source}: container digest mismatch (header or trailer corrupted)"
+        )
     return header["hollow"], tensors, header.get("meta", {})
 
 
@@ -396,3 +738,107 @@ def deserialize_from_bytes(blob) -> tuple[bytes, list[np.ndarray], dict]:
     """Alias of :func:`deserialize_from_buffer` (kept for callers written against
     the pre-streaming API; both are zero-copy over the input buffer now)."""
     return deserialize_from_buffer(blob)
+
+
+# -- standalone verification --------------------------------------------------
+
+
+def verify_container(buf, source: str = "frame") -> bool:
+    """Integrity-check a serialized container without materializing tensors —
+    the verify-on-receive primitive replication receivers run on every frame.
+
+    Returns ``True`` when every leaf CRC and the container digest verified;
+    ``False`` when the payload is unverifiable — a v1 container (one
+    ``ckpt_unverified`` event), a v2 file signed by a checksum algorithm this
+    host lacks, or not a container at all (replication also moves raw blobs
+    in tests/tools). Raises :class:`CheckpointError` on checksum mismatch or
+    structural corruption of a v2 container."""
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    if mv.nbytes < len(MAGIC) or bytes(mv[: len(MAGIC)]) not in _MAGICS:
+        return False
+    magic, header, off = _parse_buffer_prefix(mv, source)
+    if magic == MAGIC_V1:
+        _record_unverified(source, reason="format-v1")
+        return False
+    specs = header["leaves"]
+    payload = sum(int(s["nbytes"]) for s in specs)
+    tsize = trailer_size(len(specs))
+    if off + payload + tsize > mv.nbytes:
+        raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+    algo, leaf_crcs, container_crc = parse_trailer(
+        mv[off + payload : off + payload + tsize], source
+    )
+    if algo not in _VERIFIABLE_TAGS:
+        _record_unverified(source, reason=f"algo:{algo!r}")
+        return False
+    pos = off
+    for i, spec in enumerate(specs):
+        n = int(spec["nbytes"])
+        if crc32c(mv[pos : pos + n]) != leaf_crcs[i]:
+            raise CheckpointError(
+                f"{source}: leaf {i} checksum mismatch (payload corrupted)"
+            )
+        pos += n
+    if _container_crc(mv[:off], leaf_crcs) != container_crc:
+        raise CheckpointError(
+            f"{source}: container digest mismatch (header or trailer corrupted)"
+        )
+    return True
+
+
+def verify_file(path: str, chunk: int = 4 << 20) -> tuple[str, str]:
+    """Stream-verify one container file with bounded memory (``chunk`` bytes
+    at a time regardless of leaf sizes) — the ``ckpt_info --verify`` engine.
+
+    Returns ``(status, detail)`` with status one of ``"ok"`` (every CRC
+    verified), ``"unverified"`` (v1 container or foreign checksum algorithm —
+    structurally intact but unsigned for this host), or ``"corrupt"``
+    (checksum mismatch, truncation, or structural damage). Never raises for
+    a damaged file — the verdict IS the result."""
+    try:
+        with open(path, "rb") as f:
+            magic, header, prefix = _read_prefix(f, path)
+            specs = header["leaves"]
+            payload = sum(int(s["nbytes"]) for s in specs)
+            size = os.fstat(f.fileno()).st_size
+            if magic == MAGIC_V1:
+                if size < len(prefix) + payload:
+                    return "corrupt", (
+                        f"truncated v1 payload ({size} bytes, want at least "
+                        f"{len(prefix) + payload})"
+                    )
+                return "unverified", "format v1 (no checksums recorded)"
+            tsize = trailer_size(len(specs))
+            expected = len(prefix) + payload + tsize
+            if size != expected:
+                return "corrupt", (
+                    f"container size mismatch (want {expected} bytes, found {size})"
+                )
+            f.seek(len(prefix) + payload)
+            algo, leaf_crcs, container_crc = parse_trailer(f.read(tsize), path)
+            if algo not in _VERIFIABLE_TAGS:
+                return "unverified", (
+                    f"signed with algorithm tag {algo!r}; this host verifies "
+                    f"{_ALGO_TAG!r} ({CRC_ALGO})"
+                )
+            f.seek(len(prefix))
+            for i, spec in enumerate(specs):
+                remaining = int(spec["nbytes"])
+                crc = 0
+                while remaining:
+                    buf = f.read(min(chunk, remaining))
+                    if not buf:
+                        return "corrupt", f"leaf {i}: short read"
+                    crc = crc32c(buf, crc)
+                    remaining -= len(buf)
+                if crc != leaf_crcs[i]:
+                    return "corrupt", f"leaf {i} checksum mismatch"
+            if _container_crc(prefix, leaf_crcs) != container_crc:
+                return "corrupt", "container digest mismatch (header/trailer)"
+            return "ok", f"{len(specs)} leaves, {payload} payload bytes ({CRC_ALGO})"
+    except CheckpointError as e:
+        return "corrupt", str(e)
+    except OSError as e:
+        return "corrupt", f"unreadable: {e}"

@@ -15,6 +15,24 @@ Checkpoint identity is ``CkptID = (iteration, owner_rank, session)``
 :class:`~tpu_resiliency.checkpoint.async_core.AsyncCallsQueue` with a finalize step
 that re-checks cross-rank coverage and prunes superseded iterations
 (``base_manager.py:277-304``).
+
+**Recovery ladder.** ``load`` no longer trusts disk: every shard read is
+checksum-verified (container format v2, ``checkpoint/format.py``), and a rank
+whose copy fails climbs a ladder instead of raising —
+
+1. **quarantine** the damaged file (rename to ``*.corrupt-<ts>``, one
+   ``ckpt_quarantined`` event → ``tpu_ckpt_integrity_failures_total{stage}``),
+   so retries and coverage math never re-trust it and forensics keep the bytes;
+2. **peer retrieve**: the existing collective exchange routes the shard from a
+   clique mirror, verify-on-receive (a corrupt mirror is treated like PR 4's
+   degraded peer — dropped, not loaded);
+3. **fall back** to the next older iteration whose shards pass, agreed across
+   the group with a :class:`StoreComm` round (``all_reduce_min``) so every rank
+   loads the SAME iteration instead of diverging.
+
+Ladder depth is bounded by the ``keep`` retention knob (how many covered
+iterations survive pruning; default 1 preserves the reference's
+newest-only policy — set ``keep>=2`` to give the ladder a rung to fall to).
 """
 
 from __future__ import annotations
@@ -43,6 +61,11 @@ import pickle
 log = get_logger(__name__)
 
 _FILE_RE = re.compile(r"^iter_(\d{7})_(\d+)_local\.ckpt$")
+#: Quarantined container: ``<container-name>.corrupt-<hex-ts>`` (the suffix
+#: orders same-id quarantines; cleanup keeps the newest per container name).
+_CORRUPT_RE = re.compile(
+    r"^(iter_\d{7}_\d+_local\.ckpt)\.corrupt(?:-[0-9a-f]+)?$"
+)
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -111,6 +134,7 @@ class LocalCheckpointManager:
         caller: str = "thread",
         pipelined: Optional[bool] = None,
         staging: Optional[HostStagingPool] = None,
+        keep: int = 1,
     ):
         self.root = root
         self.rank = rank
@@ -118,6 +142,11 @@ class LocalCheckpointManager:
         self.comm = comm
         self.replication = replication
         self._caller_kind = caller
+        #: Covered iterations retained after a successful save. 1 = the
+        #: reference's newest-only recovery buffer; >=2 additionally keeps
+        #: older rungs for the recovery ladder to fall back to when the newest
+        #: iteration's shards fail their checksums on every holder.
+        self.keep = max(1, int(keep))
         #: Pipelined snapshot engine (default: on for the thread caller): the
         #: caller-visible window of an async save is enqueue + skeleton pickle;
         #: D2H resolution, the replication fan-out, and the shard write all
@@ -139,12 +168,65 @@ class LocalCheckpointManager:
     # -- local inventory ---------------------------------------------------
 
     def _cleanup_dirty(self) -> None:
+        """Sweep crash/corruption residue at startup: every ``.dirty`` temp
+        file goes; of the ``.corrupt`` quarantine files, the NEWEST per
+        container name is kept for forensics (the operator gets one exemplar
+        of what storage did to each shard) and older duplicates go."""
+        newest_corrupt: dict[str, tuple[float, str]] = {}
+        doomed: list[str] = []
         for name in os.listdir(self._dir):
             if name.endswith(ckpt_format.DIRTY_SUFFIX):
-                try:
-                    os.unlink(os.path.join(self._dir, name))
-                except OSError:
-                    pass
+                doomed.append(name)
+                continue
+            m = _CORRUPT_RE.match(name)
+            if not m:
+                continue
+            try:
+                mtime = os.path.getmtime(os.path.join(self._dir, name))
+            except OSError:
+                continue
+            base = m.group(1)
+            prev = newest_corrupt.get(base)
+            if prev is None or (mtime, name) > prev:
+                if prev is not None:
+                    doomed.append(prev[1])
+                newest_corrupt[base] = (mtime, name)
+            else:
+                doomed.append(name)
+        for name in doomed:
+            try:
+                os.unlink(os.path.join(self._dir, name))
+            except OSError:
+                pass
+
+    def _quarantine(
+        self, path: str, stage: str, iteration: int, owner: int, error=None
+    ) -> Optional[str]:
+        """Move a checksum-failed/unreadable container out of the inventory
+        (``*.corrupt-<ts>``): retries and coverage math must never re-trust
+        it, and the bytes stay on disk for forensics. Returns the quarantine
+        path (None when the rename itself failed — file already gone)."""
+        suffix = f"{ckpt_format.CORRUPT_SUFFIX}-{int(time.time() * 1000):x}"
+        qpath = path + suffix
+        n = 0
+        while os.path.exists(qpath):  # same-ms double quarantine
+            n += 1
+            qpath = f"{path}{suffix}{n:x}"
+        try:
+            os.replace(path, qpath)
+        except OSError:
+            qpath = None
+        log.error(
+            f"rank {self.rank}: quarantined corrupt checkpoint {path} "
+            f"(stage={stage}, error={error!r}) -> {qpath}"
+        )
+        record_event(
+            "checkpoint", "ckpt_quarantined",
+            path=os.path.basename(path), stage=stage, iteration=iteration,
+            owner=owner, rank=self.rank,
+            **({"error": repr(error)} if error is not None else {}),
+        )
+        return qpath
 
     def local_ids(self) -> set[CkptID]:
         """Checkpoint IDs held in this rank's directory (own shard + mirrors)."""
@@ -198,7 +280,13 @@ class LocalCheckpointManager:
                 hollow_bytes, snapshot.specs,
                 meta={"iteration": iteration, **(meta or {})},
             )
-            total = len(prefix) + snapshot.nbytes
+            # Total container size includes the integrity trailer — its size
+            # is fixed by the leaf count, so the stream can declare it before
+            # any D2H byte lands (the CRCs themselves resolve leaf by leaf).
+            total = (
+                len(prefix) + snapshot.nbytes
+                + ckpt_format.trailer_size(len(snapshot))
+            )
             # Round tag minted HERE, in save-call order, so concurrent
             # background rounds stay aligned across ranks.
             stream = (
@@ -238,22 +326,34 @@ class LocalCheckpointManager:
     ) -> None:
         """Background half of a pipelined save: one pass over the leaves in
         D2H order, each resolved leaf going to the local shard file and every
-        clique peer before the next is touched."""
+        clique peer before the next is touched. The same pass feeds the
+        :class:`~tpu_resiliency.checkpoint.format.Checksummer`, so the
+        integrity trailer costs zero extra reads and both the local file and
+        every peer receive a complete, verifiable v2 container."""
         t0 = time.perf_counter()
-        total = len(prefix) + snapshot.nbytes
+        total = (
+            len(prefix) + snapshot.nbytes
+            + ckpt_format.trailer_size(len(snapshot))
+        )
         try:
             if stream is not None:
                 stream.open()
 
             def chunks():
+                ck = ckpt_format.Checksummer(prefix)
                 if stream is not None:
                     stream.send_chunk(prefix)
                 yield prefix
                 for i in range(len(snapshot)):
                     view = snapshot.resolve_view(i)
+                    ck.add_leaf(view)
                     if stream is not None:
                         stream.send_chunk(view)
                     yield view
+                trailer = ck.trailer()
+                if stream is not None:
+                    stream.send_chunk(trailer)
+                yield trailer
 
             ckpt_format.write_stream(own_path, chunks())
             received = stream.finish() if stream is not None else {}
@@ -362,10 +462,14 @@ class LocalCheckpointManager:
             held=sorted(i.owner for i in self.local_ids() if i.iteration == iteration),
             **({"bytes": total_bytes} if total_bytes is not None else {}),
         )
-        # Keep only the newest fully-covered iteration (the reference's retention
-        # policy: local ckpts are a recovery buffer, not an archive).
+        # Keep the newest ``keep`` iterations (the reference's retention policy
+        # is keep=1 — local ckpts are a recovery buffer, not an archive;
+        # keep>=2 funds the recovery ladder's fallback rung).
+        retained = sorted(
+            {i.iteration for i in self.local_ids()}, reverse=True
+        )[: self.keep]
         for ckpt_id in self.local_ids():
-            if ckpt_id.iteration < iteration:
+            if ckpt_id.iteration < iteration and ckpt_id.iteration not in retained:
                 try:
                     os.unlink(self._path(ckpt_id))
                 except OSError:
@@ -446,7 +550,10 @@ class LocalCheckpointManager:
     # -- load --------------------------------------------------------------
 
     def load(self, iteration: Optional[int] = None) -> tuple[Any, list, dict]:
-        """Load this rank's shard for ``iteration`` (default: ``find_latest()``).
+        """Load this rank's shard for ``iteration`` (default: ``find_latest()``),
+        climbing the recovery ladder on integrity failure (module docstring):
+        quarantine → peer retrieve (verify-on-receive) → group-agreed fallback
+        to the next older iteration whose shards pass.
 
         Returns ``(hollow_tree, host_tensors, meta)`` — caller re-inserts and restores
         device placement (shardings belong to the *new* mesh after a restart). Routes
@@ -461,33 +568,118 @@ class LocalCheckpointManager:
             iteration = self.find_latest()
         if iteration < 0:
             raise CheckpointError("no fully-covered local checkpoint found")
-        my_id = CkptID(iteration, self.rank, self.session)
-        path = self._path(my_id)
-        get_path = lambda o: self._path(CkptID(iteration, o, self.session))  # noqa: E731
-        if os.path.exists(path):
-            if self.comm is not None and self.replication is not None:
-                # Participate in the collective retrieve even when locally satisfied.
-                self.replication.retrieve(
-                    None, self._held_owners(iteration),
-                    lambda o: self._read_blob(iteration, o), get_path=get_path,
+        requested = iteration
+        while True:
+            result, ok = self._load_attempt(iteration)
+            if self.comm is None:
+                agreed_ok = ok
+            else:
+                # The ladder is collective: every rank reports its verdict and
+                # either all return iteration's tree or all fall back together.
+                agreed_ok = all(
+                    self.comm.all_gather(ok, tag="ckpt-ladder")
                 )
-            return self._read_local_shard(iteration, self.rank)
-        else:
-            if self.replication is None:
+            if agreed_ok:
+                return result
+            fallback = self._agree_fallback(iteration)
+            if fallback is None:
+                detail = (
+                    "" if self.replication is not None or self.comm is None
+                    else " (replication is disabled)"
+                )
                 raise CheckpointError(
-                    f"rank {self.rank} holds no shard for iteration {iteration} "
-                    f"and replication is disabled"
+                    f"rank {self.rank}: no intact checkpoint at or below "
+                    f"iteration {requested}{detail} — newest attempt "
+                    f"{iteration} failed integrity on some rank and no older "
+                    f"covered iteration remains"
                 )
+            record_event(
+                "checkpoint", "ckpt_fallback", rank=self.rank,
+                from_iteration=iteration, to_iteration=fallback,
+            )
+            log.warning(
+                f"rank {self.rank}: checkpoint ladder falling back from "
+                f"iteration {iteration} to {fallback}"
+            )
+            iteration = fallback
+
+    def _load_attempt(self, iteration: int) -> tuple[Optional[tuple], bool]:
+        """One collective rung of the ladder: verify the local shard (or
+        quarantine it), run the group retrieve, verify whatever arrived.
+        Returns ``(result, ok)``; never raises for integrity failures — the
+        caller's agreement round owns the fallback decision."""
+        path = self._path(CkptID(iteration, self.rank, self.session))
+        get_path = lambda o: self._path(CkptID(iteration, o, self.session))  # noqa: E731
+        result = None
+        needed: Optional[int] = None
+        if os.path.exists(path):
+            try:
+                result = self._read_local_shard(iteration, self.rank)
+            except CheckpointError as e:
+                self._quarantine(
+                    path, stage="local-read", iteration=iteration,
+                    owner=self.rank, error=e,
+                )
+                needed = self.rank
+        else:
+            needed = self.rank
+        if self.comm is None or self.replication is None:
+            # No group/no replication: the local verdict is final for this
+            # rung (a distributed-but-unreplicated group still runs the
+            # agreement round in _load, so ranks fall back in lockstep).
+            return result, result is not None
+        try:
             blob = self.replication.retrieve(
-                self.rank, self._held_owners(iteration),
+                needed, self._held_owners(iteration),
                 lambda o: self._read_blob(iteration, o), get_path=get_path,
             )
-            if blob is None:
-                raise CheckpointError(
-                    f"retrieval produced no shard for rank {self.rank} @ iter {iteration}"
-                )
-            hollow_b, tensors, meta = ckpt_format.deserialize_from_bytes(blob)
-        return pickle.loads(hollow_b), tensors, meta
+        except CheckpointError as e:
+            # "No live holder" (raised on every rank, deterministically) or a
+            # transfer failure: locally-satisfied ranks keep their result; a
+            # needy rank reports failure into the agreement round.
+            log.warning(
+                f"rank {self.rank}: retrieve for iteration {iteration} "
+                f"failed: {e}"
+            )
+            blob = None
+        if needed is None:
+            return result, result is not None
+        if blob is None:
+            return None, False
+        # Verified on receive by the replication layer; deserialize without a
+        # second checksum pass. Re-persist the recovered shard so the next
+        # restart is served locally and the clique regains redundancy.
+        try:
+            hollow_b, tensors, meta = ckpt_format.deserialize_from_buffer(
+                blob, verify=False, source=f"retrieve(iter={iteration})"
+            )
+            result = (self._loads_hollow(hollow_b, path), tensors, meta)
+        except CheckpointError as e:
+            record_event(
+                "checkpoint", "ckpt_integrity_failure", stage="peer-retrieve",
+                iteration=iteration, owner=self.rank, rank=self.rank,
+                error=repr(e),
+            )
+            return None, False
+        try:
+            ckpt_format.write_blob(path, blob)
+        except OSError as e:
+            log.warning(f"could not re-persist recovered shard {path}: {e!r}")
+        return result, True
+
+    def _agree_fallback(self, failed_iteration: int) -> Optional[int]:
+        """The fallback rung every rank agrees on: the newest covered iteration
+        older than the failed one, converged with an explicit ``StoreComm``
+        agreement round so no rank can diverge on a stale coverage view."""
+        if self.comm is None:
+            covered = self._covered_iterations()
+            older = [it for it in covered if it < failed_iteration]
+            return max(older) if older else None
+        covered = self._covered_iterations()
+        older = [it for it in covered if it < failed_iteration]
+        candidate = max(older) if older else -1
+        agreed = self.comm.all_reduce_min(candidate, tag="ckpt-fallback")
+        return agreed if agreed >= 0 else None
 
     def load_tree(
         self,
@@ -524,22 +716,47 @@ class LocalCheckpointManager:
         return self._read_local_shard(iteration, owner)
 
     def _read_local_shard(self, iteration: int, owner: int) -> tuple[Any, list, dict]:
-        """Shared local-disk read tail for :meth:`load` / :meth:`load_shard`."""
+        """Shared local-disk read tail for :meth:`load` / :meth:`load_shard`.
+
+        Every failure mode of a damaged container — checksum mismatch,
+        truncation, unreadable file, corrupt hollow pickle — surfaces as
+        :class:`CheckpointError` naming the path, so the recovery ladder and
+        callers classify disk damage uniformly."""
         path = self._path(CkptID(iteration, owner, self.session))
         if not os.path.exists(path):
             raise CheckpointError(
                 f"rank {self.rank} holds no shard for owner {owner} @ iteration "
                 f"{iteration} (held: {sorted(self._held_owners(iteration))})"
             )
-        hollow_b, tensors, meta = ckpt_format.read_payload(path)
-        return pickle.loads(hollow_b), tensors, meta
+        try:
+            hollow_b, tensors, meta = ckpt_format.read_payload(path)
+        except CheckpointError:
+            raise
+        except OSError as e:
+            raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
+        return self._loads_hollow(hollow_b, path), tensors, meta
+
+    @staticmethod
+    def _loads_hollow(hollow_b: bytes, source: str) -> Any:
+        """Unpickle a hollow skeleton; damage surfaces as CheckpointError
+        naming the source (pickle raises half a dozen exception types)."""
+        try:
+            return pickle.loads(hollow_b)
+        except Exception as e:
+            raise CheckpointError(
+                f"{source}: corrupt hollow skeleton ({e!r})"
+            ) from e
 
     def _held_owners(self, iteration: int) -> set[int]:
         return {i.owner for i in self.local_ids() if i.iteration == iteration}
 
     def _read_blob(self, iteration: int, owner: int) -> bytes:
-        with open(self._path(CkptID(iteration, owner, self.session)), "rb") as f:
-            return f.read()
+        path = self._path(CkptID(iteration, owner, self.session))
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError as e:
+            raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
 
     # -- lifecycle ---------------------------------------------------------
 

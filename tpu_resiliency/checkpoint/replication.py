@@ -20,6 +20,7 @@ import dataclasses
 import time
 from typing import Any, Callable, Optional, Sequence
 
+from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
 from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.utils.events import record as record_event
@@ -27,6 +28,28 @@ from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
+
+
+def _verify_received(payload, src: int, stage: str) -> bool:
+    """Verify-on-receive: checksum a peer-delivered container against its v2
+    trailer. Returns True to keep the payload; False (after one
+    ``ckpt_integrity_failure`` event → ``tpu_ckpt_integrity_failures_total``)
+    to treat the frame like a degraded peer's — dropped, never loaded.
+    Payloads that aren't v2 containers (v1 format, raw blobs) pass through
+    unverified; the format layer records those separately."""
+    try:
+        ckpt_format.verify_container(payload, source=f"{stage}<-rank{src}")
+        return True
+    except CheckpointError as e:
+        log.warning(
+            f"replication: dropping corrupt frame from rank {src} "
+            f"({stage}): {e}"
+        )
+        record_event(
+            "checkpoint", "ckpt_integrity_failure", stage=stage, src=src,
+            error=repr(e),
+        )
+        return False
 
 
 def _fan_out(sends: list[Callable[[], Any]]) -> None:
@@ -401,10 +424,16 @@ class CliqueReplicationStrategy:
                 }
                 for peer in peers:
                     try:
-                        received[peer] = self.exchange.recv(
+                        got = self.exchange.recv(
                             peer, tag,
                             timeout=max(0.05, deadline - time.monotonic()),
                         )
+                        # Verify-on-receive: a checksum-failed mirror is a
+                        # degraded peer, not a stored-then-trusted liability.
+                        if _verify_received(got, peer, stage="replicate-recv"):
+                            received[peer] = got
+                        else:
+                            degraded.add(peer)
                     except CheckpointError:
                         degraded.add(peer)
                 for peer, f in futs.items():
@@ -497,7 +526,15 @@ class CliqueReplicationStrategy:
         _fan_out(sends)
         blob = None
         for src, owner in plan.recvs.get(self.comm.rank, []):
-            blob = self.exchange.recv(src, f"{tag}/{owner}")
+            got = self.exchange.recv(src, f"{tag}/{owner}")
+            # Verify-on-receive (per-leaf CRCs + container digest): a bad
+            # frame is treated like a degraded peer — the sender is
+            # deprioritized for future exchange plans and the caller's
+            # recovery ladder falls back instead of loading corruption.
+            if _verify_received(got, src, stage="retrieve-recv"):
+                blob = got
+            else:
+                self.last_degraded.add(src)
         return blob
 
 
@@ -567,18 +604,27 @@ class ReplicationStream:
             raise
 
     def finish(self) -> dict[int, Any]:
-        """Complete sends, collect every peer's mirror; returns {owner: payload}."""
+        """Complete sends, collect every peer's mirror (verify-on-receive: a
+        checksum-failed mirror is dropped and its peer degraded, exactly like
+        ``replicate_parts``); returns {owner: payload}."""
         if not self.active:
             return {}
         received: dict[int, Any] = {}
+        dropped: set[int] = set()
         try:
             for s in self._streams:
                 s.close()
             for peer in self.peers:
-                received[peer] = self._strategy.exchange.recv(peer, self.tag)
+                got = self._strategy.exchange.recv(peer, self.tag)
+                if _verify_received(got, peer, stage="stream-recv"):
+                    received[peer] = got
+                else:
+                    dropped.add(peer)
         except BaseException as e:
             self._teardown(e)
             raise
+        if dropped:
+            self._strategy._mark_degraded(dropped, self._round)
         self._teardown(None)
         return received
 

@@ -250,6 +250,20 @@ class PyTreeStateDict:
         n_ph = sum(isinstance(leaf, TensorPlaceholder) for leaf in leaves)
         if n_ph != len(tensors):
             raise CheckpointError(f"expected {n_ph} tensors, got {len(tensors)}")
+        # A hollow skeleton that deserialized but carries out-of-range indices
+        # (a corrupt-but-unpicklable-looking v1 container, a hand-built tree)
+        # must fail as a classified checkpoint error, not an IndexError.
+        bad = [
+            leaf.index
+            for leaf in leaves
+            if isinstance(leaf, TensorPlaceholder)
+            and not 0 <= leaf.index < len(tensors)
+        ]
+        if bad:
+            raise CheckpointError(
+                f"hollow skeleton placeholder index(es) {sorted(bad)} out of "
+                f"range for {len(tensors)} tensors (corrupt skeleton?)"
+            )
         full = [
             tensors[leaf.index] if isinstance(leaf, TensorPlaceholder) else leaf
             for leaf in leaves

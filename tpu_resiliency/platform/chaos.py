@@ -1,4 +1,4 @@
-"""Deterministic network fault injection for the out-of-band channels.
+"""Deterministic network + disk fault injection for the out-of-band planes.
 
 ``inprocess/tools/inject_fault.py`` covers process- and device-level faults
 (SIGKILL, GIL lockup, device hang); this module covers the faults a real
@@ -12,7 +12,20 @@ channels (``platform/framing.py`` callers):
 - ``p2p``    — :class:`~tpu_resiliency.checkpoint.comm.PeerExchange`
   replication links (dial, send/recv, accepts),
 - ``ipc``    — the UDS channel (``platform/ipc.py``: ``connect``, receiver
-  accepts/reads).
+  accepts/reads),
+
+plus a fourth, **disk**, channel covering the faults node-local *storage*
+produces against checkpoint containers (``checkpoint/format.py``'s patchable
+IO shim): silent bit flips, post-commit tail truncation, torn renames
+(rename journaled, data blocks lost), ``ENOSPC``, and slow IO. Disk rules use
+``op`` = ``write`` (every container write call: header prefix, each leaf,
+trailer, striped pwrites) or ``commit`` (the ``.dirty``→visible rename), and
+their ``peer=`` names the target file as its final
+``<holder-dir>/<filename>`` path pair (e.g.
+``peer=r0/iter_0000002_0_local.ckpt``) so one rank's copy of one shard can be
+corrupted while its clique mirrors stay intact. Disk call indices (``at=``)
+count per *file*, not per process — each container is written sequentially by
+one thread, so disk schedules reproduce even under racy cross-rank timing.
 
 Faults are *planned*, not sprayed: a :class:`ChaosPlan` is parsed from
 ``$TPU_RESILIENCY_CHAOS`` (``"<seed>:<rule>[;<rule>...]"``) or installed
@@ -28,19 +41,24 @@ per operation regardless of thread interleaving.
 Rule grammar (see ``docs/chaos.md`` for the channel × fault coverage matrix)::
 
     rule    := <channel>.<op>.<kind>[@param[,param...]]
-    channel := store | p2p | ipc | *
-    op      := connect | accept | send | recv | *
+    channel := store | p2p | ipc | disk | *
+    op      := connect | accept | send | recv | write | commit | *
     kind    := reset | truncate | eof | delay | stall | partition
+             | bitflip | torn-rename | enospc | slow-io
     param   := at=N[+N...] | p=FLOAT | n=N | peer=NAME | delay=S | jitter=S
 
 Examples::
 
     TPU_RESILIENCY_CHAOS="42:store.send.reset@at=3;p2p.send.truncate@at=1+5"
     TPU_RESILIENCY_CHAOS="7:p2p.connect.partition@peer=2,n=4;ipc.recv.delay@p=0.2,delay=0.05"
+    TPU_RESILIENCY_CHAOS="9:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+    TPU_RESILIENCY_CHAOS="3:disk.commit.torn-rename@at=1;disk.write.enospc@p=0.01"
 
 ``n=`` bounds total injections of a rule (defaults: one per ``at=`` index;
-unbounded for ``p=`` rules). Chaos is for tests of THIS framework only; with
-the variable unset every hook is a no-op returning the socket unchanged.
+unbounded for ``p=`` rules; ``partition`` and the disk-only kinds default to
+``p=1.0`` so a peer-scoped rule fires without an explicit schedule). Chaos is
+for tests of THIS framework only; with the variable unset every hook is a
+no-op returning the socket (or write buffer) unchanged.
 """
 
 from __future__ import annotations
@@ -61,9 +79,20 @@ log = get_logger(__name__)
 
 CHAOS_ENV = "TPU_RESILIENCY_CHAOS"
 
-CHANNELS = ("store", "p2p", "ipc")
-OPS = ("connect", "accept", "send", "recv")
-KINDS = ("reset", "truncate", "eof", "delay", "stall", "partition")
+CHANNELS = ("store", "p2p", "ipc", "disk")
+OPS = ("connect", "accept", "send", "recv", "write", "commit")
+KINDS = (
+    "reset", "truncate", "eof", "delay", "stall", "partition",
+    "bitflip", "torn-rename", "enospc", "slow-io",
+)
+
+#: Kinds a rule may apply at each disk op; hooks skip rules outside these sets
+#: (a wildcard ``*.*.reset`` must never "reset" a file write).
+DISK_WRITE_KINDS = ("bitflip", "enospc", "slow-io", "delay")
+DISK_COMMIT_KINDS = ("truncate", "torn-rename", "slow-io", "delay")
+#: Kinds that default to ``p=1.0`` when a rule gives neither ``at=`` nor
+#: ``p=`` — they are scoped by ``peer=``/``n=`` instead of a schedule.
+_ALWAYS_ON_KINDS = ("partition", "bitflip", "torn-rename", "enospc", "slow-io")
 
 
 @dataclasses.dataclass
@@ -122,8 +151,8 @@ def _parse_rule(text: str) -> Rule:
         else:
             raise ValueError(f"chaos rule {text!r}: unknown param {key!r}")
     if rule.at is None and rule.p is None:
-        if rule.kind == "partition":
-            rule.p = 1.0  # a partition holds until its n= budget runs out
+        if rule.kind in _ALWAYS_ON_KINDS:
+            rule.p = 1.0  # holds until the n= budget runs out / peer scope ends
         else:
             raise ValueError(f"chaos rule {text!r}: needs at= or p=")
     if rule.n is None and rule.at is not None:
@@ -167,17 +196,36 @@ class ChaosPlan:
         return cls(int(seed_s), rules, spec=spec)
 
     def check(
-        self, channel: str, op: str, peer: Optional[str] = None
+        self, channel: str, op: str, peer: Optional[str] = None,
+        kinds: Optional[Sequence[str]] = None,
     ) -> Optional[Rule]:
         """Advance the ``(channel, op)`` counter; return the rule to apply to
         this operation, or None. At most one rule fires per op (first match in
-        spec order wins)."""
+        spec order wins). ``kinds`` restricts which fault kinds this hook can
+        apply (non-matching rules are skipped, their budget untouched)."""
+        return self.check_injection(channel, op, peer, kinds)[0]
+
+    def check_injection(
+        self, channel: str, op: str, peer: Optional[str] = None,
+        kinds: Optional[Sequence[str]] = None,
+    ) -> tuple[Optional[Rule], Optional[Injection]]:
+        """Like :meth:`check` but also returns the :class:`Injection` record —
+        hooks that derive deterministic fault parameters (a bit-flip offset)
+        key them off the injection's ``(peer, index)`` identity.
+
+        Counter scope: network channels count per ``(channel, op)`` process-
+        wide; the ``disk`` channel counts per ``(channel, op, peer)`` — i.e.
+        per target file — because each container is written sequentially by
+        one thread, which makes per-file ``at=`` schedules deterministic where
+        a process-global write counter would race across ranks."""
         with self._lock:
-            key = (channel, op)
+            key = (channel, op, peer) if channel == "disk" else (channel, op)
             idx = self._counters.get(key, 0)
             self._counters[key] = idx + 1
             for rule in self.rules:
                 if rule.n == 0 or not rule.matches(channel, op, peer):
+                    continue
+                if kinds is not None and rule.kind not in kinds:
                     continue
                 hit = False
                 if rule.at is not None:
@@ -191,8 +239,8 @@ class ChaosPlan:
                 inj = Injection(channel, op, rule.kind, idx, peer)
                 self.injected.append(inj)
                 self._record(inj)
-                return rule
-        return None
+                return rule, inj
+        return None, None
 
     @staticmethod
     def _record(inj: Injection) -> None:
@@ -301,6 +349,100 @@ def check_accept(channel: str, peer: Optional[str] = None) -> bool:
         time.sleep(rule.delay + rule.jitter * random.random())
         return False
     return True  # reset/eof/truncate/partition on accept: drop the conn
+
+
+# -- disk channel hooks (consumed by checkpoint/format.py's IO shim) ---------
+
+
+def disk_peer(path: str) -> str:
+    """Stable rule-targetable name for a container path: the final
+    ``<holder-dir>/<filename>`` pair, with any ``.dirty`` suffix stripped —
+    ``/ssd/ckpt/s0/r1/iter_0000002_0_local.ckpt.dirty`` →
+    ``r1/iter_0000002_0_local.ckpt``. The holder dir is part of the name so a
+    rule can corrupt one rank's copy of a shard without touching its clique
+    mirrors (same filename, different holder dir)."""
+    if path.endswith(".dirty"):
+        path = path[: -len(".dirty")]
+    parts = path.replace(os.sep, "/").rstrip("/").split("/")
+    return "/".join(parts[-2:])
+
+
+def _deterministic_rng(plan: ChaosPlan, inj: Injection) -> random.Random:
+    """Fault parameters (bit offsets, truncation points) come from an RNG
+    seeded by ``(seed, file, injection index)`` — NOT the plan's shared RNG,
+    whose draw order is racy across threads. Same seed → same corruption."""
+    return random.Random((plan.seed, inj.peer, inj.index))
+
+
+def on_disk_write(path: str, data):
+    """Chaos hook for one container write call (header prefix, a leaf, the
+    trailer, or one striped pwrite range). Returns the buffer to actually put
+    on disk — a copy with one deterministically chosen bit flipped under
+    ``bitflip`` — sleeps under ``slow-io``/``delay``, raises
+    ``OSError(ENOSPC)`` under ``enospc``. Identity when no plan is active."""
+    plan = active_plan()
+    if plan is None:
+        return data
+    rule, inj = plan.check_injection(
+        "disk", "write", peer=disk_peer(path), kinds=DISK_WRITE_KINDS
+    )
+    if rule is None:
+        return data
+    if rule.kind == "enospc":
+        raise OSError(errno.ENOSPC, f"chaos: injected enospc writing {path}")
+    if rule.kind in ("slow-io", "delay"):
+        time.sleep(rule.delay + rule.jitter * random.random())
+        return data
+    # bitflip: corrupt a copy, never the caller's buffer (it may be a live
+    # staging-pool view feeding peer sockets that should stay intact).
+    view = memoryview(data)
+    if view.ndim != 1 or view.itemsize != 1:
+        view = view.cast("B")
+    out = bytearray(view)
+    if out:
+        rng = _deterministic_rng(plan, inj)
+        pos = rng.randrange(len(out))
+        out[pos] ^= 1 << rng.randrange(8)
+    return out
+
+
+def on_disk_commit(tmp: str, path: str):
+    """Chaos hook before the ``.dirty``→visible rename. ``torn-rename``
+    truncates the temp file before the rename lands (the rename was journaled
+    but the data blocks never hit the platter — the visible file is torn);
+    ``truncate`` returns a post-rename action that cuts the *visible* file's
+    tail (post-commit corruption); ``slow-io``/``delay`` sleep. Returns a
+    callable to run after ``os.replace``, or None."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    rule, inj = plan.check_injection(
+        "disk", "commit", peer=disk_peer(path), kinds=DISK_COMMIT_KINDS
+    )
+    if rule is None:
+        return None
+    if rule.kind in ("slow-io", "delay"):
+        time.sleep(rule.delay + rule.jitter * random.random())
+        return None
+    rng = _deterministic_rng(plan, inj)
+    if rule.kind == "torn-rename":
+        _truncate_tail(tmp, rng)
+        return None
+    return lambda: _truncate_tail(path, rng)  # post-commit truncate
+
+
+def _truncate_tail(path: str, rng: random.Random) -> None:
+    """Cut a deterministic 1..half-of-file tail off ``path`` (at least one
+    byte, so the loss is always detectable)."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size <= 1:
+        return
+    keep = rng.randrange(max(1, size // 2), size)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
 
 
 def wrap(sock: socket.socket, channel: str, peer: Optional[str] = None):

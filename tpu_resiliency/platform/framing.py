@@ -258,6 +258,13 @@ def recv_any(
     (a registered ``recv_into`` destination); returning ``None`` — or a too-small
     buffer — falls back to a fresh allocation. Either way the payload is received
     by ``recv_into`` directly into its final buffer: one allocation, zero copies.
+
+    A bulk header carrying ``crc32c`` (senders opt in —
+    ``PeerExchange(wire_checksums=True)``) is verified against the landed
+    payload; a mismatch raises ``ValueError`` like any malformed frame, so the
+    receive loop drops it and the sender-side retry/degrade machinery owns
+    recovery. Verification is skipped (not failed) when the header's
+    ``crc_algo`` is not the one this host computes.
     """
     head = recv_exact(sock, LEN.size)
     if bytes(head) == BULK_MAGIC:
@@ -277,6 +284,19 @@ def recv_any(
             view = memoryview(bytearray(nbytes))
         payload = view[:nbytes]
         recv_exact_into(sock, payload)
+        if "crc32c" in header:
+            # Layering note: the checksum implementation lives with the
+            # container format (one algo tag for disk and wire); import
+            # lazily, only for frames that actually carry a CRC.
+            from tpu_resiliency.checkpoint.format import CRC_ALGO, crc32c
+
+            if header.get("crc_algo", CRC_ALGO) == CRC_ALGO and crc32c(
+                payload
+            ) != int(header["crc32c"]):
+                raise ValueError(
+                    f"bulk frame payload checksum mismatch "
+                    f"({nbytes} bytes from src={header.get('src')!r})"
+                )
         return "bulk", header, payload
     (length,) = LEN.unpack(head)
     if length > max_frame:

@@ -8,10 +8,16 @@ audited offline from the filesystem alone, without the job's comm group. This
 is the post-mortem twin of the in-job coverage check: "which iteration could a
 restarted world actually resume from, and what is replication costing me?"
 
+``--verify`` additionally stream-verifies every container's checksums
+(format v2 per-leaf CRCs + trailer digest, ``checkpoint/format.py``), prints a
+per-file verdict, and exits 1 on any mismatch — an operator preflight before
+trusting a root for restart, and a CI gate after fault-injection runs.
+
 Usage::
 
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --session 1
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --verify
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ import re
 import sys
 from typing import Optional
 
-from tpu_resiliency.checkpoint.local_manager import _FILE_RE
+from tpu_resiliency.checkpoint.local_manager import _CORRUPT_RE, _FILE_RE
 from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
 
 _SESSION_RE = re.compile(r"^s(\d+)$")
@@ -41,6 +47,11 @@ class SessionInfo:
     bytes_by_iter: dict
     #: leftover .dirty temp files (crashed mid-save)
     dirty: list
+    #: quarantined *.corrupt files (checksum-failed containers kept for
+    #: forensics by the recovery ladder)
+    quarantined: list = dataclasses.field(default_factory=list)
+    #: container files eligible for --verify: [(path, holder, iter, owner)]
+    files: list = dataclasses.field(default_factory=list)
 
     @property
     def owners(self) -> set:
@@ -101,16 +112,21 @@ def scan(root: str, session: Optional[int] = None) -> list[SessionInfo]:
                 if fname.endswith(".dirty"):
                     info.dirty.append(os.path.join(rdir, fname))
                     continue
+                if _CORRUPT_RE.match(fname):
+                    info.quarantined.append(os.path.join(rdir, fname))
+                    continue
                 fm = _FILE_RE.match(fname)
                 if not fm:
                     continue
+                fpath = os.path.join(rdir, fname)
                 try:
-                    size = os.path.getsize(os.path.join(rdir, fname))
+                    size = os.path.getsize(fpath)
                 except OSError:
                     continue  # pruned mid-scan
                 it, owner = int(fm.group(1)), int(fm.group(2))
                 info.holdings.setdefault(it, {}).setdefault(owner, set()).add(holder)
                 info.bytes_by_iter[it] = info.bytes_by_iter.get(it, 0) + size
+                info.files.append((fpath, holder, it, owner))
         sessions.append(info)
     return sorted(sessions, key=lambda s: s.session)
 
@@ -160,6 +176,29 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
             )
     for path in info.dirty:
         print(f"  WARNING torn save temp: {path}", file=out)
+    for path in info.quarantined:
+        print(f"  WARNING quarantined corrupt container: {path}", file=out)
+
+
+def verify(sessions: list[SessionInfo], out=None) -> int:
+    """Stream-verify every container in ``sessions`` (bounded memory, one
+    line per file); returns the number of corrupt files."""
+    from tpu_resiliency.checkpoint import format as ckpt_format
+
+    out = sys.stdout if out is None else out
+    counts = {"ok": 0, "unverified": 0, "corrupt": 0}
+    for info in sessions:
+        print(f"session {info.session}: verifying {len(info.files)} container(s)", file=out)
+        for path, holder, it, owner in sorted(info.files):
+            status, detail = ckpt_format.verify_file(path)
+            counts[status] += 1
+            print(f"  [{status.upper():10s}] {path}: {detail}", file=out)
+    print(
+        f"verified: {counts['ok']} ok, {counts['unverified']} unverified, "
+        f"{counts['corrupt']} corrupt",
+        file=out,
+    )
+    return counts["corrupt"]
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -185,6 +224,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="audit coverage for this comma-separated rank set (default: every "
         "rank/owner the filesystem shows — the original full world)",
     )
+    ap.add_argument(
+        "--verify",
+        action="store_true",
+        help="stream-verify every container's checksums (per-leaf CRCs + "
+        "trailer digest); print per-file verdicts; exit 1 on any mismatch",
+    )
     args = ap.parse_args(argv)
     world = args.world
     if not os.path.isdir(args.root):
@@ -194,6 +239,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not sessions:
         print("no sessions found", file=sys.stderr)
         return 1
+    if args.verify:
+        corrupt = [0]
+
+        def emit_verify():
+            corrupt[0] = verify(sessions)
+
+        if pipe_safe(emit_verify):
+            return SIGPIPE_EXIT
+        return 1 if corrupt[0] else 0
+
     def emit():
         for info in sessions:
             render(info, world=world)

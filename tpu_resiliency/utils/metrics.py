@@ -378,6 +378,37 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
         reg.counter(
             "tpu_ckpt_save_failures_total", "coverage-failed checkpoint saves"
         ).inc()
+    elif kind == "ckpt_quarantined":
+        # A quarantine IS an integrity failure (stage says where it was
+        # caught); the dedicated counter additionally tracks file volume.
+        reg.counter(
+            "tpu_ckpt_integrity_failures_total",
+            "checkpoint integrity failures by ladder stage "
+            "(local-read quarantine, peer-retrieve, replicate/stream receive)",
+            stage=str(rec.get("stage", "?")),
+        ).inc()
+        reg.counter(
+            "tpu_ckpt_quarantined_total",
+            "checkpoint containers quarantined to *.corrupt for forensics",
+        ).inc()
+    elif kind == "ckpt_integrity_failure":
+        reg.counter(
+            "tpu_ckpt_integrity_failures_total",
+            "checkpoint integrity failures by ladder stage "
+            "(local-read quarantine, peer-retrieve, replicate/stream receive)",
+            stage=str(rec.get("stage", "?")),
+        ).inc()
+    elif kind == "ckpt_unverified":
+        reg.counter(
+            "tpu_ckpt_unverified_total",
+            "containers loaded/received without checksum verification "
+            "(v1 format or foreign checksum algorithm)",
+        ).inc()
+    elif kind == "ckpt_fallback":
+        reg.counter(
+            "tpu_ckpt_fallback_total",
+            "recovery-ladder fallbacks to an older checkpoint iteration",
+        ).inc()
     elif kind == "ckpt_foreground_blocked":
         if isinstance(rec.get("duration_s"), (int, float)):
             reg.histogram(
