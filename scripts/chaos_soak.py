@@ -1,6 +1,6 @@
 """Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Four scenarios, each asserting the job converges to a CORRECT final state
+Five scenarios, each asserting the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -23,6 +23,13 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   0, succeeds round 1) with FT monitors on, under env-propagated chaos hitting
   the store AND ipc channels. Convergence = exit 0 + the events file shows at
   least one reset and one truncation injected per channel.
+- **mixed**: the multi-fault campaign — an injected straggler driving the
+  policy → remediation loop, a store reset, and a disk bitflip landing during
+  an active save — with the incident plane watching. Convergence = recovery
+  byte-identical, every incident artifact carries the detect→decide→act→
+  recover chain and renders through ``incident_report``, and the
+  ``tpu_incident_*`` / ``tpu_remediation_actions_total`` metrics aggregate
+  from the events stream.
 
 Every in-process scenario runs TWICE with the same seed and asserts the two
 injection schedules are identical — the reproducibility contract: a failure
@@ -303,6 +310,186 @@ def scenario_disk(seed: int, fallback: bool = False, spec: str | None = None):
     return plan.schedule()
 
 
+# -- scenario: mixed multi-fault campaign ------------------------------------
+
+#: Straggler + network + disk in ONE campaign: resets on the store and p2p
+#: channels while the ranks coordinate, a bitflip landing on rank 0's newest
+#: shard DURING the active save, and an injected straggler report stream
+#: driving the policy → remediation loop — the scenario-diversity flagship
+#: (ROADMAP item 5). Network faults ride connect/send, the retried-and-MUST-
+#: converge side (REPL_SPEC's comment explains why recv-side loss is a
+#: degrade path, excluded from convergence scenarios).
+MIXED_SPEC = (
+    "{seed}:store.connect.reset@at=2;p2p.send.reset@at=2;"
+    "disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+)
+
+
+def _synthetic_report(perf: dict):
+    from tpu_resiliency.telemetry.reporting import Report
+
+    return Report(
+        rank=0, world_size=len(perf), iteration=0, section_names=("step",),
+        relative_section_scores={"step": 1.0},
+        individual_section_scores={"step": 1.0},
+        perf_scores=dict(perf), z_scores={r: 0.0 for r in perf},
+        ewma_scores=dict(perf),
+    )
+
+
+def scenario_mixed(seed: int, workdir: str, spec: str | None = None):
+    """Multi-fault campaign with the incident plane watching. Asserts the
+    full detect→decide→act→recover chain lands in an incident artifact that
+    ``incident_report`` accepts, that recovery still converges byte-identical
+    under the combined faults, and that the ``tpu_incident_*`` /
+    ``tpu_remediation_actions_total`` metrics are visible through the same
+    aggregation ``metrics_dump`` runs. Returns the injection schedule."""
+    import shutil
+    import numpy as np
+
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.launcher.incident import IncidentEngine, read_incident
+    from tpu_resiliency.telemetry.policy import HealthVectorPolicy
+    from tpu_resiliency.telemetry.remediation import RemediationEngine
+    from tpu_resiliency.tools import incident_report
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils import flight_recorder
+    from tpu_resiliency.utils.metrics import aggregate
+
+    world = 2
+    os.makedirs(workdir, exist_ok=True)
+    events_file = os.path.join(workdir, "events.jsonl")
+    incidents_dir = os.path.join(workdir, "incidents")
+    ckpt_root = os.path.join(workdir, "ckpt")
+    for stale in (events_file,):
+        if os.path.exists(stale):
+            os.unlink(stale)
+    shutil.rmtree(incidents_dir, ignore_errors=True)
+    shutil.rmtree(ckpt_root, ignore_errors=True)
+
+    plan = chaos.ChaosPlan.parse(spec or MIXED_SPEC.format(seed=seed))
+    chaos.install_plan(plan)
+    seen: list = []
+    jsonl = tpu_events.JsonlSink(events_file)
+    tpu_events.add_sink(seen.append)
+    tpu_events.add_sink(jsonl)
+    flight_recorder.install(incidents_dir, capacity=64, install_handlers=False)
+    engine = IncidentEngine(
+        incidents_dir, node_id="mixed", auto_open=True, events_file=events_file
+    )
+    engine.attach()
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores: list = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=30.0)
+        stores.append(s)
+        return s
+
+    def tree(rank: int, it: int):
+        return {"w": np.full((2048,), rank * 10.0 + it, np.float32), "step": it}
+
+    def body(rank: int, gen: int, do_save: bool):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=60.0,
+                         generation=gen)
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world
+            )
+            mgr = LocalCheckpointManager(
+                ckpt_root, rank=rank, comm=comm, replication=strat, keep=2
+            )
+            if do_save:
+                mgr.save(1, PyTreeStateDict(tree(rank, 1)), is_async=False)
+                mgr.save(2, PyTreeStateDict(tree(rank, 2)), is_async=False)
+            it_loaded, tensors = None, None
+            if not do_save:
+                hollow, tensors, meta = mgr.load()
+                it_loaded = meta["iteration"]
+                tensors = np.asarray(tensors[0]).copy()
+            mgr.close()
+            return it_loaded, tensors
+        finally:
+            ex.close()
+
+    try:
+        # Phase 1: the straggler leg — synthetic slow-rank reports drive the
+        # policy into remediation (proactive checkpoint + exclude), then clean
+        # reports recover it; the incident engine auto-opens and auto-closes.
+        ckpt_calls: list = []
+        remediation = RemediationEngine(
+            checkpoint_fn=lambda: ckpt_calls.append(1),
+            publish_degraded_fn=lambda d: None,
+        )
+        policy = HealthVectorPolicy(patience=2, recovery=1, sinks=[remediation])
+        policy.observe(_synthetic_report({0: 1.0, 1: 0.3}))
+        policy.observe(_synthetic_report({0: 1.0, 1: 0.3}))
+        assert engine.is_open, "straggler incident never opened"
+        policy.observe(_synthetic_report({0: 1.0, 1: 0.99}))
+        assert not engine.is_open, "straggler incident never auto-closed"
+        assert ckpt_calls, "remediation never ran the proactive checkpoint"
+        assert ("exclude", "ok") in remediation.history, remediation.history
+
+        # Phase 2: saves under the store-reset + disk-bitflip plan (the flip
+        # lands mid-save on rank 0's newest shard), then a collective load
+        # climbing the recovery ladder — this is its own incident.
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(body, r, 0, True) for r in range(world)]:
+                f.result(timeout=120)
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            loaded = [
+                f.result(timeout=120)
+                for f in [pool.submit(body, r, 1, False) for r in range(world)]
+            ]
+        for rank, (it, w) in enumerate(loaded):
+            assert it == 2, f"rank {rank} resumed from {it}, wanted 2"
+            expect = np.full((2048,), rank * 10.0 + 2, np.float32)
+            assert np.array_equal(w, expect), (
+                f"rank {rank}: recovered tree not byte-identical under "
+                f"mixed faults"
+            )
+        assert engine.is_open, "quarantine incident never opened"
+        engine.close(outcome="recovered")
+
+        assert len(engine.artifacts) >= 2, engine.artifacts
+        import contextlib
+        import io
+
+        for path in engine.artifacts:
+            doc = read_incident(path)
+            with contextlib.redirect_stdout(io.StringIO()):
+                assert incident_report.main([path]) == 0, path
+        straggler_doc = read_incident(engine.artifacts[0])
+        phases = [m["phase"] for m in straggler_doc["chain"]]
+        for p in ("detect", "decide", "act", "recover"):
+            assert p in phases, (p, phases)
+        assert straggler_doc["slo"]["time_to_detect_s"] is not None
+        assert straggler_doc["slo"]["time_to_recover_s"] is not None
+
+        # The acceptance surface: the same aggregation metrics_dump runs.
+        reg = aggregate(read_events(events_file))
+        prom = reg.to_prometheus()
+        for want in (
+            "tpu_incidents_total", "tpu_incident_time_to_recover_seconds",
+            "tpu_remediation_actions_total", 'kind="bitflip"',
+        ):
+            assert want in prom, f"{want} missing from metrics:\n{prom[:2000]}"
+    finally:
+        chaos.clear_plan()
+        engine.detach()
+        flight_recorder.uninstall()
+        tpu_events.remove_sink(seen.append)
+        tpu_events.remove_sink(jsonl)
+        jsonl.close()
+        for s in stores:
+            s.close()
+        srv.close()
+    return plan.schedule()
+
+
 # -- scenario: launcher restart chain ---------------------------------------
 
 LAUNCHER_SPEC = (
@@ -417,6 +604,14 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert f1 == f2, f"disk-fallback schedule not reproducible:\n{f1}\n{f2}"
     out["disk_injections"] = [list(i) for i in d1]
     out["disk_fallback_injections"] = [list(i) for i in f1]
+    # Mixed multi-fault campaign (straggler + network + disk), twice per seed:
+    # the combined schedule must reproduce exactly like the single-channel ones.
+    mixed_dir = os.path.join(workdir, f"mixed_{seed}")
+    m1 = scenario_mixed(seed, mixed_dir)
+    m2 = scenario_mixed(seed, mixed_dir)
+    assert m1 == m2, f"mixed schedule not reproducible:\n{m1}\n{m2}"
+    out["mixed_injections"] = [list(i) for i in m1]
+    out["mixed_workdir"] = mixed_dir
     if with_launcher:
         counts = scenario_launcher(seed, os.path.join(workdir, f"launcher_{seed}"))
         out["launcher_injections"] = {f"{c}.{k}": n for (c, k), n in counts.items()}
@@ -432,16 +627,29 @@ def main(argv=None) -> int:
     ap.add_argument("--soak-runs", type=int, default=0,
                     help="randomized soak: N random seeds, launcher every 4th")
     ap.add_argument("--out", default=None, help="write a JSON report here")
+    ap.add_argument(
+        "--workdir", default=None,
+        help="run under this directory instead of a self-deleting tempdir "
+        "(keeps the mixed scenario's events/incident artifacts for "
+        "downstream smoke legs)")
     args = ap.parse_args(argv)
 
     results = []
-    with tempfile.TemporaryDirectory(prefix="chaos_soak.") as workdir:
+    import contextlib
+
+    ctx = (
+        contextlib.nullcontext(args.workdir) if args.workdir
+        else tempfile.TemporaryDirectory(prefix="chaos_soak.")
+    )
+    with ctx as workdir:
+        os.makedirs(workdir, exist_ok=True)
         if args.smoke or args.seed is not None:
             seed = 1234 if args.seed is None else args.seed
             res = run_seed(seed, workdir, with_launcher=True)
             results.append(res)
             print(f"seed {seed}: store={len(res['store_injections'])} "
                   f"repl={len(res['replication_injections'])} "
+                  f"mixed={len(res['mixed_injections'])} "
                   f"launcher={res.get('launcher_injections')} "
                   f"({res['elapsed_s']}s)")
         base = int.from_bytes(os.urandom(4), "big")

@@ -91,7 +91,17 @@ else
     echo "integrity OK: --verify caught the flipped bit (exit 1 as designed)"
 fi
 
-echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels)"
-python scripts/chaos_soak.py --smoke
+echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign)"
+python scripts/chaos_soak.py --smoke --workdir "$WORKDIR/chaos"
+
+echo "== smoke: incident plane (artifact renders + tpu_incident_*/tpu_remediation_* metrics)"
+MIXED_DIR="$WORKDIR/chaos/mixed_1234"
+python -m tpu_resiliency.tools.incident_report "$MIXED_DIR/incidents" --list
+python -m tpu_resiliency.tools.incident_report "$MIXED_DIR/incidents" | sed 's/^/    /'
+python -m tpu_resiliency.tools.metrics_dump "$MIXED_DIR/events.jsonl" --format prom | \
+    grep -q "tpu_incidents_total" || { echo "FAIL: tpu_incident_* missing from metrics dump"; exit 1; }
+python -m tpu_resiliency.tools.metrics_dump "$MIXED_DIR/events.jsonl" --format prom | \
+    grep -q "tpu_remediation_actions_total" || { echo "FAIL: tpu_remediation_actions_total missing"; exit 1; }
+python -m tpu_resiliency.tools.events_summary "$MIXED_DIR/events.jsonl" --kind incident_closed --no-timeline > /dev/null
 
 echo "smoke_observability: PASS ($WORKDIR)"

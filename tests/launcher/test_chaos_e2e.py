@@ -47,6 +47,26 @@ def test_launcher_restart_chain_under_chaos(tmp_path):
     assert injected[("ipc", "truncate")] >= 1
 
 
+def test_mixed_scenario_converges_and_reproduces(tmp_path):
+    """The multi-fault campaign (straggler + store/p2p resets + disk bitflip
+    during an active save): the combined injection schedule reproduces from
+    the seed and all three channels actually fired. The scenario asserts the
+    incident/remediation acceptance surface internally (artifact chain, CLI
+    exit 0, metric visibility)."""
+    wd = str(tmp_path / "mixed")
+    s1 = chaos_soak.scenario_mixed(seed=77, workdir=wd)
+    s2 = chaos_soak.scenario_mixed(seed=77, workdir=wd)
+    assert s1 == s2, "same-seed mixed runs diverged in injection schedule"
+    channels = {c for c, _, _, _ in s1}
+    assert channels == {"store", "p2p", "disk"}, channels
+    # The smoke-leg contract: artifacts + events stream persist in workdir.
+    assert os.path.exists(os.path.join(wd, "events.jsonl"))
+    assert any(
+        n.startswith("incident-") and n.endswith(".json")
+        for n in os.listdir(os.path.join(wd, "incidents"))
+    )
+
+
 @pytest.mark.slow
 def test_randomized_soak():
     """Long randomized soak: several random seeds through every scenario (the

@@ -1,0 +1,288 @@
+"""Incident plane acceptance: engine unit behavior, the straggler →
+remediation → recovery loop producing a causally-ordered artifact with
+non-null SLO timings, and the crash-survival e2e — kill -9 of a worker
+mid-step still yields that rank's flight-recorder dump in the incident
+artifact written by the real launcher."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tpu_resiliency.launcher.incident import (
+    IncidentEngine,
+    classify_phase,
+    read_incident,
+)
+from tpu_resiliency.tools import incident_report
+from tpu_resiliency.utils import events, flight_recorder
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    events.clear_sinks()
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in (events.EVENTS_FILE_ENV, events.FLIGHT_DIR_ENV,
+                  events.TRACE_ID_ENV, events.PARENT_SPAN_ENV)
+    }
+    yield
+    flight_recorder.uninstall()
+    events.clear_sinks()
+    for k, v in saved.items():
+        if v is not None:
+            os.environ[k] = v
+
+
+class TestEngineUnit:
+    def test_explicit_open_close_produces_schema_valid_artifact(self, tmp_path):
+        eng = IncidentEngine(str(tmp_path / "inc"), node_id="n0", events_file=None)
+        eng.attach()
+        events.record("launcher", "worker_failed", global_rank=3, exitcode=-9,
+                      detail="rank 3 exit -9")
+        eng.open("worker_failed", detail="rank 3 exit -9", ranks=[3])
+        events.record("launcher", "restart_requested", reason="rank 3 died")
+        events.record("launcher", "rendezvous_round", round=1, world_size=2)
+        path = eng.close(outcome="recovered")
+        eng.detach()
+        doc = read_incident(path)
+        assert doc["trigger"] == "worker_failed" and doc["ranks"] == [3]
+        phases = [m["phase"] for m in doc["chain"]]
+        assert phases == ["detect", "decide", "act"]
+        # Chain is ts-ordered (causal within one clock domain).
+        tss = [m["ts"] for m in doc["chain"]]
+        assert tss == sorted(tss)
+        assert doc["slo"]["time_to_detect_s"] is not None
+        assert doc["slo"]["time_to_recover_s"] is not None
+
+    def test_second_fault_folds_into_open_incident(self, tmp_path):
+        eng = IncidentEngine(str(tmp_path / "inc"), events_file=None)
+        a = eng.open("worker_failed", ranks=[1])
+        b = eng.open("worker_failed", ranks=[2])
+        assert a == b
+        path = eng.close()
+        assert read_incident(path)["ranks"] == [1, 2]
+        assert eng.close() is None  # nothing open anymore
+
+    def test_auto_mode_opens_on_fault_kinds_and_closes_on_recovery(self, tmp_path):
+        eng = IncidentEngine(str(tmp_path / "inc"), auto_open=True, events_file=None)
+        eng.attach()
+        events.record("checkpoint", "ckpt_fallback", from_iteration=5, to_iteration=4)
+        assert eng.is_open
+        events.record("launcher", "round_succeeded", round=1)
+        eng.detach()
+        assert not eng.is_open
+        doc = read_incident(eng.artifacts[0])
+        assert doc["trigger"] == "ckpt_fallback"
+        assert doc["outcome"] == "recovered"
+
+    def test_own_narration_never_retriggers(self, tmp_path):
+        eng = IncidentEngine(str(tmp_path / "inc"), auto_open=True, events_file=None)
+        eng.attach()
+        events.record("launcher", "worker_failed", global_rank=0)
+        assert eng.is_open
+        events.record("launcher", "round_succeeded", round=1)
+        assert not eng.is_open
+        # The incident_opened/closed events the engine itself recorded must
+        # not have opened a second incident.
+        assert len(eng.artifacts) == 1
+        eng.detach()
+
+    def test_window_prefers_shared_events_file_and_filters_trace(self, tmp_path):
+        ev_file = str(tmp_path / "ev.jsonl")
+        now = time.time()
+        with open(ev_file, "w") as f:
+            for rec in [
+                {"ts": now - 0.03, "source": "w", "kind": "worker_failed",
+                 "pid": 1, "trace_id": "ours", "global_rank": 0},
+                {"ts": now - 0.02, "source": "w", "kind": "restart_requested",
+                 "pid": 1, "trace_id": "ours", "reason": "x"},
+                {"ts": now - 0.01, "source": "other", "kind": "worker_failed",
+                 "pid": 9, "trace_id": "theirs", "global_rank": 5},
+            ]:
+                f.write(json.dumps(rec) + "\n")
+        eng = IncidentEngine(str(tmp_path / "inc"), events_file=ev_file)
+        eng.attach()
+        # Two local records make "ours" the dominant trace.
+        os.environ[events.TRACE_ID_ENV] = "ours"
+        events.record("launcher", "worker_failed", global_rank=0)
+        eng.open("worker_failed", ranks=[0])
+        path = eng.close()
+        eng.detach()
+        doc = read_incident(path)
+        assert all(r.get("trace_id") != "theirs" for r in doc["events"])
+        assert any(r["kind"] == "restart_requested" for r in doc["events"])
+
+    def test_steps_lost_from_iteration_markers(self, tmp_path):
+        eng = IncidentEngine(str(tmp_path / "inc"), events_file=None)
+        eng.attach()
+        events.record("inprocess", "iteration_start", iteration=7)
+        events.record("inprocess", "fn_exception", iteration=7, error="boom")
+        eng.open("fn_exception")
+        events.record("inprocess", "iteration_start", iteration=5)  # resumed
+        path = eng.close()
+        eng.detach()
+        assert read_incident(path)["slo"]["steps_lost"] == 2
+
+    def test_classify_phase_table(self):
+        assert classify_phase({"kind": "worker_failed"}) == "detect"
+        assert classify_phase({"kind": "restart_requested"}) == "decide"
+        assert classify_phase({"kind": "kill_ladder"}) == "act"
+        assert classify_phase({"kind": "round_succeeded"}) == "recover"
+        assert classify_phase({"kind": "degraded_set", "newly": [1]}) == "detect"
+        assert classify_phase({"kind": "degraded_set", "recovered": [1]}) == "recover"
+        assert classify_phase({"kind": "straggler_report"}) is None
+        assert classify_phase(
+            {"kind": "straggler_report", "stragglers_by_perf": [2]}
+        ) == "detect"
+        assert classify_phase(
+            {"kind": "remediation_action", "action": "reinstate"}
+        ) == "recover"
+        assert classify_phase({"kind": "ckpt_saved"}) is None
+
+
+class TestStragglerRemediationE2E:
+    """Acceptance: an injected straggler drives policy → remediation
+    (exclude) → recovery; the artifact carries the causally-ordered
+    detect → decide → act → recover chain with non-null time-to-detect /
+    time-to-recover, and the CLI renders it with exit 0."""
+
+    def _report(self, perf):
+        from tpu_resiliency.telemetry.reporting import Report
+
+        return Report(
+            rank=0, world_size=len(perf), iteration=0, section_names=("step",),
+            relative_section_scores={"step": 1.0},
+            individual_section_scores={"step": 1.0},
+            perf_scores=dict(perf), z_scores={r: 0.0 for r in perf},
+            ewma_scores=dict(perf),
+        )
+
+    def test_full_loop(self, tmp_path, capsys, coord_store):
+        from tpu_resiliency.inprocess.coordination import RestartCoordinator
+        from tpu_resiliency.telemetry.policy import HealthVectorPolicy
+        from tpu_resiliency.telemetry.remediation import RemediationEngine
+
+        inc_dir = str(tmp_path / "incidents")
+        flight_recorder.install(inc_dir, capacity=64, install_handlers=False)
+        eng = IncidentEngine(inc_dir, node_id="e2e", auto_open=True,
+                             events_file=None)
+        eng.attach()
+        coord = RestartCoordinator(coord_store, world_size=2)
+        ckpts = []
+        remediation = RemediationEngine(
+            checkpoint_fn=lambda: ckpts.append(1),
+            publish_degraded_fn=coord.set_degraded,
+        )
+        policy = HealthVectorPolicy(patience=2, recovery=1, sinks=[remediation])
+        slow = {0: 1.0, 1: 0.35}
+        policy.observe(self._report(slow))
+        policy.observe(self._report(slow))
+        assert eng.is_open
+        assert coord.degraded_ranks() == {1}  # the exclude actually landed
+        policy.observe(self._report({0: 1.0, 1: 0.99}))
+        eng.detach()
+        assert not eng.is_open and eng.artifacts
+        assert ckpts, "proactive checkpoint never ran"
+        assert coord.degraded_ranks() == frozenset()
+
+        doc = read_incident(eng.artifacts[0])
+        chain = doc["chain"]
+        # The causally-ordered chain: detect before decide before act before
+        # the final recover.
+        first_of = {p: next(i for i, m in enumerate(chain) if m["phase"] == p)
+                    for p in ("detect", "decide", "act", "recover")}
+        assert first_of["detect"] < first_of["decide"] < first_of["act"] \
+            < max(i for i, m in enumerate(chain) if m["phase"] == "recover")
+        tss = [m["ts"] for m in chain]
+        assert tss == sorted(tss)
+        assert doc["slo"]["time_to_detect_s"] is not None
+        assert doc["slo"]["time_to_recover_s"] is not None
+        assert doc["slo"]["time_to_recover_s"] >= 0
+        # The remediation audit rode into the artifact.
+        acted = [m for m in chain if m["kind"] == "remediation_action"]
+        assert any("exclude" in m["summary"] for m in acted)
+        # And the CLI renders it, exit 0.
+        assert incident_report.main([eng.artifacts[0]]) == 0
+        out = capsys.readouterr().out
+        assert "DETECT" in out and "DECIDE" in out
+        assert "ACT" in out and "RECOVER" in out
+
+
+_KILLED_WORKER = textwrap.dedent(
+    """
+    import os, signal, sys, time
+    from tpu_resiliency.utils import events
+
+    round_no = int(os.environ["TPU_FT_RESTART_COUNT"])
+    for step in range(12):
+        events.record("worker", "train_step", step=step, round=round_no)
+    if round_no == 0:
+        os.kill(os.getpid(), signal.SIGKILL)   # mid-step, no warning at all
+    print("recovered in round", round_no)
+    """
+)
+
+
+class TestKill9E2E:
+    """Acceptance: kill -9 of a worker mid-step still yields that rank's
+    flight-recorder dump inside the incident artifact the launcher writes."""
+
+    def test_launcher_writes_artifact_with_flight_dump(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(_KILLED_WORKER)
+        inc_dir = tmp_path / "incidents"
+        events_file = tmp_path / "events.jsonl"
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu"})
+        r = subprocess.run(
+            [sys.executable, "-m", "tpu_resiliency.launcher.launch",
+             "--standalone", "--nproc-per-node", "1", "--max-restarts", "2",
+             "--no-ft-monitors", "--rdzv-last-call", "0.2",
+             "--monitor-interval", "0.1",
+             "--events-file", str(events_file),
+             "--incidents-dir", str(inc_dir),
+             "--run-dir", str(tmp_path / "run"), str(script)],
+            capture_output=True, text=True, timeout=180, env=env,
+            cwd=str(tmp_path),
+        )
+        assert r.returncode == 0, r.stderr[-3000:]
+        assert "recovered in round 1" in r.stdout
+
+        artifacts = [n for n in os.listdir(inc_dir)
+                     if n.startswith("incident-") and n.endswith(".json")]
+        assert artifacts, os.listdir(inc_dir)
+        doc = read_incident(str(inc_dir / sorted(artifacts)[0]))
+        assert doc["trigger"] == "worker_failed"
+        assert doc["outcome"] == "recovered"
+        assert doc["slo"]["time_to_detect_s"] is not None
+        assert doc["slo"]["time_to_recover_s"] is not None
+        phases = {m["phase"] for m in doc["chain"]}
+        assert {"detect", "decide", "act"} <= phases
+
+        # THE crash-survival property: the SIGKILLed rank's ring is in the
+        # artifact — train_step events from round 0, no flush marker (the
+        # process never got to run one).
+        flights = doc["flight"]
+        rank0 = {
+            ident: recs for ident, recs in flights.items()
+            if ident.startswith("0-")
+        }
+        assert rank0, f"no rank-0 flight dump: {sorted(flights)}"
+        killed = [
+            recs for recs in rank0.values()
+            if any(rec.get("kind") == "train_step" and rec.get("round") == 0
+                   for rec in recs)
+        ]
+        assert killed, "killed worker's train_step ring missing"
+        assert all(
+            rec.get("kind") != "flight_flush" for rec in killed[0]
+        ), "a SIGKILLed process cannot have flushed"
+
+        # The CLI renders the artifact (exit 0) and names the flight dump.
+        assert incident_report.main([str(inc_dir)]) == 0

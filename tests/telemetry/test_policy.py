@@ -83,6 +83,75 @@ class TestHealthVectorPolicy:
             HealthVectorPolicy(patience=0)
 
 
+class TestHysteresisEdges:
+    """The edges remediation depends on: streaks across restart rounds,
+    simultaneous transitions, and the one-event-per-transition contract."""
+
+    def test_recovery_streak_does_not_survive_a_restart_round(self):
+        p = HealthVectorPolicy(patience=1, recovery=3)
+        p.observe(make_report(SLOW2))
+        assert p.degraded == {2}
+        p.observe(make_report(HEALTHY))
+        p.observe(make_report(HEALTHY))  # 2 of 3 clean rounds banked
+        p.note_restart()                 # respawned rank has proven nothing
+        p.observe(make_report(HEALTHY))
+        assert p.degraded == {2}, "pre-restart clean streak wrongly carried"
+        p.observe(make_report(HEALTHY))
+        p.observe(make_report(HEALTHY))
+        assert p.degraded == frozenset()  # 3 fresh post-restart rounds clear it
+
+    def test_flag_streak_does_not_survive_a_restart_round(self):
+        p = HealthVectorPolicy(patience=2, recovery=1)
+        p.observe(make_report(SLOW2))    # streak 1 of 2
+        p.note_restart()
+        d = p.observe(make_report(SLOW2))  # fresh streak 1, NOT promotion
+        assert d.degraded == frozenset()
+        d = p.observe(make_report(SLOW2))
+        assert d.newly_degraded == {2}
+
+    def test_degraded_status_persists_across_restart(self):
+        p = HealthVectorPolicy(patience=1, recovery=2)
+        p.observe(make_report(SLOW2))
+        p.note_restart()
+        assert p.degraded == {2}  # hysteresis resets, the verdict does not
+
+    def test_simultaneous_degrade_and_recover_in_one_observation(self):
+        p = HealthVectorPolicy(patience=1, recovery=1)
+        p.observe(make_report(SLOW2))            # rank 2 degraded
+        both = {0: 1.0, 1: 0.4, 2: 1.0, 3: 1.0}  # 1 degrades AS 2 recovers
+        d = p.observe(make_report(both))
+        assert d.newly_degraded == {1}
+        assert d.recovered == {2}
+        assert d.degraded == {1}
+        assert d.changed
+
+    def test_every_transition_emits_its_event(self):
+        from tpu_resiliency.utils import events
+
+        seen = []
+        events.add_sink(seen.append)
+        try:
+            p = HealthVectorPolicy(patience=1, recovery=1)
+            p.observe(make_report(SLOW2))    # transition: degrade
+            p.observe(make_report(SLOW2))    # steady state: no event
+            p.observe(make_report(HEALTHY))  # transition: recover
+            p.observe(make_report(HEALTHY))  # steady state: no event
+        finally:
+            events.remove_sink(seen.append)
+        transitions = [e for e in seen if e.kind == "degraded_set"]
+        assert len(transitions) == 2
+        assert transitions[0].payload["newly"] == [2]
+        assert transitions[0].payload["recovered"] == []
+        assert transitions[1].payload["recovered"] == [2]
+        # The event carries the scores that justified the transition.
+        assert transitions[0].payload["scores"]["2"] == pytest.approx(0.4)
+
+    def test_decision_carries_scores_for_downstream_audit(self):
+        p = HealthVectorPolicy(patience=1, recovery=1)
+        d = p.observe(make_report(SLOW2))
+        assert d.scores[2] == pytest.approx(0.4)
+
+
 class TestDemoteDegraded:
     def _ctx(self, world, terminated=(), degraded=(), rank=0):
         from tpu_resiliency.inprocess.rank_assignment import RankAssignmentCtx

@@ -1,0 +1,200 @@
+"""RemediationEngine: the decision matrix, audit events, cooldown, and the
+failure containment contract (an actuator bug never breaks detection)."""
+
+import pytest
+
+from tpu_resiliency.telemetry.policy import HealthDecision, HealthVectorPolicy
+from tpu_resiliency.telemetry.remediation import (
+    ACTION_CHECKPOINT,
+    ACTION_EXCLUDE,
+    ACTION_REINSTATE,
+    ACTION_SPARE_SWAP,
+    OUTCOME_FAILED,
+    OUTCOME_OK,
+    OUTCOME_SKIPPED,
+    RemediationEngine,
+)
+from tpu_resiliency.telemetry.reporting import Report
+from tpu_resiliency.utils import events
+
+
+def decision(newly=(), degraded=None, recovered=()):
+    newly = frozenset(newly)
+    return HealthDecision(
+        degraded=frozenset(degraded if degraded is not None else newly),
+        newly_degraded=newly,
+        recovered=frozenset(recovered),
+        flagged=newly,
+        scores={0: 1.0, 1: 0.4},
+    )
+
+
+@pytest.fixture
+def seen():
+    captured = []
+    events.add_sink(captured.append)
+    yield captured
+    events.remove_sink(captured.append)
+
+
+class TestDecisionMatrix:
+    def test_checkpoint_always_first_when_wired(self, seen):
+        order = []
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: order.append("ckpt"),
+            publish_degraded_fn=lambda d: order.append("publish"),
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert [a for a, _ in taken] == [ACTION_CHECKPOINT, ACTION_EXCLUDE]
+        assert order == ["ckpt", "publish"]
+        assert all(o == OUTCOME_OK for _, o in taken)
+
+    def test_spare_swap_when_capacity_available(self):
+        restarts = []
+        eng = RemediationEngine(
+            spare_capacity_fn=lambda: 2,
+            publish_degraded_fn=lambda d: None,
+            request_restart_fn=restarts.append,
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert (ACTION_SPARE_SWAP, OUTCOME_OK) in taken
+        assert restarts and "swap degraded ranks [1]" in restarts[0]
+
+    def test_exclude_when_no_spares(self):
+        published = []
+        eng = RemediationEngine(
+            spare_capacity_fn=lambda: 0,
+            publish_degraded_fn=published.append,
+            request_restart_fn=lambda r: pytest.fail("no swap without spares"),
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert taken == [(ACTION_EXCLUDE, OUTCOME_OK)]
+        assert published == [frozenset({1})]
+
+    def test_exclude_self_sends_control_request(self):
+        class FakeClient:
+            def __init__(self):
+                self.sent = []
+
+            def send_workload_control_request(self, action, reason=""):
+                self.sent.append((action, reason))
+
+        client = FakeClient()
+        eng = RemediationEngine(monitor_client=client, self_rank=1,
+                                publish_degraded_fn=lambda d: None)
+        eng.remediate(decision(newly={1}))
+        from tpu_resiliency.watchdog.data import WorkloadAction
+
+        assert client.sent and client.sent[0][0] is WorkloadAction.ExcludeThisNode
+        # Another rank degrading must NOT make this node exclude itself.
+        client.sent.clear()
+        eng.remediate(decision(newly={0}, degraded={0, 1}))
+        assert client.sent == []
+
+    def test_reinstate_on_pure_recovery(self, seen):
+        published = []
+        eng = RemediationEngine(publish_degraded_fn=published.append)
+        taken = eng.remediate(decision(newly=(), degraded=(), recovered={1}))
+        assert taken == [(ACTION_REINSTATE, OUTCOME_OK)]
+        assert published == [frozenset()]
+        acts = [e for e in seen if e.kind == "remediation_action"]
+        assert acts[0].payload["action"] == ACTION_REINSTATE
+
+    def test_no_change_no_action(self):
+        eng = RemediationEngine(publish_degraded_fn=lambda d: None)
+        assert eng.remediate(decision(newly=())) == []
+
+
+class TestAuditTrail:
+    def test_every_action_emits_event_and_spans(self, seen):
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: None, publish_degraded_fn=lambda d: None
+        )
+        eng.remediate(decision(newly={1}))
+        kinds = [e.kind for e in seen]
+        assert "remediation_decision" in kinds
+        actions = [e.payload["action"] for e in seen if e.kind == "remediation_action"]
+        assert actions == [ACTION_CHECKPOINT, ACTION_EXCLUDE]
+        # Each action ran inside its own remediation.<action> span.
+        spans = [e.payload.get("span") for e in seen if e.kind == "span_begin"]
+        assert "remediation.decide" in spans
+        assert f"remediation.{ACTION_CHECKPOINT}" in spans
+        assert f"remediation.{ACTION_EXCLUDE}" in spans
+
+    def test_actuator_failure_is_audited_not_raised(self, seen):
+        def boom():
+            raise RuntimeError("ckpt disk full")
+
+        eng = RemediationEngine(
+            checkpoint_fn=boom, publish_degraded_fn=lambda d: None
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert (ACTION_CHECKPOINT, OUTCOME_FAILED) in taken
+        # The matrix keeps going: exclude still ran.
+        assert (ACTION_EXCLUDE, OUTCOME_OK) in taken
+        failed = next(
+            e for e in seen
+            if e.kind == "remediation_action" and e.payload["outcome"] == OUTCOME_FAILED
+        )
+        assert "ckpt disk full" in failed.payload["detail"]
+
+    def test_sink_entry_swallows_everything(self):
+        eng = RemediationEngine()
+        # No actuators wired at all: exclude raises internally; the sink
+        # entry point must still return (the detection loop survives).
+        eng(decision(newly={1}))
+        assert (ACTION_EXCLUDE, OUTCOME_FAILED) in eng.history
+
+
+class TestCooldownAndDryRun:
+    def test_cooldown_audits_skip(self, seen):
+        eng = RemediationEngine(
+            publish_degraded_fn=lambda d: None, cooldown=3600.0
+        )
+        first = eng.remediate(decision(newly={1}))
+        assert first == [(ACTION_EXCLUDE, OUTCOME_OK)]
+        second = eng.remediate(decision(newly={0}, degraded={0, 1}))
+        assert second == [(ACTION_EXCLUDE, OUTCOME_SKIPPED)]
+        skipped = [
+            e for e in seen
+            if e.kind == "remediation_action"
+            and e.payload["outcome"] == OUTCOME_SKIPPED
+        ]
+        assert skipped and skipped[0].payload["detail"] == "cooldown"
+
+    def test_dry_run_never_actuates(self):
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: pytest.fail("dry run must not checkpoint"),
+            publish_degraded_fn=lambda d: pytest.fail("dry run must not publish"),
+            dry_run=True,
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert all(o == OUTCOME_SKIPPED for _, o in taken)
+
+
+class TestPolicyIntegration:
+    def _report(self, perf):
+        return Report(
+            rank=0, world_size=len(perf), iteration=0, section_names=("step",),
+            relative_section_scores={"step": 1.0},
+            individual_section_scores={"step": 1.0},
+            perf_scores=dict(perf), z_scores={r: 0.0 for r in perf},
+            ewma_scores=dict(perf),
+        )
+
+    def test_policy_drives_engine_end_to_end(self, seen):
+        history_at_demote = []
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: None,
+            publish_degraded_fn=lambda d: history_at_demote.append(set(d)),
+        )
+        pol = HealthVectorPolicy(patience=2, recovery=1, sinks=[eng])
+        slow = {0: 1.0, 1: 0.3}
+        pol.observe(self._report(slow))
+        assert eng.history == []  # patience not yet met
+        pol.observe(self._report(slow))
+        assert (ACTION_CHECKPOINT, OUTCOME_OK) in eng.history
+        assert history_at_demote[0] == {1}
+        pol.observe(self._report({0: 1.0, 1: 0.99}))
+        assert (ACTION_REINSTATE, OUTCOME_OK) in eng.history
+        assert history_at_demote[-1] == set()

@@ -86,6 +86,99 @@ def test_kind_filter_and_no_timeline(tmp_path):
     assert "worker failures: 1" in out2.getvalue()
 
 
+def _write_incident_stream(path):
+    """Two 'runs' on one stream: trace A faults at t+10..t+20, trace B is a
+    different job sharing the file."""
+    import json
+    import time
+
+    t0 = time.time()
+    rows = [
+        (0.0, "launcher", "rendezvous_round", "A", {"round": 0, "world_size": 1}),
+        (10.0, "launcher", "worker_failed", "A",
+         {"global_rank": 0, "exitcode": -9, "detail": "rank 0 exit -9"}),
+        (12.0, "launcher", "restart_requested", "A", {"reason": "rank 0 died"}),
+        (15.0, "launcher", "rendezvous_round", "B", {"round": 0, "world_size": 1}),
+        (20.0, "launcher", "round_succeeded", "A", {"round": 1}),
+        (30.0, "ft", "training_finished", "A", {"step": 5}),
+    ]
+    with open(path, "w") as f:
+        for dt, source, kind, trace, payload in rows:
+            f.write(json.dumps(
+                {"ts": t0 + dt, "source": source, "kind": kind, "pid": 1,
+                 "trace_id": trace, **payload}
+            ) + "\n")
+    return t0
+
+
+class TestSliceFilters:
+    """--since/--until/--trace: slice the stream to one incident without grep."""
+
+    def test_relative_window_slices_timeline_and_footer(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        _write_incident_stream(path)
+        out = io.StringIO()
+        records = events_summary.read_events(path)
+        t0 = min(r["ts"] for r in records)
+        keep = events_summary.make_filter("+9", "+21", None, t0)
+        events_summary.summarize(records, out=out, keep=keep)
+        text = out.getvalue()
+        assert "worker_failed" in text and "restart_requested" in text
+        assert "round_succeeded" in text
+        assert "training_finished" not in text  # t+30 is outside
+        assert "4 events" in text  # footer counts the slice, not the stream
+        # t+ offsets stay anchored to the FULL stream's first event.
+        assert "t+   10.000s" in text
+
+    def test_absolute_epoch_bounds(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        t0 = _write_incident_stream(path)
+        records = events_summary.read_events(path)
+        keep = events_summary.make_filter(str(t0 + 9), str(t0 + 13), None, t0)
+        out = io.StringIO()
+        events_summary.summarize(records, out=out, keep=keep)
+        text = out.getvalue()
+        assert "worker_failed" in text and "restart_requested" in text
+        assert "round_succeeded" not in text
+
+    def test_trace_filter_drops_the_other_run(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        _write_incident_stream(path)
+        records = events_summary.read_events(path)
+        keep = events_summary.make_filter(None, None, "A", 0.0)
+        out = io.StringIO()
+        events_summary.summarize(records, out=out, keep=keep)
+        text = out.getvalue()
+        assert "5 events" in text  # B's rendezvous_round gone
+        assert text.count("rendezvous_round:") == 1
+
+    def test_iso_spec_parses(self):
+        import datetime
+
+        ts, rel = events_summary.parse_when("2026-08-04T12:00:00")
+        assert not rel
+        assert ts == datetime.datetime(2026, 8, 4, 12, 0).timestamp()
+        assert events_summary.parse_when("+5.5") == (5.5, True)
+        assert events_summary.parse_when("1700000000.25") == (1700000000.25, False)
+
+    def test_cli_flags_end_to_end(self, tmp_path, capsys):
+        path = str(tmp_path / "ev.jsonl")
+        _write_incident_stream(path)
+        assert events_summary.main([path, "--since", "+9", "--until", "+21",
+                                    "--trace", "A"]) == 0
+        out = capsys.readouterr().out
+        assert "worker_failed" in out and "training_finished" not in out
+        # A typo'd bound fails the invocation, not silently shows everything.
+        assert events_summary.main([path, "--since", "yesterdayish"]) == 2
+        assert "cannot parse time" in capsys.readouterr().err
+
+    def test_empty_slice_says_so(self, tmp_path, capsys):
+        path = str(tmp_path / "ev.jsonl")
+        _write_incident_stream(path)
+        assert events_summary.main([path, "--since", "+1000"]) == 0
+        assert "no events in the selected slice" in capsys.readouterr().out
+
+
 def test_cli_main(tmp_path, capsys):
     path = str(tmp_path / "ev.jsonl")
     _write_events(path, [(0.0, "ft", "training_finished", {"step": 30})])

@@ -1,0 +1,185 @@
+"""Flight recorder: continuous segment persistence, rotation, fault flush,
+collect() stitching, env wiring, and the crash-survival property (kill -9)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tpu_resiliency.utils import events, flight_recorder
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    events.clear_sinks()
+    saved = os.environ.pop(flight_recorder.FLIGHT_DIR_ENV, None)
+    yield
+    flight_recorder.uninstall()
+    events.clear_sinks()
+    if saved is not None:
+        os.environ[flight_recorder.FLIGHT_DIR_ENV] = saved
+
+
+def test_every_event_lands_on_disk_immediately(tmp_path):
+    d = str(tmp_path / "fl")
+    flight_recorder.install(d, capacity=100, install_handlers=False)
+    events.record("test", "step_one", n=1)
+    events.record("test", "step_two", n=2)
+    # No flush, no close: the hot segment already holds both lines.
+    dumps = flight_recorder.collect(d)
+    assert len(dumps) == 1
+    records = next(iter(dumps.values()))
+    assert [r["kind"] for r in records] == ["step_one", "step_two"]
+    assert records[1]["n"] == 2
+
+
+def test_rotation_bounds_disk_and_keeps_recent_window(tmp_path):
+    d = str(tmp_path / "fl")
+    rec = flight_recorder.install(d, capacity=10, install_handlers=False)
+    for i in range(35):
+        events.record("test", "tick", i=i)
+    names = sorted(os.listdir(d))
+    # Exactly one hot + one prev segment — rotation replaces, never accumulates.
+    assert len([n for n in names if n.endswith(".hot.jsonl")]) == 1
+    assert len([n for n in names if n.endswith(".prev.jsonl")]) == 1
+    records = next(iter(flight_recorder.collect(d).values()))
+    # The newest events survive; the oldest rotated away.
+    assert records[-1]["i"] == 34
+    assert 10 <= len(records) <= 20
+    assert rec is flight_recorder.get_recorder()
+
+
+def test_flush_writes_consolidated_dump_with_reason(tmp_path):
+    d = str(tmp_path / "fl")
+    rec = flight_recorder.install(d, capacity=50, install_handlers=False)
+    events.record("test", "before_death", x=1)
+    path = rec.flush("signal:SIGTERM", detail="testing")
+    assert path and os.path.exists(path)
+    records = next(iter(flight_recorder.collect(d).values()))
+    kinds = [r["kind"] for r in records]
+    assert "before_death" in kinds
+    marker = next(r for r in records if r["kind"] == "flight_flush")
+    assert marker["reason"] == "signal:SIGTERM"
+    assert marker["detail"] == "testing"
+
+
+def test_events_after_flush_still_collected(tmp_path):
+    d = str(tmp_path / "fl")
+    rec = flight_recorder.install(d, capacity=50, install_handlers=False)
+    events.record("test", "pre_flush")
+    rec.flush("fn_exception")
+    events.record("test", "post_flush")
+    records = next(iter(flight_recorder.collect(d).values()))
+    kinds = [r["kind"] for r in records]
+    assert "pre_flush" in kinds and "post_flush" in kinds
+    # The marker sits between them in ts order.
+    assert kinds.index("pre_flush") < kinds.index("flight_flush")
+
+
+def test_env_wiring_installs_lazily(tmp_path):
+    d = str(tmp_path / "fl_env")
+    os.environ[flight_recorder.FLIGHT_DIR_ENV] = d
+    events.record("test", "wired_by_env")
+    assert flight_recorder.get_recorder() is not None
+    records = next(iter(flight_recorder.collect(d).values()))
+    assert any(r["kind"] == "wired_by_env" for r in records)
+    del os.environ[flight_recorder.FLIGHT_DIR_ENV]
+
+
+def test_collect_ignores_garbage_and_missing_dir(tmp_path):
+    assert flight_recorder.collect(str(tmp_path / "nope")) == {}
+    d = tmp_path / "fl"
+    d.mkdir()
+    (d / "flight-3-99.jsonl").write_text('{"ts": 1.0, "kind": "ok"}\n{torn')
+    records = flight_recorder.collect(str(d))["3-99"]
+    assert [r["kind"] for r in records] == ["ok"]
+
+
+_KILLED = textwrap.dedent(
+    """
+    import os, sys, time
+    from tpu_resiliency.utils import events
+    for i in range(20):
+        events.record("worker", "train_step", step=i)
+    with open(sys.argv[1], "w") as f:
+        f.write("ready")
+    time.sleep(60)   # parked: the parent kill -9s us here
+    """
+)
+
+
+def test_sigkill_still_leaves_a_dump(tmp_path):
+    """The crash-survival property: kill -9 is uncatchable, so the dump must
+    already be on disk when it lands."""
+    d = str(tmp_path / "fl")
+    script = tmp_path / "victim.py"
+    script.write_text(_KILLED)
+    ready = str(tmp_path / "ready")
+    env = dict(os.environ)
+    env.update({
+        flight_recorder.FLIGHT_DIR_ENV: d,
+        "RANK": "7",
+        "JAX_PLATFORMS": "cpu",
+    })
+    proc = subprocess.Popen([sys.executable, str(script), ready], env=env)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready):
+        assert time.monotonic() < deadline, "victim never became ready"
+        assert proc.poll() is None, "victim died early"
+        time.sleep(0.05)
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=10)
+    dumps = flight_recorder.collect(d)
+    ident = next(iter(dumps))
+    assert ident.startswith("7-")
+    kinds = [r["kind"] for r in dumps[ident]]
+    assert kinds.count("train_step") == 20
+    # No flush marker: the process died without warning — segments only.
+    assert "flight_flush" not in kinds
+
+
+def test_sigterm_handler_flushes_and_still_dies(tmp_path):
+    script = tmp_path / "victim.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        from tpu_resiliency.utils import events
+        events.record("worker", "about_to_hang")
+        with open(sys.argv[1], "w") as f:
+            f.write("ready")
+        time.sleep(60)
+        """
+    ))
+    d = str(tmp_path / "fl")
+    ready = str(tmp_path / "ready")
+    env = dict(os.environ)
+    env.update({flight_recorder.FLIGHT_DIR_ENV: d, "JAX_PLATFORMS": "cpu"})
+    proc = subprocess.Popen([sys.executable, str(script), ready], env=env)
+    deadline = time.monotonic() + 30
+    while not os.path.exists(ready):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    os.kill(proc.pid, signal.SIGTERM)
+    rc = proc.wait(timeout=10)
+    assert rc != 0  # the chained handler re-raised the default disposition
+    records = next(iter(flight_recorder.collect(d).values()))
+    marker = [r for r in records if r["kind"] == "flight_flush"]
+    assert marker and marker[0]["reason"] == "signal:SIGTERM"
+
+
+def test_reinstall_replaces_and_uninstall_detaches(tmp_path):
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    flight_recorder.install(d1, install_handlers=False)
+    flight_recorder.install(d2, install_handlers=False)
+    events.record("test", "after_reinstall")
+    assert not flight_recorder.collect(d1)
+    assert flight_recorder.collect(d2)
+    flight_recorder.uninstall()
+    events.record("test", "after_uninstall")
+    records = next(iter(flight_recorder.collect(d2).values()))
+    assert all(r["kind"] != "after_uninstall" for r in records)

@@ -67,6 +67,56 @@ def test_unmatched_begin_renders_unfinished_to_stream_end(tmp_path):
     assert x["dur"] == pytest.approx(2e6)  # microseconds to end of stream
 
 
+def test_multiple_unfinished_spans_across_pids_all_survive():
+    """A multi-rank crash (every worker dies mid-span) must render EVERY open
+    span — none silently dropped — each extended to the trace's last
+    timestamp and visually flagged (distinct cname + unfinished arg)."""
+    recs = [
+        {"ts": 10.0, "source": "w", "kind": "span_begin", "pid": 5,
+         "span_id": "aa", "span": "step", "rank": 0},
+        {"ts": 11.0, "source": "w", "kind": "span_begin", "pid": 6,
+         "span_id": "bb", "span": "step", "rank": 1},
+        {"ts": 12.0, "source": "w", "kind": "span_begin", "pid": 6,
+         "span_id": "cc", "span": "barrier", "rank": 1},  # nested, also open
+        {"ts": 14.0, "source": "launcher", "kind": "worker_failed", "pid": 1},
+    ]
+    trace = trace_export.to_chrome_trace(recs)
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert len(slices) == 3  # all three open spans survive
+    assert all(e["args"]["unfinished"] is True for e in slices)
+    assert all(e["cname"] == "terrible" for e in slices)
+    # Each extends exactly to the last event of the whole trace.
+    by_span = {e["args"]["span_id"]: e for e in slices}
+    assert by_span["aa"]["dur"] == pytest.approx(4e6)
+    assert by_span["bb"]["dur"] == pytest.approx(3e6)
+    assert by_span["cc"]["dur"] == pytest.approx(2e6)
+    # Rows stay per-rank: the two pids don't collapse onto one track.
+    assert {e["pid"] for e in slices} == {5, 6}
+
+
+def test_finished_spans_carry_no_crash_color(tmp_path):
+    path = _synthetic_stream(tmp_path)
+    trace = trace_export.to_chrome_trace(events.read_events(path))
+    slices = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert slices and all("cname" not in e for e in slices)
+    assert all("unfinished" not in e["args"] for e in slices)
+
+
+def test_cli_reports_unfinished_count(tmp_path, capsys):
+    import json as _json
+
+    path = tmp_path / "ev.jsonl"
+    recs = [
+        {"ts": 1.0, "source": "w", "kind": "span_begin", "pid": 5,
+         "span_id": "aa", "span": "doomed"},
+        {"ts": 2.0, "source": "w", "kind": "worker_failed", "pid": 5},
+    ]
+    path.write_text("".join(_json.dumps(r) + "\n" for r in recs))
+    out = tmp_path / "t.json"
+    assert trace_export.main([str(path), "-o", str(out)]) == 0
+    assert "1 UNFINISHED" in capsys.readouterr().out
+
+
 def test_orphan_end_degrades_to_instant():
     recs = [
         {"ts": 1.0, "source": "w", "kind": "span_end", "pid": 5,

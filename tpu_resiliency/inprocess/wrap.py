@@ -53,6 +53,7 @@ from tpu_resiliency.inprocess.rank_assignment import (
 )
 from tpu_resiliency.inprocess.state import Mode, State
 from tpu_resiliency.platform.store import host_store, store_addr_from_env
+from tpu_resiliency.utils import flight_recorder
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.tracing import span
@@ -600,6 +601,10 @@ class CallWrapper:
                             "inprocess", "fn_exception", iteration=iteration,
                             initial_rank=state.initial_rank, error=repr(e),
                         )
+                        # The last seconds before this exception are exactly
+                        # what a postmortem wants — snapshot them now, while
+                        # this incarnation still owns its ring.
+                        flight_recorder.flush("fn_exception", detail=repr(e))
                         restart = True
                     else:
                         # SystemExit / KeyboardInterrupt mean the rank is leaving,
@@ -621,6 +626,7 @@ class CallWrapper:
                             "inprocess", "rank_terminated", iteration=iteration,
                             initial_rank=state.initial_rank, error=repr(e),
                         )
+                        flight_recorder.flush("rank_terminated", detail=repr(e))
                         self._terminate_and_leave(monitor, state)
                         raise
 
@@ -642,6 +648,7 @@ class CallWrapper:
                 state = new_state
             except (RestartAbort, HealthCheckError) as e:
                 log.error(f"rank {state.rank}: leaving restart loop: {e!r}")
+                flight_recorder.flush("restart_abort", detail=repr(e))
                 self._terminate_and_leave(monitor, state)
                 raise
             finally:

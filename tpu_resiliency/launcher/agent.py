@@ -73,6 +73,11 @@ class AgentConfig:
     #: serialization (BENCH_restart.json decomposition). 0 disables.
     warm_spares: int = 0
     warm_spare_preload: str = "jax"
+    #: directory for incident artifacts + flight-recorder dumps; empty
+    #: disables the incident plane (``launcher/incident.py``). Exported to
+    #: workers as $TPU_RESILIENCY_FLIGHT_DIR so every rank keeps a
+    #: crash-surviving ring of its last events.
+    incidents_dir: str = ""
 
     def __post_init__(self):
         if not self.node_id:
@@ -119,6 +124,18 @@ class ElasticAgent:
         #: set by restart watchers so spare/completion waits wake on a peer's
         #: restart request instead of sleeping out their poll tick
         self._wake = threading.Event()
+        self.incidents: Optional["IncidentEngine"] = None
+        if cfg.incidents_dir:
+            from tpu_resiliency.launcher.incident import IncidentEngine
+            from tpu_resiliency.utils.events import FLIGHT_DIR_ENV
+
+            # One export wires every child's flight recorder (and this
+            # process's own, through the lazy events env wiring).
+            os.environ[FLIGHT_DIR_ENV] = cfg.incidents_dir
+            self.incidents = IncidentEngine(
+                cfg.incidents_dir, node_id=cfg.node_id
+            )
+            self.incidents.attach()
 
     def _pause(self, timeout: float) -> None:
         if self._wake.wait(timeout):
@@ -207,10 +224,22 @@ class ElasticAgent:
                     return self._last_exitcodes
                 if action == "excluded":
                     log.info(f"[{self.cfg.node_id}] leaving the job (excluded)")
+                    if self.incidents is not None and self.incidents.is_open:
+                        self.incidents.close(outcome="excluded")
                     self.rdzv.leave()
                     return {}
                 # action == "restart": loop into the next rendezvous round
         finally:
+            if self.incidents is not None and self.incidents.is_open:
+                # Leaving run() with an incident still open means the job never
+                # recovered from it (budget exhausted, shutdown, store loss) —
+                # the artifact must say so rather than silently vanish.
+                try:
+                    self.incidents.close(outcome="unrecovered")
+                except Exception:
+                    pass
+            if self.incidents is not None:
+                self.incidents.detach()
             try:
                 self.rdzv.mark_exited()
             except Exception:
@@ -340,6 +369,12 @@ class ElasticAgent:
                         lambda local: {ipc.MONITOR_SOCKET_ENV: sockets[local]}
                     )
                 group.start(outcome.round, first_rank, world_size)
+            if self.incidents is not None and self.incidents.is_open:
+                # The fault's replacement round is up and training again:
+                # that IS the recovery the SLO clock measures (waiting for the
+                # round to *succeed* would count hours of healthy training as
+                # time-to-recover on long jobs).
+                self.incidents.close(outcome="recovered")
             # A peer's restart request wakes the supervise loop through the
             # same event as a local worker death: multi-node respawn is then
             # notification-bound on every surviving node, not poll-bound.
@@ -396,6 +431,10 @@ class ElasticAgent:
                 return "restart"
             req = self._poll_control()
             if req == "excluded":
+                if self.incidents is not None and not self.incidents.is_open:
+                    # Rank-requested exclusion (often the remediation engine's
+                    # doing) is an incident even though no worker died here.
+                    self.incidents.open("exclude_request")
                 group.stop(cfg.term_grace)
                 self.rdzv.request_restart(f"node {cfg.node_id} excluded by rank request")
                 return "excluded"
@@ -436,6 +475,14 @@ class ElasticAgent:
                 "launcher", "worker_failed", round=outcome.round,
                 node_id=cfg.node_id, global_rank=f.global_rank,
                 exitcode=f.exitcode, detail=f.describe(),
+            )
+        if self.incidents is not None:
+            # After the worker_failed records: the engine's pre-buffer scan
+            # anchors time-to-detect on the earliest fault evidence.
+            self.incidents.open(
+                "worker_failed",
+                detail="; ".join(f.describe() for f in failures),
+                ranks=sorted(f.global_rank for f in failures),
             )
         group.stop(cfg.term_grace)
         # Budget accounting lives in run() (epoch deltas); here we only pre-check

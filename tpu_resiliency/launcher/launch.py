@@ -132,6 +132,15 @@ def build_parser() -> argparse.ArgumentParser:
         "$TPU_RESILIENCY_METRICS_FILE); post-hoc aggregation needs only "
         "--events-file + tpu-metrics-dump",
     )
+    p.add_argument(
+        "--incidents-dir",
+        default=None,
+        help="enable the incident plane: incident-<ts>.json postmortem "
+        "artifacts land here, and every process keeps a crash-surviving "
+        "flight-recorder ring in the same directory (exports "
+        "$TPU_RESILIENCY_FLIGHT_DIR); render artifacts with "
+        "tpu-incident-report",
+    )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
     p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
@@ -383,6 +392,9 @@ def main(argv: Optional[list[str]] = None) -> int:
         store_port=store_port,
         warm_spares=args.warm_spares,
         warm_spare_preload=args.warm_spare_preload,
+        incidents_dir=(
+            os.path.abspath(args.incidents_dir) if args.incidents_dir else ""
+        ),
     )
     agent = ElasticAgent(cfg, ft_cfg, store)
     try:

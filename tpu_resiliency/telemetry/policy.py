@@ -41,6 +41,10 @@ class HealthDecision:
     newly_degraded: frozenset[int]  # transitions this round
     recovered: frozenset[int]  # ranks cleared this round
     flagged: frozenset[int]  # raw flags this round (pre-hysteresis)
+    #: the per-rank perf scores behind this decision — carried so downstream
+    #: consumers (``remediation.py`` spans, incident artifacts) can show WHY a
+    #: rank was demoted without re-reading the report
+    scores: Optional[dict[int, float]] = None
 
     @property
     def changed(self) -> bool:
@@ -107,6 +111,7 @@ class HealthVectorPolicy:
             newly_degraded=frozenset(newly),
             recovered=frozenset(recovered),
             flagged=frozenset(flagged),
+            scores={r: float(s) for r, s in (report.perf_scores or {}).items()},
         )
         if decision.changed:
             record_event(
@@ -114,6 +119,10 @@ class HealthVectorPolicy:
                 degraded=sorted(decision.degraded),
                 newly=sorted(decision.newly_degraded),
                 recovered=sorted(decision.recovered),
+                scores={
+                    str(r): round(float(s), 4)
+                    for r, s in (report.perf_scores or {}).items()
+                },
             )
             log.warning(
                 f"health vector: degraded={sorted(decision.degraded)} "
@@ -125,6 +134,23 @@ class HealthVectorPolicy:
                 except Exception:
                     log.exception("health-policy sink failed")
         return decision
+
+    def note_restart(self) -> None:
+        """A restart round happened: in-flight streak evidence is void.
+
+        Ranks were reassigned, respawned, or benched — a pre-restart clean
+        streak must not count toward reinstating a degraded rank (the respawned
+        incarnation has proven nothing yet), and a pre-restart flag streak must
+        not demote a rank on its first post-restart wobble. Degraded *status*
+        persists: hysteresis restarts, the verdict does not."""
+        self._flag_streak.clear()
+        self._clean_streak.clear()
+        if self._degraded:
+            record_event(
+                "telemetry", "degraded_set",
+                degraded=sorted(self._degraded), newly=[], recovered=[],
+                reason="restart: streaks reset, degraded set carried",
+            )
 
 
 # -- stock sinks -----------------------------------------------------------

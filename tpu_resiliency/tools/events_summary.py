@@ -15,6 +15,9 @@ Usage::
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --kind worker_failed
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --no-timeline
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --follow
+    # slice to one incident: absolute epoch, ISO-8601, or stream-relative +SECS
+    python -m tpu_resiliency.tools.events_summary ev.jsonl --since +42 --until +97
+    python -m tpu_resiliency.tools.events_summary ev.jsonl --trace 4f2a91b0c3d4e5f6
 """
 
 from __future__ import annotations
@@ -31,6 +34,59 @@ from tpu_resiliency.utils.events import RESERVED_KEYS, read_events
 
 def _payload(rec: dict) -> dict:
     return {k: v for k, v in rec.items() if k not in RESERVED_KEYS}
+
+
+def parse_when(spec: str) -> tuple[float, bool]:
+    """One ``--since``/``--until`` operand → ``(seconds, relative)``.
+
+    Three spellings, matched to how operators actually hold timestamps:
+    raw epoch seconds (what the JSONL carries), ISO-8601 (what an incident
+    report or pager shows — naive stamps are LOCAL time, matching
+    ``datetime.fromtimestamp`` output), and ``+SECS`` relative to the stream's
+    first event (what the timeline itself prints as ``t+...s``)."""
+    spec = spec.strip()
+    if spec.startswith("+"):
+        return float(spec[1:]), True
+    try:
+        return float(spec), False
+    except ValueError:
+        pass
+    import datetime
+
+    try:
+        dt = datetime.datetime.fromisoformat(spec)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse time {spec!r}: want epoch seconds, ISO-8601, "
+            f"or +SECS relative to stream start"
+        ) from None
+    return dt.timestamp(), False
+
+
+def make_filter(
+    since: Optional[str], until: Optional[str], trace: Optional[str], t0: float
+):
+    """Record predicate for the --since/--until/--trace slicers; ``t0``
+    resolves relative (+SECS) bounds."""
+    lo = hi = None
+    if since is not None:
+        s, rel = parse_when(since)
+        lo = t0 + s if rel else s
+    if until is not None:
+        s, rel = parse_when(until)
+        hi = t0 + s if rel else s
+
+    def keep(rec: dict) -> bool:
+        ts = rec.get("ts")
+        if lo is not None and (not isinstance(ts, (int, float)) or ts < lo):
+            return False
+        if hi is not None and (not isinstance(ts, (int, float)) or ts > hi):
+            return False
+        if trace is not None and rec.get("trace_id") != trace:
+            return False
+        return True
+
+    return keep
 
 
 def _fmt_default(p: dict) -> str:
@@ -107,7 +163,12 @@ def summarize(
     out=None,
     kind: Optional[str] = None,
     timeline: bool = True,
+    keep=None,
 ) -> None:
+    """``keep``: optional record predicate (the --since/--until/--trace slice).
+    Sliced records drive both timeline and footer — that's the point of
+    slicing — but ``t+`` offsets stay anchored to the FULL stream's first
+    event, so a sliced view's timestamps line up with the unsliced one."""
     out = sys.stdout if out is None else out  # resolved at call time, not import
     records = [r for r in records if "ts" in r and "kind" in r]
     if not records:
@@ -115,6 +176,11 @@ def summarize(
         return
     records.sort(key=lambda r: r["ts"])
     t0 = records[0]["ts"]
+    if keep is not None:
+        records = [r for r in records if keep(r)]
+        if not records:
+            print("no events in the selected slice", file=out)
+            return
     shown = [r for r in records if kind is None or r["kind"] == kind]
     if timeline:
         for r in shown:
@@ -242,24 +308,34 @@ class _StdoutGone:
             return True
 
 
-def _follow(path: str, kind: Optional[str]) -> int:
+def _follow(
+    path: str,
+    kind: Optional[str],
+    since: Optional[str] = None,
+    until: Optional[str] = None,
+    trace: Optional[str] = None,
+) -> int:
     # Incremental footer state, not a record list: a multi-day follow on a
     # chatty job must not grow RSS one dict per event.
     counts: Counter = Counter()
     pids: set = set()
     t0: Optional[float] = None
     last_ts = 0.0
+    keep = None  # built once t0 is known (relative bounds need it)
 
     def emit() -> None:
-        nonlocal t0, last_ts
+        nonlocal t0, last_ts, keep
         try:
             for rec in iter_new_records(path, stop=_StdoutGone()):
                 if "ts" not in rec or "kind" not in rec:
                     continue
-                counts[rec["kind"]] += 1
-                pids.add(rec.get("pid"))
                 if t0 is None:
                     t0 = rec["ts"]
+                    keep = make_filter(since, until, trace, t0)
+                if not keep(rec):
+                    continue
+                counts[rec["kind"]] += 1
+                pids.add(rec.get("pid"))
                 last_ts = max(last_ts, rec["ts"])
                 if kind is None or rec["kind"] == kind:
                     print(format_line(rec, t0), flush=True)
@@ -292,6 +368,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("events_file")
     ap.add_argument("--kind", help="show only this event kind in the timeline")
     ap.add_argument(
+        "--since",
+        help="drop records before this time: epoch seconds, ISO-8601, or "
+        "+SECS relative to the stream's first event (matches the timeline's "
+        "t+ offsets) — slice the stream to one incident without grep",
+    )
+    ap.add_argument(
+        "--until",
+        help="drop records after this time (same formats as --since)",
+    )
+    ap.add_argument(
+        "--trace",
+        help="show only records carrying this trace id (one run on a stream "
+        "shared by several)",
+    )
+    ap.add_argument(
         "--no-timeline", action="store_true", help="print only the summary footer"
     )
     ap.add_argument(
@@ -301,8 +392,20 @@ def main(argv: Optional[list[str]] = None) -> int:
         "not exist yet — a launcher that hasn't started still gets watched",
     )
     args = ap.parse_args(argv)
+    try:
+        # Validate the time specs up front — a typo'd --since must fail the
+        # invocation, not silently show the whole stream.
+        for spec in (args.since, args.until):
+            if spec is not None:
+                parse_when(spec)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
     if args.follow:
-        return _follow(args.events_file, args.kind)
+        return _follow(
+            args.events_file, args.kind,
+            since=args.since, until=args.until, trace=args.trace,
+        )
     # read_events tolerates unreadable files (shared-stream readers race the
     # first writer); a CLI invocation on a missing/denied/directory path must
     # fail visibly, not report an empty-but-successful run.
@@ -313,8 +416,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"cannot read events file: {e}", file=sys.stderr)
         return 1
     records = read_events(args.events_file)
+    keep = None
+    if args.since or args.until or args.trace:
+        tss = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
+        keep = make_filter(args.since, args.until, args.trace, min(tss) if tss else 0.0)
     if pipe_safe(
-        lambda: summarize(records, kind=args.kind, timeline=not args.no_timeline)
+        lambda: summarize(
+            records, kind=args.kind, timeline=not args.no_timeline, keep=keep
+        )
     ):
         return SIGPIPE_EXIT
     return 0

@@ -1,0 +1,211 @@
+"""Render an incident artifact as a human-readable postmortem timeline.
+
+The incident engine (``launcher/incident.py``) writes one
+``incident-<ts>.json`` per fault: the causally-ordered event window, the
+detect → decide → act → recover milestone chain, SLO timings, and the involved
+processes' flight-recorder dumps. This tool is the reader — the postmortem an
+operator would otherwise assemble from raw JSONL by hand:
+
+    python -m tpu_resiliency.tools.incident_report incidents/incident-...json
+    python -m tpu_resiliency.tools.incident_report incidents/            # newest
+    python -m tpu_resiliency.tools.incident_report incidents/ --list
+    python -m tpu_resiliency.tools.incident_report ... --events   # full window
+    python -m tpu_resiliency.tools.incident_report ... --flight   # ring dumps
+
+Exit 0 on a rendered artifact, 1 on a missing/invalid one — CI smoke legs
+assert the exit code (``scripts/smoke_observability.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional
+
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
+from tpu_resiliency.launcher.incident import read_incident
+
+_PHASE_TAG = {
+    "detect": "DETECT ",
+    "decide": "DECIDE ",
+    "act": "ACT    ",
+    "recover": "RECOVER",
+}
+
+
+def _fmt_s(v) -> str:
+    return f"{v:.3f}s" if isinstance(v, (int, float)) else "n/a"
+
+
+def resolve_artifact(path: str) -> str:
+    """A file path is used as-is; a directory resolves to its newest
+    ``incident-*.json`` (the artifact an operator usually wants)."""
+    if os.path.isdir(path):
+        candidates = sorted(
+            n for n in os.listdir(path)
+            if n.startswith("incident-") and n.endswith(".json")
+        )
+        if not candidates:
+            raise FileNotFoundError(f"no incident-*.json under {path!r}")
+        return os.path.join(path, candidates[-1])
+    return path
+
+
+def render(doc: dict, out, show_events: bool = False, show_flight: bool = False) -> None:
+    slo = doc.get("slo", {})
+    t0 = doc.get("fault_ts") or doc.get("opened_ts") or 0.0
+    dur = (doc.get("closed_ts") or t0) - t0
+    print(f"incident {doc['id']}  [{doc.get('outcome', '?')}]", file=out)
+    print(
+        f"  trigger: {doc['trigger']}"
+        + (f" — {doc['detail']}" if doc.get("detail") else ""),
+        file=out,
+    )
+    if doc.get("node_id"):
+        print(f"  node: {doc['node_id']}", file=out)
+    if doc.get("ranks"):
+        print(f"  ranks: {doc['ranks']}", file=out)
+    if doc.get("trace_id"):
+        print(f"  trace: {doc['trace_id']}", file=out)
+    print(f"  duration: {dur:.3f}s", file=out)
+    print(
+        "  slo: detect=" + _fmt_s(slo.get("time_to_detect_s"))
+        + " decide=" + _fmt_s(slo.get("time_to_decide_s"))
+        + " act=" + _fmt_s(slo.get("time_to_act_s"))
+        + " recover=" + _fmt_s(slo.get("time_to_recover_s"))
+        + " steps_lost=" + str(slo.get("steps_lost")),
+        file=out,
+    )
+
+    chain = doc.get("chain", [])
+    print(f"\ncausal chain ({len(chain)} milestones):", file=out)
+    for m in chain:
+        ts = m.get("ts")
+        rel = f"t+{ts - t0:8.3f}s" if isinstance(ts, (int, float)) else " " * 11
+        rank = f" r{m['rank']}" if m.get("rank") is not None else ""
+        print(
+            f"  {rel} {_PHASE_TAG.get(m.get('phase'), '?      ')} "
+            f"[{m.get('source', '?')}{rank}] {m.get('kind')}: "
+            f"{m.get('summary', '')}",
+            file=out,
+        )
+    if not chain:
+        print("  (none classified)", file=out)
+
+    flights = doc.get("flight") or {}
+    if flights:
+        print(f"\nflight recorders ({len(flights)} process(es)):", file=out)
+        for ident, records in sorted(flights.items()):
+            reasons = [
+                r.get("reason") for r in records if r.get("kind") == "flight_flush"
+            ]
+            span = ""
+            tss = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
+            if tss:
+                span = f", {max(tss) - min(tss):.1f}s window"
+            print(
+                f"  flight-{ident}: {len(records)} records{span}"
+                + (f", flushes: {reasons}" if reasons else " (segments only — "
+                   "process died without a flush, e.g. SIGKILL)"),
+                file=out,
+            )
+            if show_flight:
+                for r in records:
+                    ts = r.get("ts")
+                    rel = (
+                        f"t+{ts - t0:8.3f}s"
+                        if isinstance(ts, (int, float)) else " " * 11
+                    )
+                    extras = {
+                        k: v for k, v in r.items()
+                        if k not in ("ts", "source", "kind", "pid", "rank",
+                                     "trace_id", "span_id")
+                    }
+                    print(
+                        f"      {rel} [{r.get('source', '?')}] "
+                        f"{r.get('kind')} "
+                        + " ".join(f"{k}={v}" for k, v in extras.items()),
+                        file=out,
+                    )
+
+    if show_events:
+        from tpu_resiliency.tools.events_summary import format_line
+
+        evs = doc.get("events", [])
+        print(f"\nevent window ({len(evs)} records):", file=out)
+        for r in evs:
+            if isinstance(r.get("ts"), (int, float)) and r.get("kind"):
+                print("  " + format_line(r, t0), file=out)
+
+
+def _list(directory: str, out) -> int:
+    rows = []
+    for n in sorted(os.listdir(directory)):
+        if not (n.startswith("incident-") and n.endswith(".json")):
+            continue
+        try:
+            doc = read_incident(os.path.join(directory, n))
+        except (OSError, ValueError) as e:
+            rows.append((n, f"INVALID: {e}"))
+            continue
+        slo = doc.get("slo", {})
+        rows.append((
+            n,
+            f"{doc.get('trigger')} [{doc.get('outcome')}] "
+            f"detect={_fmt_s(slo.get('time_to_detect_s'))} "
+            f"recover={_fmt_s(slo.get('time_to_recover_s'))}",
+        ))
+    if not rows:
+        print(f"no incidents under {directory}", file=sys.stderr)
+        return 1
+    for name, desc in rows:
+        print(f"{name}  {desc}", file=out)
+    return 0
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render a tpu-resiliency incident artifact as a "
+        "postmortem timeline"
+    )
+    ap.add_argument(
+        "artifact",
+        help="incident-<ts>.json file, or a directory (newest artifact; "
+        "--list shows all)",
+    )
+    ap.add_argument(
+        "--list", action="store_true",
+        help="list every artifact in the directory with one-line verdicts",
+    )
+    ap.add_argument(
+        "--events", action="store_true",
+        help="also print the full event window",
+    )
+    ap.add_argument(
+        "--flight", action="store_true",
+        help="also print each flight-recorder dump line by line",
+    )
+    args = ap.parse_args(argv)
+    if args.list:
+        if not os.path.isdir(args.artifact):
+            print(f"--list needs a directory, got {args.artifact!r}", file=sys.stderr)
+            return 1
+        return _list(args.artifact, sys.stdout)
+    try:
+        path = resolve_artifact(args.artifact)
+        doc = read_incident(path)
+    except (OSError, ValueError) as e:
+        print(f"cannot read incident artifact: {e}", file=sys.stderr)
+        return 1
+    if pipe_safe(
+        lambda: render(
+            doc, sys.stdout, show_events=args.events, show_flight=args.flight
+        )
+    ):
+        return SIGPIPE_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,7 +111,9 @@ def to_chrome_trace(records: list[dict]) -> dict:
         })
 
     # A span the process never closed (it crashed inside — the signal an
-    # operator is usually hunting) renders as a slice to end-of-stream.
+    # operator is usually hunting) renders as a slice to end-of-stream:
+    # open-ended, never silently dropped, and colored distinctly (cname) so
+    # the crashed-mid-span slice jumps out of a busy trace.
     for (pid, sid), begin in open_spans.items():
         bp = _payload(begin)
         args = {**bp, "span_id": sid, "unfinished": True}
@@ -121,6 +123,7 @@ def to_chrome_trace(records: list[dict]) -> dict:
             "ph": "X", "ts": us(begin["ts"]),
             "dur": max(0.0, us(t_last) - us(begin["ts"])),
             "pid": pid, "tid": _tid(begin), "args": args,
+            "cname": "terrible",
         })
 
     # Name each pid row by its dominant event source (launcher/worker/monitor).
@@ -169,9 +172,16 @@ def main(argv: Optional[list[str]] = None) -> int:
         with open(args.output, "w") as f:
             f.write(doc + "\n")
         n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        n_open = sum(
+            1 for e in trace["traceEvents"]
+            if e.get("ph") == "X" and e.get("args", {}).get("unfinished")
+        )
+        open_note = (
+            f", {n_open} UNFINISHED (a process died mid-span)" if n_open else ""
+        )
         print(
             f"wrote {args.output}: {len(trace['traceEvents'])} trace events "
-            f"({n_spans} spans) — load in ui.perfetto.dev"
+            f"({n_spans} spans{open_note}) — load in ui.perfetto.dev"
         )
         return 0
     if pipe_safe(lambda: print(doc)):

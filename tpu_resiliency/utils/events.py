@@ -25,6 +25,10 @@ Instrumented as built (the canonical emitter set — one record per decision):
   async-save scheduling.
 - ``ft``/``straggler``/``preemption`` (integrations): timeout calibrations,
   straggler reports, preemption sync points, training-finished markers.
+- ``incident``/``remediation``/``flight`` (the incident plane,
+  ``launcher/incident.py`` + ``telemetry/remediation.py`` +
+  ``utils/flight_recorder.py``): incident open/close with SLO timings,
+  remediation decisions and per-action outcomes, flight-recorder flushes.
 
 Design:
 
@@ -64,6 +68,11 @@ EVENTS_FILE_ENV = "TPU_RESILIENCY_EVENTS_FILE"
 #: snapshot it as JSON (``utils/metrics.py``); ``<pid>`` is inserted before the
 #: extension so each process of a node drops its own snapshot (no clobbering).
 METRICS_FILE_ENV = "TPU_RESILIENCY_METRICS_FILE"
+#: Set to a directory to ALSO keep a crash-surviving flight-recorder ring of
+#: this process's recent events (``utils/flight_recorder.py``) — the incident
+#: plane's last-seconds-before-death record, persisted continuously so even a
+#: SIGKILL leaves a dump behind.
+FLIGHT_DIR_ENV = "TPU_RESILIENCY_FLIGHT_DIR"
 
 #: Envelope keys every JSONL record carries; payload keys that collide are
 #: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary, trace_export)
@@ -188,20 +197,24 @@ def remove_sink(sink: Callable[[Event], None]) -> None:
 def clear_sinks() -> None:
     with _sinks_lock:
         _sinks.clear()
-    global _env_wired_for, _metrics_wired_for
+    global _env_wired_for, _metrics_wired_for, _flight_wired_for
     _env_wired_for = None
     _metrics_wired_for = None
+    _flight_wired_for = None
 
 
 _metrics_wired_for: Optional[str] = None
+_flight_wired_for: Optional[str] = None
 
 
 def _wire_env_sink() -> None:
     """Attach (once per path) the JSONL sink named by $TPU_RESILIENCY_EVENTS_FILE
     and the metrics bridge named by $TPU_RESILIENCY_METRICS_FILE.
     Re-checked on every record so a launcher exporting the variable after import
-    still takes effect, and forked/spawned children wire themselves lazily."""
-    global _env_wired_for, _metrics_wired_for
+    still takes effect, and forked/spawned children wire themselves lazily.
+    The flight recorder named by $TPU_RESILIENCY_FLIGHT_DIR rides the same
+    lazy wiring (flight_recorder.install registers itself as a sink)."""
+    global _env_wired_for, _metrics_wired_for, _flight_wired_for
     path = os.environ.get(EVENTS_FILE_ENV)
     if path and path != _env_wired_for:
         with _sinks_lock:
@@ -228,6 +241,17 @@ def _wire_env_sink() -> None:
                 except Exception as e:
                     log.warning(f"cannot wire metrics snapshots to {mpath!r}: {e}")
                 _metrics_wired_for = mpath
+    fpath = os.environ.get(FLIGHT_DIR_ENV)
+    if fpath and fpath != _flight_wired_for:
+        try:
+            # Lazy import for the same reason as the metrics bridge: events
+            # stays the dependency root. install() adds the sink itself.
+            from tpu_resiliency.utils import flight_recorder
+
+            flight_recorder.install_from_env()
+        except Exception as e:
+            log.warning(f"cannot wire flight recorder in {fpath!r}: {e}")
+        _flight_wired_for = fpath
 
 
 def record(source: str, kind: str, **payload: Any) -> None:

@@ -481,6 +481,52 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "network faults injected by the chaos plan",
             kind=str(rec.get("fault", "?")), channel=str(rec.get("channel", "?")),
         ).inc()
+    elif kind == "incident_opened":
+        reg.counter(
+            "tpu_incidents_total",
+            "incidents opened by the incident engine, by trigger",
+            trigger=str(rec.get("trigger", "?")),
+        ).inc()
+        reg.gauge(
+            "tpu_incidents_open", "incidents currently open"
+        ).inc()
+    elif kind == "incident_closed":
+        reg.gauge("tpu_incidents_open", "incidents currently open").dec()
+        # Literal names on purpose: the docs-drift gate
+        # (tests/utils/test_metrics_doc.py) extracts them by AST.
+        if isinstance(rec.get("time_to_detect_s"), (int, float)):
+            reg.histogram(
+                "tpu_incident_time_to_detect_seconds",
+                "fault evidence -> incident opened, per incident",
+            ).observe(rec["time_to_detect_s"])
+        if isinstance(rec.get("time_to_decide_s"), (int, float)):
+            reg.histogram(
+                "tpu_incident_time_to_decide_seconds",
+                "incident opened -> first decision, per incident",
+            ).observe(rec["time_to_decide_s"])
+        if isinstance(rec.get("time_to_recover_s"), (int, float)):
+            reg.histogram(
+                "tpu_incident_time_to_recover_seconds",
+                "fault evidence -> recovered, per incident",
+            ).observe(rec["time_to_recover_s"])
+        if isinstance(rec.get("steps_lost"), (int, float)):
+            reg.counter(
+                "tpu_incident_steps_lost_total",
+                "training steps lost across incidents (resume gap)",
+            ).inc(max(0.0, rec["steps_lost"]))
+    elif kind == "remediation_action":
+        reg.counter(
+            "tpu_remediation_actions_total",
+            "automated remediation actions by action and outcome",
+            action=str(rec.get("action", "?")),
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "flight_flush":
+        reg.counter(
+            "tpu_flight_flushes_total",
+            "flight-recorder consolidated dumps by reason",
+            reason=str(rec.get("reason", "?")),
+        ).inc()
     elif kind == "heartbeat_stats":
         if isinstance(rec.get("max_gap_s"), (int, float)):
             reg.histogram(

@@ -324,6 +324,15 @@ class RankMonitorServer:
             global_rank=s.info.global_rank,
             pid=s.info.pid, reason=reason, via=via,
         )
+        # The monitor holds the heartbeat/section story the dying rank cannot
+        # tell: snapshot this process's ring before the kill ladder runs, so
+        # the incident artifact carries the detection side even if the
+        # monitor itself is torn down right after.
+        from tpu_resiliency.utils import flight_recorder
+
+        flight_recorder.flush(
+            "kill_ladder", detail=f"rank {s.info.global_rank}: {reason}"
+        )
         self.restarter.handling_start(f"reason={reason!r}")
         self.log.error(f"terminating rank {s.info.global_rank} (pid {s.info.pid}): {reason}")
         self.restarter.handling_processing()
