@@ -118,6 +118,33 @@ class TestEngineUnit:
         assert all(r.get("trace_id") != "theirs" for r in doc["events"])
         assert any(r["kind"] == "restart_requested" for r in doc["events"])
 
+    def test_stale_run_history_cannot_dominate_trace(self, tmp_path):
+        # A reused events file holds a LONGER previous run under another
+        # trace, all outside the incident window: the dominant trace must be
+        # computed over the window only, keeping this run's events.
+        ev_file = str(tmp_path / "ev.jsonl")
+        now = time.time()
+        with open(ev_file, "w") as f:
+            for i in range(50):  # yesterday's run, out-voting if counted
+                f.write(json.dumps({
+                    "ts": now - 86400 + i, "source": "w", "kind": "heartbeat",
+                    "pid": 9, "trace_id": "yesterday",
+                }) + "\n")
+            for rec in [
+                {"ts": now - 0.02, "source": "w", "kind": "worker_failed",
+                 "pid": 1, "trace_id": "today", "global_rank": 0},
+                {"ts": now - 0.01, "source": "w", "kind": "restart_requested",
+                 "pid": 1, "trace_id": "today", "reason": "x"},
+            ]:
+                f.write(json.dumps(rec) + "\n")
+        eng = IncidentEngine(str(tmp_path / "inc"), events_file=ev_file)
+        eng.open("worker_failed", ranks=[0])
+        path = eng.close()
+        doc = read_incident(path)
+        assert doc["trace_id"] == "today"
+        assert any(r["kind"] == "restart_requested" for r in doc["events"])
+        assert all(r.get("trace_id") != "yesterday" for r in doc["events"])
+
     def test_steps_lost_from_iteration_markers(self, tmp_path):
         eng = IncidentEngine(str(tmp_path / "inc"), events_file=None)
         eng.attach()

@@ -162,6 +162,28 @@ class TestCooldownAndDryRun:
         ]
         assert skipped and skipped[0].payload["detail"] == "cooldown"
 
+    def test_cooldown_does_not_suppress_own_plan(self):
+        # A multi-action plan is ONE remediation: with cooldown enabled, the
+        # proactive checkpoint must not cool down the swap in the same plan.
+        restarts = []
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: None,
+            spare_capacity_fn=lambda: 1,
+            publish_degraded_fn=lambda d: None,
+            request_restart_fn=restarts.append,
+            cooldown=3600.0,
+        )
+        taken = eng.remediate(decision(newly={1}))
+        assert taken == [
+            (ACTION_CHECKPOINT, OUTCOME_OK),
+            (ACTION_SPARE_SWAP, OUTCOME_OK),
+        ]
+        assert len(restarts) == 1
+        # The next decision lands inside the window: the whole plan skips.
+        second = eng.remediate(decision(newly={0}, degraded={0, 1}))
+        assert all(o == OUTCOME_SKIPPED for _, o in second)
+        assert len(restarts) == 1
+
     def test_dry_run_never_actuates(self):
         eng = RemediationEngine(
             checkpoint_fn=lambda: pytest.fail("dry run must not checkpoint"),

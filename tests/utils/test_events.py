@@ -93,6 +93,21 @@ def test_read_events_tolerates_torn_line(tmp_path):
     assert [r["kind"] for r in events.read_events(str(path))] == ["a"]
 
 
+def test_read_events_window_filters_at_read_time(tmp_path):
+    path = tmp_path / "win.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in [
+        {"kind": "old", "ts": 10.0},
+        {"kind": "in_a", "ts": 20.0},
+        {"kind": "no_ts"},
+        {"kind": "in_b", "ts": 25.0},
+        {"kind": "future", "ts": 99.0},
+    ]) + "\n")
+    recs = events.read_events(str(path), since=20.0, until=30.0)
+    assert [r["kind"] for r in recs] == ["in_a", "in_b"]
+    # Unbounded read keeps everything, ts-less records included.
+    assert len(events.read_events(str(path))) == 5
+
+
 def test_debug_time_nesting_and_event(tmp_path, caplog):
     import logging
 

@@ -68,6 +68,24 @@ def test_flush_writes_consolidated_dump_with_reason(tmp_path):
     assert marker["detail"] == "testing"
 
 
+def test_flush_does_not_block_when_lock_held(tmp_path):
+    # A SIGTERM can land while the main thread holds the ring lock inside
+    # __call__; flush() runs on that same thread and must not deadlock — it
+    # snapshots the ring without blocking and still writes the dump.
+    d = str(tmp_path / "fl")
+    rec = flight_recorder.install(d, capacity=50, install_handlers=False)
+    events.record("test", "before_signal")
+    assert rec._lock.acquire(blocking=False)
+    try:
+        path = rec.flush("signal:SIGTERM")
+    finally:
+        rec._lock.release()
+    assert path and os.path.exists(path)
+    records = next(iter(flight_recorder.collect(d).values()))
+    kinds = [r["kind"] for r in records]
+    assert "before_signal" in kinds and "flight_flush" in kinds
+
+
 def test_events_after_flush_still_collected(tmp_path):
     d = str(tmp_path / "fl")
     rec = flight_recorder.install(d, capacity=50, install_handlers=False)

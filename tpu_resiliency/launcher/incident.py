@@ -400,15 +400,19 @@ class IncidentEngine:
         lo = inc.fault_ts - PRE_WINDOW_S
         recs: list[dict] = []
         if self.events_file:
-            recs = read_events(self.events_file)
+            # Stream-filtered at read time: the shared file can span many
+            # runs/days and must never be materialized whole at close.
+            recs = read_events(self.events_file, since=lo, until=now)
         if not recs:
-            recs = list(self._prebuffer)
+            recs = [
+                r for r in self._prebuffer
+                if isinstance(r.get("ts"), (int, float)) and lo <= r["ts"] <= now
+            ]
+        # Dominant trace over the window only — a longer earlier run sharing
+        # the stream must not out-vote this incident's own events.
         trace = self._dominant_trace(recs)
         out = []
         for r in recs:
-            ts = r.get("ts")
-            if not isinstance(ts, (int, float)) or not (lo <= ts <= now):
-                continue
             if trace and r.get("trace_id") not in (None, trace):
                 continue  # another run sharing the stream
             if r.get("kind") in ("incident_opened", "incident_closed"):

@@ -76,7 +76,9 @@ class RemediationEngine:
 
     ``cooldown`` (seconds) bounds actuation frequency: a decision landing
     inside the cooldown window is still audited, with ``outcome=skipped`` —
-    remediation must not thrash the job faster than it can recover.
+    remediation must not thrash the job faster than it can recover. The
+    window is evaluated once per decision (a plan is one remediation), so a
+    proactive checkpoint never cools down the swap/exclude in its own plan.
     """
 
     def __init__(
@@ -141,8 +143,18 @@ class RemediationEngine:
                 degraded=sorted(decision.degraded),
                 newly=sorted(decision.newly_degraded),
             )
+        # Cooldown is evaluated once per decision, not per action: a plan is
+        # one remediation (checkpoint → swap/exclude), and stamping after the
+        # first step would suppress the rest of its own plan.
+        in_cooldown = (
+            time.monotonic() - self._last_action_ts
+        ) < self.cooldown
         for action, runner in plan:
-            taken.append(self._execute(action, runner, decision))
+            taken.append(
+                self._execute(action, runner, decision, in_cooldown=in_cooldown)
+            )
+        if any(outcome == OUTCOME_OK for _, outcome in taken):
+            self._last_action_ts = time.monotonic()
         self.history.extend(taken)
         return taken
 
@@ -164,11 +176,14 @@ class RemediationEngine:
         return plan
 
     def _execute(
-        self, action: str, runner: Callable, decision: HealthDecision
+        self,
+        action: str,
+        runner: Callable,
+        decision: HealthDecision,
+        in_cooldown: bool = False,
     ) -> tuple[str, str]:
-        now = time.monotonic()
         ranks = sorted(decision.newly_degraded)
-        if self.dry_run or (now - self._last_action_ts) < self.cooldown:
+        if self.dry_run or in_cooldown:
             outcome = OUTCOME_SKIPPED
             detail = "dry_run" if self.dry_run else "cooldown"
             record_event(
@@ -195,8 +210,6 @@ class RemediationEngine:
                 outcome=outcome, ranks=ranks,
                 **({"detail": detail} if detail else {}),
             )
-        if outcome == OUTCOME_OK:
-            self._last_action_ts = now
         return action, outcome
 
     # -- actuators ----------------------------------------------------------

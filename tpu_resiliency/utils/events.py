@@ -246,12 +246,14 @@ def _wire_env_sink() -> None:
         try:
             # Lazy import for the same reason as the metrics bridge: events
             # stays the dependency root. install() adds the sink itself.
+            # Marked wired only on success so a transient mkdir/open failure
+            # is retried on the next record, as the lazy wiring promises.
             from tpu_resiliency.utils import flight_recorder
 
-            flight_recorder.install_from_env()
+            if flight_recorder.install_from_env() is not None:
+                _flight_wired_for = fpath
         except Exception as e:
             log.warning(f"cannot wire flight recorder in {fpath!r}: {e}")
-        _flight_wired_for = fpath
 
 
 def record(source: str, kind: str, **payload: Any) -> None:
@@ -312,9 +314,21 @@ def prof(source: str, name: Optional[str] = None):
     return deco
 
 
-def read_events(path: str) -> list[dict]:
-    """Parse a JSONL event file (tolerates torn trailing lines)."""
+def read_events(
+    path: str,
+    *,
+    since: Optional[float] = None,
+    until: Optional[float] = None,
+) -> list[dict]:
+    """Parse a JSONL event file (tolerates torn trailing lines).
+
+    ``since``/``until`` stream-filter records by their ``ts`` while reading,
+    so callers slicing a window out of a long-lived shared file (the incident
+    engine closes incidents against a stream that can span days) never
+    materialize its full history. When either bound is set, records without a
+    numeric ``ts`` are dropped — they cannot be placed in the window."""
     out = []
+    bounded = since is not None or until is not None
     try:
         with open(path) as f:
             for line in f:
@@ -322,9 +336,18 @@ def read_events(path: str) -> list[dict]:
                 if not line:
                     continue
                 try:
-                    out.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if bounded:
+                    ts = rec.get("ts")
+                    if not isinstance(ts, (int, float)):
+                        continue
+                    if since is not None and ts < since:
+                        continue
+                    if until is not None and ts > until:
+                        continue
+                out.append(rec)
     except OSError:
         pass
     return out

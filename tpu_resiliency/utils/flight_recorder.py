@@ -40,6 +40,7 @@ import os
 import signal
 import sys
 import threading
+import time
 from collections import deque
 from typing import Optional
 
@@ -147,9 +148,15 @@ class FlightRecorder:
         """Write the consolidated dump (ring + trailing ``flight_flush``
         marker). Idempotent per reason sequence — later flushes rewrite the
         dump with the newest ring, so the deepest-in-the-death flush wins.
-        Returns the dump path (None if the write failed)."""
-        import time
+        Returns the dump path (None if the write failed).
 
+        Signal-safe: flush() runs from the chained SIGTERM/SIGABRT handlers,
+        which interrupt the main thread at an arbitrary point — possibly while
+        it already holds ``self._lock`` inside ``__call__``. A blocking
+        acquire there would deadlock the handler and turn a graceful stop into
+        a hang, so the ring is snapshotted with a non-blocking acquire and,
+        when the lock is held, copied without it (deque reads are GIL-atomic
+        enough for a best-effort dump)."""
         marker = json.dumps(
             {
                 "ts": time.time(),
@@ -161,9 +168,22 @@ class FlightRecorder:
                 **({"detail": detail} if detail else {}),
             }
         )
-        with self._lock:
-            lines = list(self._ring) + [marker]
+        acquired = self._lock.acquire(blocking=False)
+        try:
+            lines = None
+            for _ in range(3):
+                try:
+                    lines = list(self._ring)
+                    break
+                except RuntimeError:  # deque mutated mid-iteration (lockless)
+                    continue
+            if lines is None:
+                lines = []
+            lines.append(marker)
             self._flushed_reason = reason
+        finally:
+            if acquired:
+                self._lock.release()
         tmp = f"{self.dump_path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
