@@ -27,9 +27,10 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   policy → remediation loop, a store reset, and a disk bitflip landing during
   an active save — with the incident plane watching. Convergence = recovery
   byte-identical, every incident artifact carries the detect→decide→act→
-  recover chain and renders through ``incident_report``, and the
+  recover chain and renders through ``incident_report``, the
   ``tpu_incident_*`` / ``tpu_remediation_actions_total`` metrics aggregate
-  from the events stream.
+  from the events stream, and the goodput ledger charges the campaign's
+  open→close windows to the ``incident`` phase.
 
 Every in-process scenario runs TWICE with the same seed and asserts the two
 injection schedules are identical — the reproducibility contract: a failure
@@ -477,6 +478,19 @@ def scenario_mixed(seed: int, workdir: str, spec: str | None = None):
             "tpu_remediation_actions_total", 'kind="bitflip"',
         ):
             assert want in prom, f"{want} missing from metrics:\n{prom[:2000]}"
+
+        # Goodput attribution: the campaign's incident windows must be
+        # charged to the ``incident`` phase by the same ledger the launcher's
+        # /goodput endpoint and metrics_dump --goodput run.
+        from tpu_resiliency.utils.goodput import GoodputLedger
+
+        ledger = GoodputLedger()
+        ledger.observe_many(read_events(events_file))
+        gp = ledger.summary()
+        assert gp["phases"]["incident"] > 0, (
+            f"mixed campaign charged no incident time: {gp['phases']}"
+        )
+        assert abs(sum(gp["phases"].values()) - gp["wall_clock_s"]) < 1e-3, gp
     finally:
         chaos.clear_plan()
         engine.detach()

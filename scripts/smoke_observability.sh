@@ -91,6 +91,82 @@ else
     echo "integrity OK: --verify caught the flipped bit (exit 1 as designed)"
 fi
 
+echo "== smoke: goodput plane (live /metrics + /goodput on the launcher vs offline --goodput)"
+GP="$WORKDIR/goodput"
+mkdir -p "$GP"
+cat > "$GP/worker.py" <<'PY'
+import os, sys, time
+import numpy as np
+from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+from tpu_resiliency.utils.events import record
+
+stop, ckpt_root = sys.argv[1], sys.argv[2]
+round_no = int(os.environ["TPU_FT_RESTART_COUNT"])
+rank = int(os.environ.get("RANK", "0"))
+for i in range(10):
+    record("inprocess", "iteration_start", iteration=i)
+    time.sleep(0.05)
+m = LocalCheckpointManager(ckpt_root, rank=rank)
+m.save(round_no, PyTreeStateDict({"w": np.arange(8192, dtype=np.float32)}), is_async=False)
+m.close()
+if round_no == 0 and rank == 0:
+    sys.exit(3)  # round 0 fault: the restart phase must show up in /goodput
+i = 10
+deadline = time.time() + 90
+while not os.path.exists(stop) and time.time() < deadline:
+    record("inprocess", "iteration_start", iteration=i)
+    i += 1
+    time.sleep(0.05)
+PY
+python -m tpu_resiliency.launcher.launch \
+    --standalone --nproc-per-node 2 --max-restarts 2 --no-ft-monitors \
+    --rdzv-last-call 0.2 --monitor-interval 0.1 --telemetry-port 0 \
+    --events-file "$GP/events.jsonl" --run-dir "$GP/run" \
+    "$GP/worker.py" "$GP/stop" "$GP/ckpt" &
+GP_PID=$!
+python - "$GP" <<'PY'
+import json, os, sys, time, urllib.request
+
+gp = sys.argv[1]
+port_file = os.path.join(gp, "run", "telemetry.port")
+deadline = time.time() + 60
+while not os.path.exists(port_file):
+    assert time.time() < deadline, "telemetry.port handshake file never appeared"
+    time.sleep(0.2)
+port = int(open(port_file).read().strip())
+summary = None
+while time.time() < deadline:
+    try:
+        summary = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/goodput", timeout=5).read())
+    except OSError:
+        time.sleep(0.3)
+        continue
+    ph = summary["phases"]
+    if ph["train"] > 0 and ph["ckpt_stall"] > 0 and ph["restart"] > 0:
+        break
+    time.sleep(0.3)
+ph = summary["phases"]
+assert ph["train"] > 0 and ph["ckpt_stall"] > 0 and ph["restart"] > 0, summary
+wall = summary["wall_clock_s"]
+assert abs(sum(ph.values()) - wall) <= 0.05 * wall, (
+    f"attribution phases {ph} do not sum to wall clock {wall}")
+prom = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+assert "tpu_goodput_ratio" in prom, prom[:2000]
+assert "tpu_time_attributed_seconds_total" in prom, prom[:2000]
+assert "tpu_step_seconds_bucket" in prom, prom[:2000]
+hz = json.loads(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+assert "healthy" in hz, hz
+print(f"goodput live OK: ratio={summary['goodput_ratio']} phases={ph}")
+PY
+touch "$GP/stop"
+wait "$GP_PID"
+python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --goodput | sed 's/^/    /'
+python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --goodput --format json | \
+    python -c "import json,sys; d=json.load(sys.stdin); assert d['phases']['restart']>0 and d['phases']['ckpt_stall']>0, d" \
+    || { echo "FAIL: offline --goodput lost the restart/ckpt attribution"; exit 1; }
+
 echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign)"
 python scripts/chaos_soak.py --smoke --workdir "$WORKDIR/chaos"
 

@@ -1,0 +1,154 @@
+"""TelemetryServer unit coverage: endpoint contract, merged view, port-file
+handshake, incremental events tail, health semantics — no launcher needed."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_resiliency.launcher.telemetry import PORT_FILE_NAME, TelemetryServer
+from tpu_resiliency.utils import events
+from tpu_resiliency.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    old = os.environ.pop(events.EVENTS_FILE_ENV, None)
+    yield
+    events.clear_sinks()
+    if old is not None:
+        os.environ[events.EVENTS_FILE_ENV] = old
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type", "")
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = TelemetryServer(
+        port=0,
+        port_file=str(tmp_path / "run" / PORT_FILE_NAME),
+        events_file=str(tmp_path / "ev.jsonl"),
+    )
+    srv.start()
+    yield srv, tmp_path
+    srv.stop()
+
+
+def test_port_file_handshake(server):
+    srv, tmp_path = server
+    port_file = tmp_path / "run" / PORT_FILE_NAME
+    assert int(port_file.read_text().strip()) == srv.port
+    srv.stop()
+    assert not port_file.exists()  # handshake file is cleaned up
+
+
+def test_metrics_endpoint_merges_pushed_snapshots(server):
+    srv, _ = server
+    # Two fake ranks' pushed snapshots + launcher-local registry.
+    snaps = []
+    for r in range(2):
+        reg = MetricsRegistry()
+        reg.counter("tpu_ckpt_saves_total", "saves").inc(3)
+        snaps.append(reg.snapshot())
+    srv.fetch_snapshots = lambda: snaps
+    srv.registry.counter("tpu_ckpt_saves_total", "saves").inc(1)
+    status, body, ctype = _get(srv.port, "/metrics")
+    assert status == 200 and ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    assert "tpu_ckpt_saves_total 7" in body  # 3 + 3 + 1: the summed view
+
+
+def test_metrics_endpoint_survives_bad_snapshots(server):
+    srv, _ = server
+    srv.fetch_snapshots = lambda: [{"garbage": True}, None, 42]
+    status, body, _ = _get(srv.port, "/metrics")
+    assert status == 200  # unmergeable snapshots are skipped, not fatal
+
+
+def test_goodput_endpoint_tails_events_incrementally(server):
+    srv, tmp_path = server
+    ev = tmp_path / "ev.jsonl"
+    t0 = time.time()
+    with open(ev, "w") as f:
+        for i in range(3):
+            f.write(json.dumps({
+                "kind": "iteration_start", "iteration": i, "ts": t0 + i,
+                "pid": 9, "rank": 0,
+            }) + "\n")
+    status, body, ctype = _get(srv.port, "/goodput")
+    doc = json.loads(body)
+    assert status == 200 and ctype.startswith("application/json")
+    assert doc["schema"] == "tpu-goodput-1"
+    assert doc["phases"]["train"] == pytest.approx(2.0)
+    offset_after_first = srv._offset
+    assert offset_after_first == ev.stat().st_size
+    # Append more (plus a torn trailing line that must NOT advance offset).
+    with open(ev, "a") as f:
+        f.write(json.dumps({
+            "kind": "iteration_start", "iteration": 3, "ts": t0 + 3,
+            "pid": 9, "rank": 0,
+        }) + "\n")
+        f.write('{"kind": "torn')
+    doc2 = json.loads(_get(srv.port, "/goodput")[1])
+    assert doc2["phases"]["train"] == pytest.approx(3.0)
+    assert srv._offset > offset_after_first
+    assert srv._offset < ev.stat().st_size  # torn tail left for next refresh
+
+
+def test_goodput_publish_lands_in_metrics_view(server):
+    srv, tmp_path = server
+    t0 = time.time()
+    with open(tmp_path / "ev.jsonl", "w") as f:
+        for i in range(2):
+            f.write(json.dumps({
+                "kind": "iteration_start", "iteration": i, "ts": t0 + i,
+                "pid": 9, "rank": 0,
+            }) + "\n")
+    _get(srv.port, "/goodput")  # refresh publishes goodput_update
+    _, body, _ = _get(srv.port, "/metrics")
+    assert 'tpu_time_attributed_seconds_total{phase="train"}' in body
+    assert "tpu_goodput_ratio 1" in body
+
+
+def test_healthz_contract(server):
+    srv, _ = server
+    status, body, _ = _get(srv.port, "/healthz")
+    assert status == 200 and json.loads(body) == {"healthy": True}
+    srv.health_fn = lambda: {"healthy": False, "restarts_used": 9}
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.port, "/healthz")
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read())["restarts_used"] == 9
+    # A crashing health_fn degrades to unhealthy, never to a 500.
+    srv.health_fn = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.port, "/healthz")
+    assert ei.value.code == 503
+
+
+def test_unknown_path_is_404_with_directory(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.port, "/nope")
+    assert ei.value.code == 404
+    doc = json.loads(ei.value.read())
+    assert set(doc["endpoints"]) == {"/metrics", "/goodput", "/healthz"}
+
+
+def test_local_events_feed_the_served_registry(server):
+    """The server attaches a MetricsSink: launcher-process events appear in
+    /metrics without any file round-trip; stop() detaches it."""
+    srv, _ = server
+    events.record("launcher", "worker_failed", global_rank=0)
+    _, body, _ = _get(srv.port, "/metrics")
+    assert "tpu_worker_failures_total 1" in body
+    srv.stop()
+    events.record("launcher", "worker_failed", global_rank=0)
+    assert srv.registry.counter("tpu_worker_failures_total").value == 1

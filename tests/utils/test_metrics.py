@@ -103,6 +103,102 @@ def test_metric_name_sanitized():
     assert "weird_name_total 1" in reg.to_prometheus()
 
 
+def test_label_values_escaped_per_exposition_format():
+    """Regression: a backslash, double-quote, or newline in a label value
+    (peer addresses, file paths) must render as valid 0.0.4 text — escaped,
+    never raw."""
+    reg = MetricsRegistry()
+    reg.counter("x_total", path="C:\\tmp\\f").inc()
+    reg.counter("x_total", peer='he said "hi"').inc()
+    reg.counter("x_total", detail="line1\nline2").inc()
+    text = reg.to_prometheus()
+    assert 'path="C:\\\\tmp\\\\f"' in text
+    assert 'peer="he said \\"hi\\""' in text
+    assert 'detail="line1\\nline2"' in text
+    # No sample line may span two physical lines.
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert line.count('"') % 2 == 0, line
+    # HELP text gets backslash/newline escaping too.
+    reg2 = MetricsRegistry()
+    reg2.counter("y_total", "multi\nline \\help").inc()
+    help_line = next(
+        ln for ln in reg2.to_prometheus().splitlines() if ln.startswith("# HELP")
+    )
+    assert help_line == "# HELP y_total multi\\nline \\\\help"
+
+
+def test_write_json_is_strict_json(tmp_path):
+    """Snapshots are restricted to plain JSON types: NaN quantiles become
+    null (not a repr string, not a bare NaN token) and the document parses
+    under a strict-JSON reader."""
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(2)
+    reg.histogram("h_seconds")  # zero observations -> NaN quantiles
+    reg.gauge("g").set(1.5)
+    path = str(tmp_path / "m.json")
+    reg.write_json(path)
+
+    def no_constants(name):
+        raise AssertionError(f"non-JSON constant {name} leaked into snapshot")
+
+    doc = json.loads(open(path).read(), parse_constant=no_constants)
+    h = doc["metrics"]["h_seconds"][0]
+    assert h["p50"] is None and h["count"] == 0
+    assert doc["metrics"]["c_total"][0]["value"] == 2
+    # Round-trip: the parsed document is byte-equivalent snapshot content.
+    assert json.loads(json.dumps(doc)) == doc
+
+
+def test_snapshot_drops_non_coercible_values():
+    from tpu_resiliency.utils.metrics import _plain_json
+
+    class Weird:
+        pass
+
+    doc = _plain_json({"ok": 1, "bad": Weird(), "nan": float("nan"),
+                       "inf": float("inf"), "np_like": True})
+    assert doc == {"ok": 1, "bad": None, "nan": None, "inf": None,
+                   "np_like": True}
+
+
+def test_iteration_start_feeds_step_histogram():
+    """The satellite: iteration_start deltas land in tpu_step_seconds — but
+    only strictly-consecutive iterations within the gap cap (a repeat after
+    an in-process restart or a multi-minute stall is downtime, not a step)."""
+    from tpu_resiliency.utils.metrics import STEP_GAP_MAX_S
+
+    reg = MetricsRegistry()
+    t0 = 1000.0
+    recs = [
+        {"kind": "iteration_start", "iteration": 0, "ts": t0, "pid": 7},
+        {"kind": "iteration_start", "iteration": 1, "ts": t0 + 0.5, "pid": 7},
+        {"kind": "iteration_start", "iteration": 2, "ts": t0 + 1.0, "pid": 7},
+        # same iteration again (in-process restart): not a step
+        {"kind": "iteration_start", "iteration": 2, "ts": t0 + 9.0, "pid": 7},
+        # consecutive but beyond the gap cap: not a step
+        {"kind": "iteration_start", "iteration": 3,
+         "ts": t0 + 9.0 + STEP_GAP_MAX_S + 1, "pid": 7},
+        # a different pid has its own chain
+        {"kind": "iteration_start", "iteration": 0, "ts": t0, "pid": 8},
+        {"kind": "iteration_start", "iteration": 1, "ts": t0 + 0.25, "pid": 8},
+    ]
+    aggregate(recs, reg)
+    hists = reg.histograms("tpu_step_seconds")
+    assert len(hists) == 1
+    h = next(iter(hists.values()))
+    assert h.count == 3  # 2 steps from pid 7 + 1 from pid 8
+    assert abs(h.sum - 1.25) < 1e-9
+    # Live sink parity: the same records through MetricsSink agree.
+    live = MetricsRegistry()
+    for r in recs:
+        from tpu_resiliency.utils.metrics import observe_record as orec
+        orec(r, live)
+    lh = next(iter(live.histograms("tpu_step_seconds").values()))
+    assert lh.count == h.count and lh.bucket_counts == h.bucket_counts
+
+
 def test_snapshot_and_write_json(tmp_path):
     reg = MetricsRegistry()
     reg.counter("c_total").inc(3)

@@ -1,0 +1,200 @@
+"""Merge algebra of MetricsRegistry snapshots.
+
+The push-path contract: counters sum, gauges are last-writer-wins by
+timestamp, histograms add bucket-wise — and the merge is associative AND
+commutative, so a tree of partial merges (what an O(log N) aggregation
+topology produces) equals the flat merge, and either equals the flat
+``aggregate()`` of the same event stream.
+"""
+
+import random
+
+import pytest
+
+from tpu_resiliency.utils.metrics import (
+    DEFAULT_BUCKETS,
+    MetricsRegistry,
+    aggregate,
+    observe_record,
+)
+
+
+def _exposition_series(reg: MetricsRegistry) -> dict:
+    """Counter/gauge values and histogram buckets, quantiles excluded (the
+    merged truth is buckets; reservoirs don't transport)."""
+    out = {}
+    snap = reg.snapshot()
+    for name, entries in snap["metrics"].items():
+        for e in entries:
+            key = (name, tuple(sorted(e["labels"].items())))
+            if e["type"] == "histogram":
+                # Buckets and counts compare EXACTLY; the float ``sum``
+                # accumulator is normalized (addition order varies with merge
+                # shape, the one place IEEE754 non-associativity leaks in).
+                out[key] = ("histogram", e["count"], round(e["sum"], 6),
+                            tuple(e["buckets"]["bounds"]),
+                            tuple(e["buckets"]["counts"]))
+            else:
+                out[key] = (e["type"], round(e["value"], 6))
+    return out
+
+
+def _random_registry(seed: int) -> MetricsRegistry:
+    rng = random.Random(seed)
+    reg = MetricsRegistry()
+    for i in range(rng.randrange(1, 5)):
+        reg.counter("m_total", "c", kind=f"k{rng.randrange(3)}").inc(
+            rng.randrange(1, 100)
+        )
+    for i in range(rng.randrange(1, 4)):
+        reg.gauge("g_val", "g", slot=f"s{rng.randrange(2)}").set(
+            rng.randrange(100), ts=rng.randrange(1, 1000)
+        )
+    h = reg.histogram("h_seconds", "h")
+    for _ in range(rng.randrange(0, 20)):
+        h.observe(rng.random() * 100)
+    return reg
+
+
+def merged(*snaps) -> MetricsRegistry:
+    reg = MetricsRegistry()
+    for s in snaps:
+        reg.merge(s)
+    return reg
+
+
+def test_counters_sum_and_gauges_lww():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("c_total").inc(3)
+    b.counter("c_total").inc(4)
+    a.gauge("g").set(10, ts=100.0)
+    b.gauge("g").set(20, ts=50.0)  # older write must lose
+    m = merged(a.snapshot(), b.snapshot())
+    assert m.counter("c_total").value == 7
+    assert m.gauge("g").value == 10  # newest ts wins regardless of order
+    m2 = merged(b.snapshot(), a.snapshot())
+    assert m2.gauge("g").value == 10
+
+
+def test_histograms_add_bucketwise():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    for v in (0.05, 0.5):
+        a.histogram("h_seconds", "", (0.1, 1.0)).observe(v)
+    for v in (0.5, 5.0):
+        b.histogram("h_seconds", "", (0.1, 1.0)).observe(v)
+    m = merged(a.snapshot(), b.snapshot())
+    h = next(iter(m.histograms("h_seconds").values()))
+    assert h.count == 4 and abs(h.sum - 6.05) < 1e-9
+    assert h.bucket_counts == [1, 2, 1]
+
+
+def test_bucket_bounds_mismatch_is_an_error():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.histogram("h_seconds", "", (0.1, 1.0)).observe(0.5)
+    b.histogram("h_seconds", "", (0.2, 2.0)).observe(0.5)
+    m = MetricsRegistry()
+    m.merge(a.snapshot())
+    with pytest.raises(ValueError, match="bounds mismatch"):
+        m.merge(b.snapshot())
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+def test_merge_is_associative_and_commutative(seed):
+    """Property-style: for random registries A, B, C every merge order and
+    every tree shape yields the identical exposition state."""
+    rng = random.Random(seed)
+    snaps = [
+        _random_registry(seed * 10 + i).snapshot() for i in range(3)
+    ]
+    a, b, c = snaps
+    flat = _exposition_series(merged(a, b, c))
+    # commutativity: all permutations
+    for perm in ((a, c, b), (b, a, c), (b, c, a), (c, a, b), (c, b, a)):
+        assert _exposition_series(merged(*perm)) == flat
+    # associativity: (A+B)+C == A+(B+C) via partial-merge snapshots
+    left = merged(merged(a, b).snapshot(), c)
+    right = merged(a, merged(b, c).snapshot())
+    assert _exposition_series(left) == flat
+    assert _exposition_series(right) == flat
+    # idempotent shape: re-snapshotting a merged registry loses nothing
+    assert _exposition_series(merged(merged(a, b, c).snapshot())) == flat
+    del rng
+
+
+def _rank_stream(rank: int, n: int) -> list:
+    rng = random.Random(rank)
+    t = 1000.0 * (rank + 1)
+    recs = []
+    for i in range(n):
+        t += rng.random()
+        recs.append({"kind": "iteration_start", "iteration": i, "ts": t,
+                     "pid": 100 + rank, "rank": rank})
+        if rng.random() < 0.3:
+            recs.append({"kind": "worker_failed", "ts": t, "pid": 100 + rank})
+        if rng.random() < 0.3:
+            recs.append({"kind": "span_end", "span": "rendezvous.round",
+                         "duration_s": rng.random(), "ts": t, "pid": 100 + rank})
+    return recs
+
+
+def test_tree_merged_rank_snapshots_equal_flat_aggregate():
+    """The ISSUE's parity criterion: per-rank registries (what each rank's
+    MetricsPublisher pushes), merged as a tree, must equal the flat
+    ``aggregate()`` of the concatenated event stream — counters and
+    histogram buckets identical."""
+    streams = {r: _rank_stream(r, 25) for r in range(4)}
+    # per-rank live registries (what each rank pushes)
+    rank_snaps = []
+    for r, recs in streams.items():
+        reg = MetricsRegistry()
+        for rec in recs:
+            observe_record(rec, reg)
+        rank_snaps.append(reg.snapshot())
+    # tree: ((r0+r1) + (r2+r3))
+    tree = merged(
+        merged(rank_snaps[0], rank_snaps[1]).snapshot(),
+        merged(rank_snaps[2], rank_snaps[3]).snapshot(),
+    )
+    # flat post-hoc aggregation of the combined stream
+    flat_reg = aggregate([rec for recs in streams.values() for rec in recs])
+    tree_series = _exposition_series(tree)
+    flat_series = _exposition_series(flat_reg)
+    # Gauges carry live wall-clock write stamps; drop them (LWW across
+    # processes is a freshness rule, not a replay-stable value) and compare
+    # every counter and histogram exactly.
+    tree_cmp = {k: v for k, v in tree_series.items() if v[0] != "gauge"}
+    flat_cmp = {k: v for k, v in flat_series.items() if v[0] != "gauge"}
+    assert tree_cmp == flat_cmp
+    # The step histogram specifically: bucket-identical.
+    th = next(iter(tree.histograms("tpu_step_seconds").values()))
+    fh = next(iter(flat_reg.histograms("tpu_step_seconds").values()))
+    assert th.bucket_counts == fh.bucket_counts and th.count == fh.count
+    assert th.bounds == tuple(fh.bounds)
+
+
+def test_merge_rejects_garbage():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.merge({"not": "a snapshot"})
+    # Tolerates a pre-merge-format histogram entry (no buckets): skipped,
+    # not crashed.
+    reg.merge({"ts": 0, "metrics": {
+        "h_seconds": [{"type": "histogram", "labels": {}, "count": 3, "sum": 1.0}],
+        "c_total": [{"type": "counter", "labels": {}, "value": 2}],
+    }})
+    assert reg.counter("c_total").value == 2
+    assert not reg.histograms("h_seconds")
+
+
+def test_default_buckets_roundtrip_through_json():
+    """Bounds survive a JSON round-trip (floats stay equal) so merging a
+    store-transported snapshot never false-positives the mismatch check."""
+    import json
+
+    reg = MetricsRegistry()
+    reg.histogram("h_seconds").observe(0.3)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    m = MetricsRegistry()
+    m.merge(snap)
+    h = next(iter(m.histograms("h_seconds").values()))
+    assert h.bounds == DEFAULT_BUCKETS and h.count == 1

@@ -78,6 +78,17 @@ class AgentConfig:
     #: workers as $TPU_RESILIENCY_FLIGHT_DIR so every rank keeps a
     #: crash-surviving ring of its last events.
     incidents_dir: str = ""
+    #: None disables the live telemetry endpoint (``launcher/telemetry.py``);
+    #: 0 binds an ephemeral port (the bound port lands in
+    #: ``<run_dir>/telemetry.port`` — the port-file handshake). Enabling it
+    #: also exports $TPU_RESILIENCY_METRICS_PUSH to workers so every rank
+    #: publishes its metrics snapshot up the coordination store for the
+    #: merged job-level /metrics view.
+    telemetry_port: Optional[int] = None
+    #: store key prefix the ranks publish metrics snapshots under (namespaced
+    #: by --rdzv-id at the CLI so jobs sharing a store endpoint never merge
+    #: each other's metrics)
+    metrics_push_prefix: str = "jobmetrics/default/"
 
     def __post_init__(self):
         if not self.node_id:
@@ -124,6 +135,12 @@ class ElasticAgent:
         #: set by restart watchers so spare/completion waits wake on a peer's
         #: restart request instead of sleeping out their poll tick
         self._wake = threading.Event()
+        #: the health decision /healthz reflects: True while the last round's
+        #: workers were healthy, False from a worker failure until the
+        #: replacement round's workers spawn
+        self._healthy = True
+        self.telemetry = None
+        self._metrics_store = None
         self.incidents: Optional["IncidentEngine"] = None
         if cfg.incidents_dir:
             from tpu_resiliency.launcher.incident import IncidentEngine
@@ -141,6 +158,49 @@ class ElasticAgent:
         if self._wake.wait(timeout):
             self._wake.clear()
 
+    # -- telemetry ---------------------------------------------------------
+
+    def _start_telemetry(self) -> None:
+        from tpu_resiliency.launcher.telemetry import PORT_FILE_NAME, TelemetryServer
+        from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore
+        from tpu_resiliency.utils.events import EVENTS_FILE_ENV
+
+        # A dedicated store client for the snapshot pull: the server thread
+        # must not share the agent's coordination connection.
+        self._metrics_store = CoordStore(
+            self.cfg.store_host, self.cfg.store_port,
+            prefix=self.cfg.metrics_push_prefix, timeout=10.0,
+            auth_key=os.environ.get(AUTH_KEY_ENV) or None,
+        )
+        store = self._metrics_store
+
+        def fetch_snapshots() -> list:
+            return [v for v in store.prefix_get("").values() if isinstance(v, dict)]
+
+        self.telemetry = TelemetryServer(
+            port=self.cfg.telemetry_port or 0,
+            port_file=os.path.join(self.cfg.run_dir, PORT_FILE_NAME),
+            events_file=os.environ.get(EVENTS_FILE_ENV) or None,
+            fetch_snapshots=fetch_snapshots,
+            health_fn=self.health,
+        )
+        self.telemetry.start()
+
+    def health(self) -> dict:
+        """The /healthz document: this agent's current health decision."""
+        budget_ok = self._restarts_used <= self.cfg.max_restarts
+        doc = {
+            "healthy": bool(self._healthy and budget_ok),
+            "node_id": self.cfg.node_id,
+            "workers_healthy": bool(self._healthy),
+            "restarts_used": self._restarts_used,
+            "max_restarts": self.cfg.max_restarts,
+            "restart_budget_ok": budget_ok,
+        }
+        if self.incidents is not None:
+            doc["incident_open"] = bool(self.incidents.is_open)
+        return doc
+
     # -- lifecycle ---------------------------------------------------------
 
     def run(self) -> dict[int, int]:
@@ -149,6 +209,8 @@ class ElasticAgent:
         os.makedirs(self.cfg.run_dir, exist_ok=True)
         self._ipc = ipc.IpcReceiver(self._launcher_socket)
         self._ipc.start()
+        if self.cfg.telemetry_port is not None:
+            self._start_telemetry()
         self.restarter.initialize()
         prev_round = -1
         try:
@@ -249,6 +311,18 @@ class ElasticAgent:
                 self._ipc.stop()
             if self._spare_pool is not None:
                 self._spare_pool.close()
+            if self.telemetry is not None:
+                try:
+                    self.telemetry.stop()
+                except Exception:
+                    pass
+                self.telemetry = None
+            if self._metrics_store is not None:
+                try:
+                    self._metrics_store.close()
+                except Exception:
+                    pass
+                self._metrics_store = None
 
     # -- spare path --------------------------------------------------------
 
@@ -345,6 +419,15 @@ class ElasticAgent:
             # whatever the env held when the launcher started.
             **child_env(),
         }
+        if self.telemetry is not None:
+            from tpu_resiliency.utils.events import METRICS_PUSH_ENV
+
+            # Each rank publishes its metrics snapshot up the coordination
+            # store (utils/metrics.py:MetricsPublisher); the telemetry
+            # server's /metrics merges the published set into the job view.
+            base_env[METRICS_PUSH_ENV] = (
+                f"{cfg.store_host}:{cfg.store_port}:{cfg.metrics_push_prefix}"
+            )
         group = WorkerGroup(
             argv=cfg.argv,
             nproc=cfg.nproc_per_node,
@@ -402,6 +485,7 @@ class ElasticAgent:
         cfg = self.cfg
         epoch0 = outcome.epoch
         i_am_leader = outcome.node_rank == 0
+        self._healthy = True  # this round's workers are up: /healthz recovers
         self.rdzv.set_health(True)
         while True:
             # Event-driven: a worker exit wakes this immediately (ms detection
@@ -468,6 +552,7 @@ class ElasticAgent:
 
     def _handle_failure(self, group: WorkerGroup, outcome: RendezvousOutcome) -> str:
         cfg = self.cfg
+        self._healthy = False  # /healthz reports 503 until the next round spawns
         failures = group.failures()
         for f in failures:
             log.error(f"[{cfg.node_id}] worker failed: {f.describe()}")

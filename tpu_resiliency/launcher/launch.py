@@ -141,6 +141,16 @@ def build_parser() -> argparse.ArgumentParser:
         "$TPU_RESILIENCY_FLIGHT_DIR); render artifacts with "
         "tpu-incident-report",
     )
+    p.add_argument(
+        "--telemetry-port",
+        type=int,
+        default=None,
+        help="serve live job telemetry from the agent: /metrics (merged "
+        "job-level Prometheus view from rank-pushed snapshots), /goodput "
+        "(time-attribution ledger), /healthz (agent health decision). "
+        "0 binds an ephemeral port; the bound port is written to "
+        "<run-dir>/telemetry.port (omit the flag to disable)",
+    )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
     p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
@@ -395,6 +405,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         incidents_dir=(
             os.path.abspath(args.incidents_dir) if args.incidents_dir else ""
         ),
+        telemetry_port=args.telemetry_port,
+        # rdzv-id namespacing keeps two jobs on one store endpoint from
+        # merging each other's metrics snapshots into their /metrics views.
+        metrics_push_prefix=f"jobmetrics/{args.rdzv_id}/",
     )
     agent = ElasticAgent(cfg, ft_cfg, store)
     try:

@@ -1,0 +1,255 @@
+"""Live telemetry endpoint on the launcher agent.
+
+Everything the observability plane records was, until this module, offline:
+metrics lived in per-rank files and every CLI replayed a finished JSONL. The
+:class:`TelemetryServer` turns the launcher into the job's scrape target — a
+stdlib ``http.server`` thread on an ephemeral port with a port-file handshake
+(no port flag coordination; a sidecar reads ``telemetry.port`` out of the run
+dir), serving three endpoints:
+
+- ``GET /metrics`` — the **merged job-level** Prometheus view: the launcher's
+  own registry folded together with every rank-published snapshot from the
+  coordination store (``utils/metrics.py:MetricsPublisher`` push path +
+  ``MetricsRegistry.merge``). One scrape answers for N ranks; no scraper ever
+  opens a rank's files.
+- ``GET /goodput`` — the goodput ledger's attribution document as JSON
+  (``utils/goodput.py``): wall clock classified into train / ckpt_stall /
+  restart / incident / unattributed, goodput ratio, per-rank rows. The ledger
+  tails the shared events JSONL incrementally, so a scrape costs only the
+  bytes appended since the last one.
+- ``GET /healthz`` — the agent's current health decision as JSON; HTTP 200
+  when healthy, 503 when not (load-balancer / watchdog friendly).
+
+Each ``/metrics`` or ``/goodput`` request also refreshes the ledger and
+publishes attribution deltas back through the event stream
+(``goodput_update`` → ``tpu_time_attributed_seconds_total{phase}`` /
+``tpu_goodput_ratio``), so the Prometheus view and the post-hoc
+``tpu-metrics-dump`` aggregation of the same JSONL stay in parity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from tpu_resiliency.utils import events as events_mod
+from tpu_resiliency.utils.goodput import GoodputLedger
+from tpu_resiliency.utils.logging import get_logger
+from tpu_resiliency.utils.metrics import MetricsRegistry, MetricsSink, observe_record
+
+log = get_logger(__name__)
+
+#: default name of the port-file handshake inside the launcher's run dir
+PORT_FILE_NAME = "telemetry.port"
+
+
+class TelemetryServer:
+    """Threaded HTTP endpoint serving /metrics, /goodput, /healthz.
+
+    ``fetch_snapshots`` returns the rank-published snapshot documents (the
+    agent wires a store prefix scan); ``health_fn`` returns the health
+    document (``{"healthy": bool, ...}``). Both are optional — without them
+    the server still serves the launcher-local registry and the ledger.
+    """
+
+    def __init__(
+        self,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        port_file: Optional[str] = None,
+        events_file: Optional[str] = None,
+        registry: Optional[MetricsRegistry] = None,
+        fetch_snapshots: Optional[Callable[[], list]] = None,
+        health_fn: Optional[Callable[[], dict]] = None,
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.ledger = GoodputLedger()
+        self._host = host
+        self._want_port = port
+        self.port_file = port_file
+        self.events_file = events_file
+        self.fetch_snapshots = fetch_snapshots
+        self.health_fn = health_fn
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        #: byte offset of the last complete line consumed from events_file
+        self._offset = 0
+        self._refresh_lock = threading.Lock()
+        self._sink: Optional[MetricsSink] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> int:
+        """Bind, write the port file, start serving. Returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # no stderr chatter
+                log.debug(f"telemetry: {fmt % args}")
+
+            def do_GET(self):
+                try:
+                    server._handle(self)
+                except BrokenPipeError:
+                    pass
+                except Exception:
+                    log.debug("telemetry request failed", exc_info=True)
+                    try:
+                        self.send_error(500)
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-http", daemon=True
+        )
+        self._thread.start()
+        # The launcher's own events feed the local half of the merged view.
+        self._sink = MetricsSink(self.registry)
+        events_mod.add_sink(self._sink)
+        port = self._httpd.server_address[1]
+        if self.port_file:
+            d = os.path.dirname(self.port_file)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{self.port_file}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(f"{port}\n")
+            os.replace(tmp, self.port_file)
+        log.info(f"telemetry endpoint on http://{self._host}:{port} "
+                 f"(/metrics /goodput /healthz)")
+        return port
+
+    def stop(self) -> None:
+        if self._sink is not None:
+            events_mod.remove_sink(self._sink)
+            self._sink = None
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.port_file:
+            try:
+                os.unlink(self.port_file)
+            except OSError:
+                pass
+
+    # -- request handling ---------------------------------------------------
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        if path == "/metrics":
+            self.refresh()
+            body = self.merged_registry().to_prometheus().encode()
+            self._respond(req, 200, body, "text/plain; version=0.0.4")
+        elif path == "/goodput":
+            summary = self.refresh()
+            self._respond(req, 200, _json_body(summary), "application/json")
+        elif path == "/healthz":
+            doc = {"healthy": True}
+            if self.health_fn is not None:
+                try:
+                    doc = dict(self.health_fn())
+                except Exception as e:
+                    doc = {"healthy": False, "error": repr(e)}
+            status = 200 if doc.get("healthy") else 503
+            self._respond(req, status, _json_body(doc), "application/json")
+        else:
+            self._respond(
+                req, 404,
+                _json_body({"error": f"unknown path {path!r}",
+                            "endpoints": ["/metrics", "/goodput", "/healthz"]}),
+                "application/json",
+            )
+
+    @staticmethod
+    def _respond(
+        req: BaseHTTPRequestHandler, status: int, body: bytes, ctype: str
+    ) -> None:
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    # -- data assembly ------------------------------------------------------
+
+    def refresh(self) -> dict:
+        """Feed the ledger the events appended since the last refresh, then
+        publish attribution deltas through the event stream (which lands in
+        the local registry via the attached sink AND in the shared JSONL for
+        post-hoc parity). Returns the current summary."""
+        with self._refresh_lock:
+            for rec in self._read_new_events():
+                self.ledger.observe(rec)
+            return self.ledger.publish()
+
+    def _read_new_events(self) -> list[dict]:
+        if not self.events_file:
+            return []
+        out: list[dict] = []
+        try:
+            with open(self.events_file, "rb") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+        except OSError:
+            return []
+        if not chunk:
+            return []
+        # Only complete lines advance the offset: a torn trailing line is
+        # re-read whole on the next refresh.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        self._offset += end + 1
+        for line in chunk[: end + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def merged_registry(self) -> MetricsRegistry:
+        """Fold the launcher-local registry and every rank-published snapshot
+        into one fresh job-level registry (the /metrics body)."""
+        merged = MetricsRegistry()
+        merged.merge(self.registry.snapshot())
+        if self.fetch_snapshots is not None:
+            try:
+                snapshots = self.fetch_snapshots() or []
+            except Exception:
+                log.debug("snapshot fetch failed", exc_info=True)
+                snapshots = []
+            for snap in snapshots:
+                try:
+                    merged.merge(snap)
+                except (ValueError, TypeError):
+                    log.debug("skipping unmergeable snapshot", exc_info=True)
+        return merged
+
+    def observe(self, rec: dict) -> None:
+        """Feed one flat record straight into local registry + ledger (tests
+        and embedders without an events file)."""
+        observe_record(rec, self.registry)
+        self.ledger.observe(rec)
+
+
+def _json_body(doc: dict) -> bytes:
+    return (json.dumps(doc, indent=2) + "\n").encode()

@@ -12,6 +12,13 @@ Usage::
     python -m tpu_resiliency.tools.metrics_dump run_events.jsonl            # report
     python -m tpu_resiliency.tools.metrics_dump run_events.jsonl --format prom
     python -m tpu_resiliency.tools.metrics_dump run_events.jsonl --format json -o m.json
+    python -m tpu_resiliency.tools.metrics_dump run_events.jsonl --goodput  # attribution
+
+``--goodput`` renders the time-attribution ledger (``utils/goodput.py``)
+instead of the metrics report: wall clock classified into train / ckpt_stall /
+restart / incident / unattributed, the goodput ratio, and per-rank rows — the
+offline twin of the launcher's live ``/goodput`` endpoint, computed from the
+same stream by the same ledger.
 """
 
 from __future__ import annotations
@@ -89,6 +96,17 @@ def render_report(reg: MetricsRegistry, out=None) -> None:
         for line in timing_lines:
             print(line, file=out)
 
+    # Step timing (tpu_step_seconds: consecutive iteration_start deltas).
+    step_hists = reg.histograms("tpu_step_seconds")
+    if step_hists:
+        h = next(iter(step_hists.values()))
+        if h.count:
+            print(
+                f"training steps: n={h.count} p50={_fmt_s(h.quantile(0.5))} "
+                f"p95={_fmt_s(h.quantile(0.95))}",
+                file=out,
+            )
+
     # The two headline latencies, called out by name so a fleet dashboard's
     # first question needs no knowledge of span naming conventions.
     rdzv = reg.histograms("tpu_span_seconds").get((("span", "rendezvous.round"),))
@@ -127,6 +145,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "-o", "--output", default=None,
         help="write here instead of stdout (json format: atomic write)",
     )
+    ap.add_argument(
+        "--goodput", action="store_true",
+        help="render the time-attribution ledger (train/ckpt_stall/restart/"
+        "incident/unattributed + goodput ratio) instead of the metrics "
+        "report; --format json emits the same attribution document the "
+        "launcher's live /goodput endpoint serves",
+    )
     args = ap.parse_args(argv)
     try:
         with open(args.events_file):
@@ -138,6 +163,32 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not records:
         print("no events to aggregate", file=sys.stderr)
         return 1
+    if args.goodput:
+        from tpu_resiliency.utils.goodput import GoodputLedger, render_table
+
+        ledger = GoodputLedger()
+        ledger.observe_many(records)
+        summary = ledger.summary()
+
+        def emit_goodput() -> None:
+            if args.format == "json":
+                json.dump(summary, sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                render_table(summary)
+
+        if args.output:
+            with open(args.output, "w") as f:
+                old, sys.stdout = sys.stdout, f
+                try:
+                    emit_goodput()
+                finally:
+                    sys.stdout = old
+            print(f"wrote {args.output}")
+            return 0
+        if pipe_safe(emit_goodput):
+            return SIGPIPE_EXIT
+        return 0
     reg = aggregate(records)
     if args.format == "json" and args.output:
         reg.write_json(args.output)

@@ -73,6 +73,12 @@ METRICS_FILE_ENV = "TPU_RESILIENCY_METRICS_FILE"
 #: plane's last-seconds-before-death record, persisted continuously so even a
 #: SIGKILL leaves a dump behind.
 FLIGHT_DIR_ENV = "TPU_RESILIENCY_FLIGHT_DIR"
+#: Set to ``host:port[:prefix]`` to ALSO publish this process's metrics
+#: snapshot to the coordination store every few seconds
+#: (``utils/metrics.py:MetricsPublisher``) — the goodput plane's push path:
+#: the launcher's telemetry endpoint merges the published snapshots into one
+#: job-level view instead of scraping every rank's files.
+METRICS_PUSH_ENV = "TPU_RESILIENCY_METRICS_PUSH"
 
 #: Envelope keys every JSONL record carries; payload keys that collide are
 #: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary, trace_export)
@@ -197,14 +203,16 @@ def remove_sink(sink: Callable[[Event], None]) -> None:
 def clear_sinks() -> None:
     with _sinks_lock:
         _sinks.clear()
-    global _env_wired_for, _metrics_wired_for, _flight_wired_for
+    global _env_wired_for, _metrics_wired_for, _flight_wired_for, _push_wired_for
     _env_wired_for = None
     _metrics_wired_for = None
     _flight_wired_for = None
+    _push_wired_for = None
 
 
 _metrics_wired_for: Optional[str] = None
 _flight_wired_for: Optional[str] = None
+_push_wired_for: Optional[str] = None
 
 
 def _wire_env_sink() -> None:
@@ -214,7 +222,7 @@ def _wire_env_sink() -> None:
     still takes effect, and forked/spawned children wire themselves lazily.
     The flight recorder named by $TPU_RESILIENCY_FLIGHT_DIR rides the same
     lazy wiring (flight_recorder.install registers itself as a sink)."""
-    global _env_wired_for, _metrics_wired_for, _flight_wired_for
+    global _env_wired_for, _metrics_wired_for, _flight_wired_for, _push_wired_for
     path = os.environ.get(EVENTS_FILE_ENV)
     if path and path != _env_wired_for:
         with _sinks_lock:
@@ -241,6 +249,19 @@ def _wire_env_sink() -> None:
                 except Exception as e:
                     log.warning(f"cannot wire metrics snapshots to {mpath!r}: {e}")
                 _metrics_wired_for = mpath
+    ppath = os.environ.get(METRICS_PUSH_ENV)
+    if ppath and ppath != _push_wired_for:
+        with _sinks_lock:
+            if _push_wired_for != ppath:
+                try:
+                    # Lazy import, same reason as the metrics bridge: events
+                    # stays the dependency root.
+                    from tpu_resiliency.utils.metrics import MetricsPublisher
+
+                    _sinks.append(MetricsPublisher.from_env_spec(ppath))
+                except Exception as e:
+                    log.warning(f"cannot wire metrics push to {ppath!r}: {e}")
+                _push_wired_for = ppath
     fpath = os.environ.get(FLIGHT_DIR_ENV)
     if fpath and fpath != _flight_wired_for:
         try:

@@ -28,9 +28,12 @@ import random
 import re
 import threading
 import time
-from typing import Any, Iterable, Optional
+from typing import Any, Iterable, Optional, Sequence
 
 from tpu_resiliency.utils.events import RESERVED_KEYS
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
 
 #: Prometheus histogram bucket upper bounds (seconds) tuned for restart
 #: machinery: sub-ms store ops up through multi-minute rendezvous holds.
@@ -57,6 +60,18 @@ FOREGROUND_BUCKETS_S = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+#: Bucket bounds (seconds) for training-step wall clock (``tpu_step_seconds``):
+#: toy CPU loops (ms) up through big-model steps (minutes).
+STEP_BUCKETS_S = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+#: An ``iteration_start`` delta larger than this is not a step — it's a gap
+#: (hang, restart, operator pause) and must not pollute the step histogram or
+#: the goodput ledger's ``train`` attribution (``utils/goodput.py`` shares it).
+STEP_GAP_MAX_S = 300.0
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
@@ -88,22 +103,38 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins instantaneous value."""
+    """Last-write-wins instantaneous value.
+
+    Each write stamps ``ts`` (wall clock) so cross-registry merges can keep
+    last-writer-wins semantics: :meth:`merge_lww` takes the (ts, value) pair
+    with the larger timestamp, value-tiebroken — a commutative, associative
+    rule, so a tree of partial merges equals the flat merge.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._value = 0.0
+        self.ts = 0.0
 
-    def set(self, v: float) -> None:
+    def set(self, v: float, ts: Optional[float] = None) -> None:
         with self._lock:
             self._value = float(v)
+            self.ts = time.time() if ts is None else float(ts)
 
     def inc(self, n: float = 1.0) -> None:
         with self._lock:
             self._value += n
+            self.ts = time.time()
 
     def dec(self, n: float = 1.0) -> None:
         self.inc(-n)
+
+    def merge_lww(self, v: float, ts: float) -> None:
+        """Adopt ``(v, ts)`` iff it out-ranks the current write."""
+        with self._lock:
+            if (float(ts), float(v)) > (self.ts, self._value):
+                self._value = float(v)
+                self.ts = float(ts)
 
     @property
     def value(self) -> float:
@@ -153,6 +184,52 @@ class Histogram:
         idx = min(len(ordered) - 1, max(0, math.ceil(q * len(ordered)) - 1))
         return ordered[idx]
 
+    def merge_counts(
+        self, counts: Sequence[int], count: float, total: float
+    ) -> None:
+        """Bucket-wise add another histogram's state (same bounds required).
+
+        The reservoir is NOT merged — a merged histogram answers exposition
+        (buckets/count/sum) exactly; quantiles stay with the per-process
+        registries that observed the raw samples."""
+        counts = list(counts)
+        if len(counts) != len(self.bucket_counts):
+            raise ValueError(
+                f"bucket count mismatch: {len(counts)} != "
+                f"{len(self.bucket_counts)}"
+            )
+        with self._lock:
+            for i, n in enumerate(counts):
+                self.bucket_counts[i] += int(n)
+            self.count += int(count)
+            self.sum += float(total)
+
+
+def _plain_json(value: Any) -> Any:
+    """Restrict a value tree to plain, strict-JSON types.
+
+    Non-finite floats become ``None`` (``NaN``/``Infinity`` are not JSON and
+    don't round-trip), numeric-coercible scalars (numpy, Decimal, ...) are
+    coerced to ``float``, and anything else is dropped to ``None`` with a
+    warning — so a snapshot consumer (``merge``, a dashboard, a scraper)
+    never meets a ``repr``-stringified object where a number belongs."""
+    if value is None or isinstance(value, (str, bool, int)):
+        return value
+    if isinstance(value, float):
+        return value if math.isfinite(value) else None
+    if isinstance(value, dict):
+        return {str(k): _plain_json(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_plain_json(v) for v in value]
+    try:
+        f = float(value)
+        return f if math.isfinite(f) else None
+    except (TypeError, ValueError):
+        log.warning(
+            f"dropping non-JSON value {type(value).__name__} from metrics snapshot"
+        )
+        return None
+
 
 class MetricsRegistry:
     """Name+labels → metric instance; the creation call is the lookup call.
@@ -169,6 +246,19 @@ class MetricsRegistry:
         self._families: dict[str, tuple[str, str]] = {}
         #: (name, labels_tuple) -> metric
         self._series: dict[tuple, Any] = {}
+        #: scratch space for stateful bridge mappings (see :meth:`aux_state`)
+        self._aux: dict[str, dict] = {}
+
+    def aux_state(self, key: str) -> dict:
+        """Per-registry scratch dict for stateful event→metric mappings.
+
+        ``observe_record`` is mostly stateless, but some derivations need
+        memory (e.g. ``tpu_step_seconds`` = delta between consecutive
+        ``iteration_start`` records of one pid). Keeping that state ON the
+        registry — not module-global — preserves live/post-hoc parity: the
+        live sink and a fresh ``aggregate()`` replay each carry their own."""
+        with self._lock:
+            return self._aux.setdefault(key, {})
 
     def _get(self, kind: str, ctor, name: str, help: str, labels: dict):
         name = _sanitize(name)
@@ -216,8 +306,20 @@ class MetricsRegistry:
     # -- rendering ---------------------------------------------------------
 
     @staticmethod
-    def _label_str(labels: tuple, extra: str = "") -> str:
-        parts = [f'{k}="{v}"' for k, v in labels]
+    def _escape_label_value(v: str) -> str:
+        """Prometheus text format 0.0.4 label-value escaping: backslash,
+        double-quote, and line-feed — an unescaped peer address or file path
+        must never produce unparseable exposition text."""
+        return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+    @staticmethod
+    def _escape_help(v: str) -> str:
+        """HELP text escaping per 0.0.4: backslash and line-feed only."""
+        return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+    @classmethod
+    def _label_str(cls, labels: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{cls._escape_label_value(str(v))}"' for k, v in labels]
         if extra:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
@@ -239,7 +341,7 @@ class MetricsRegistry:
         for name in sorted(families):
             kind, help = families[name]
             if help:
-                lines.append(f"# HELP {name} {help}")
+                lines.append(f"# HELP {name} {self._escape_help(help)}")
             lines.append(f"# TYPE {name} {kind}")
             for (sname, labels), m in sorted(series.items()):
                 if sname != name:
@@ -266,16 +368,24 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """JSON-serializable state: counters/gauges by series, histograms with
-        count/sum/quantiles (the operator's one-call answer, no PromQL needed)."""
+        count/sum/quantiles AND raw buckets (the operator's one-call answer, no
+        PromQL needed — and :meth:`merge`'s input format).
+
+        Every value is a plain JSON type: non-finite floats become ``null``
+        and anything non-coercible is dropped with a warning, so a snapshot
+        round-trips through strict JSON and ``merge`` can trust its input."""
         with self._lock:
             families = dict(self._families)
             series = dict(self._series)
         out: dict = {"ts": time.time(), "metrics": {}}
         for (name, labels), m in sorted(series.items()):
             kind, help = families[name]
-            entry: dict = {"type": kind, "labels": dict(labels)}
-            if isinstance(m, (Counter, Gauge)):
+            entry: dict = {"type": kind, "labels": dict(labels), "help": help}
+            if isinstance(m, Counter):
                 entry["value"] = m.value
+            elif isinstance(m, Gauge):
+                entry["value"] = m.value
+                entry["ts"] = m.ts
             else:
                 entry.update(
                     count=m.count,
@@ -284,19 +394,85 @@ class MetricsRegistry:
                     p90=m.quantile(0.90),
                     p95=m.quantile(0.95),
                     p99=m.quantile(0.99),
+                    buckets={
+                        "bounds": list(m.bounds),
+                        "counts": list(m.bucket_counts),
+                    },
                 )
             out["metrics"].setdefault(name, []).append(entry)
-        return out
+        return _plain_json(out)
+
+    def merge(self, snapshot: dict) -> None:
+        """Fold one :meth:`snapshot` document into this registry.
+
+        The merge algebra (what makes a tree of partial merges equal the flat
+        merge — associative AND commutative):
+
+        - **counters** sum;
+        - **gauges** are last-writer-wins by each entry's ``ts`` (value
+          tie-break — see :meth:`Gauge.merge_lww`);
+        - **histograms** add bucket-wise (bounds must match; count and sum
+          add; quantile reservoirs are not transported — buckets are the
+          merged truth).
+
+        This is the aggregation step of the push path: every rank publishes
+        its snapshot up the store topology and any node can fold the set —
+        or a subtree's partial fold — into one job-level registry without
+        ever touching another rank's files.
+        """
+        metrics = snapshot.get("metrics") if isinstance(snapshot, dict) else None
+        if not isinstance(metrics, dict):
+            raise ValueError("not a metrics snapshot (missing 'metrics' dict)")
+        default_ts = snapshot.get("ts")
+        if not isinstance(default_ts, (int, float)):
+            default_ts = 0.0
+        for name, entries in sorted(metrics.items()):
+            if not isinstance(entries, list):
+                continue
+            for e in entries:
+                if not isinstance(e, dict):
+                    continue
+                kind = e.get("type")
+                labels = {
+                    str(k): str(v)
+                    for k, v in (e.get("labels") or {}).items()
+                }
+                help = e.get("help") or ""
+                if kind == "counter":
+                    v = e.get("value")
+                    if isinstance(v, (int, float)) and v > 0:
+                        self.counter(name, help, **labels).inc(v)
+                elif kind == "gauge":
+                    v = e.get("value")
+                    ts = e.get("ts")
+                    if isinstance(v, (int, float)):
+                        self.gauge(name, help, **labels).merge_lww(
+                            v, ts if isinstance(ts, (int, float)) else default_ts
+                        )
+                elif kind == "histogram":
+                    b = e.get("buckets") or {}
+                    bounds = tuple(b.get("bounds") or ())
+                    counts = b.get("counts") or []
+                    if not bounds or len(counts) != len(bounds) + 1:
+                        continue  # pre-merge-format snapshot: not mergeable
+                    h = self.histogram(name, help, bounds, **labels)
+                    if h.bounds != bounds:
+                        raise ValueError(
+                            f"histogram {name!r}: bucket bounds mismatch "
+                            f"({h.bounds} != {bounds})"
+                        )
+                    h.merge_counts(counts, e.get("count") or 0, e.get("sum") or 0.0)
 
     def write_json(self, path: str) -> None:
         """Atomic snapshot-to-file (tmp + rename): a scraper reading the path
-        mid-write never sees a torn document."""
+        mid-write never sees a torn document. The document is strict JSON
+        (``snapshot`` already coerced or dropped anything that isn't)."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
-            json.dump(self.snapshot(), f, indent=2, default=repr)
+            json.dump(self.snapshot(), f, indent=2, allow_nan=False)
             f.write("\n")
         os.replace(tmp, path)
 
@@ -334,6 +510,48 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             reg.gauge("tpu_rendezvous_round", "last rendezvous round").set(
                 rec["round"]
             )
+    elif kind == "iteration_start":
+        # Stateful derivation: a step's wall clock is the delta between this
+        # rank's consecutive iteration_start markers. State lives on the
+        # registry (aux_state) so the live sink and a post-hoc aggregate()
+        # replay compute the identical histogram. Only a strictly-consecutive
+        # iteration within the gap cap counts — a repeat after an in-process
+        # restart, or a multi-minute gap, is downtime, not a step.
+        ts, it = rec.get("ts"), rec.get("iteration")
+        if isinstance(ts, (int, float)) and isinstance(it, int):
+            st = reg.aux_state("step_timing")
+            prev = st.get(rec.get("pid"))
+            if (
+                prev is not None and it == prev[1] + 1
+                and 0 < ts - prev[0] <= STEP_GAP_MAX_S
+            ):
+                reg.histogram(
+                    "tpu_step_seconds",
+                    "training step wall clock (consecutive iteration_start "
+                    "deltas per rank)",
+                    STEP_BUCKETS_S,
+                ).observe(ts - prev[0])
+            st[rec.get("pid")] = (ts, it)
+    elif kind == "goodput_update":
+        # Emitted by the goodput ledger (utils/goodput.py) with per-phase
+        # attribution DELTAS since its previous publish, so replaying the
+        # stream reconstructs the same monotonic totals the live sink held.
+        phases = rec.get("phases")
+        if isinstance(phases, dict):
+            for phase, delta in sorted(phases.items()):
+                if isinstance(delta, (int, float)) and delta > 0:
+                    reg.counter(
+                        "tpu_time_attributed_seconds_total",
+                        "job wall clock attributed by the goodput ledger "
+                        "(train | ckpt_stall | restart | incident | "
+                        "unattributed)",
+                        phase=str(phase),
+                    ).inc(delta)
+        if isinstance(rec.get("ratio"), (int, float)):
+            reg.gauge(
+                "tpu_goodput_ratio",
+                "fraction of job wall clock attributed to training",
+            ).set(rec["ratio"])
     elif kind == "restart_requested":
         reg.counter(
             "tpu_restarts_total", "restart rounds by layer", layer="injob"
@@ -614,3 +832,110 @@ class MetricsSink:
             if now - self._last_snapshot >= self.snapshot_interval:
                 self._last_snapshot = now
                 self.registry.write_json(self.json_path)
+
+
+class MetricsPublisher(MetricsSink):
+    """``events.add_sink`` bridge that pushes snapshots up the coordination
+    store instead of (or alongside) dropping files.
+
+    The scale story: a scraper of an N-rank job must not open N per-rank
+    snapshot files. Each rank periodically publishes its registry snapshot to
+    one store key (``<prefix><identity>``) — piggybacked on event arrivals
+    like :class:`MetricsSink`'s file snapshots, so no thread leaks into forked
+    workers — and the launcher's telemetry endpoint folds the key range into
+    one job-level registry with :meth:`MetricsRegistry.merge`. Because the
+    merge is associative/commutative, intermediate nodes of a large store
+    clique can fold subtrees before forwarding (the O(log N) aggregation path
+    ROADMAP item 3 builds toward).
+
+    The identity is ``r<rank>-<pid>`` (``p<pid>`` when rankless): a restarted
+    rank publishes under a NEW key, and the merge sums both incarnations'
+    counters instead of losing the first one to a same-key overwrite.
+
+    A push failure never breaks the workload: errors are contained, and the
+    next attempt waits out ``interval`` like a successful push would.
+    """
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        prefix: str = "jobmetrics/default/",
+        *,
+        registry: Optional[MetricsRegistry] = None,
+        interval: float = 2.0,
+        identity: Optional[str] = None,
+    ):
+        # A PRIVATE registry by default: the publisher must not double-count
+        # events into the process-wide registry another sink already feeds.
+        super().__init__(registry=registry or MetricsRegistry())
+        self._host = host
+        self._port = port
+        self._prefix = prefix
+        self._interval = interval
+        self._store: Any = None
+        self._last_push = 0.0
+        if identity is None:
+            rank_s = os.environ.get("RANK")
+            identity = (
+                f"r{rank_s}-{os.getpid()}"
+                if rank_s and rank_s.isdigit() else f"p{os.getpid()}"
+            )
+        self.identity = identity
+        import atexit
+
+        atexit.register(self._final_push)
+
+    @classmethod
+    def from_env_spec(cls, spec: str) -> "MetricsPublisher":
+        """Parse ``host:port[:prefix]`` (the $TPU_RESILIENCY_METRICS_PUSH
+        value the launcher exports to its workers)."""
+        parts = spec.split(":", 2)
+        if len(parts) < 2 or not parts[1].isdigit():
+            raise ValueError(f"bad metrics-push spec {spec!r}: want host:port[:prefix]")
+        host, port = parts[0] or "127.0.0.1", int(parts[1])
+        prefix = parts[2] if len(parts) == 3 and parts[2] else "jobmetrics/default/"
+        return cls(host, port, prefix)
+
+    def _connect(self):
+        if self._store is None:
+            # Lazy import: metrics must not pull the platform layer in at
+            # module load (events -> metrics stays the dependency root path).
+            from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore
+
+            self._store = CoordStore(
+                self._host, self._port, prefix=self._prefix,
+                timeout=10.0, connect_retries=1, retry_budget=2.0,
+                auth_key=os.environ.get(AUTH_KEY_ENV) or None,
+            )
+        return self._store
+
+    def push(self) -> None:
+        """Publish the current snapshot under this process's identity key."""
+        self._connect().set(self.identity, self.registry.snapshot())
+
+    def _final_push(self) -> None:
+        try:
+            self.push()
+        except Exception:
+            pass  # interpreter exit: the store may already be gone
+
+    def close(self) -> None:
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+            self._store = None
+
+    def __call__(self, event) -> None:
+        super().__call__(event)
+        now = time.monotonic()
+        if now - self._last_push >= self._interval:
+            # Stamp BEFORE attempting: a dead store must not be re-dialed on
+            # every single event (the interval is also the failure backoff).
+            self._last_push = now
+            try:
+                self.push()
+            except Exception:
+                log.debug("metrics snapshot push failed", exc_info=True)
