@@ -1,6 +1,6 @@
 """Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Five scenarios, each asserting the job converges to a CORRECT final state
+Six scenarios, each asserting the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -31,6 +31,13 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   ``tpu_incident_*`` / ``tpu_remediation_actions_total`` metrics aggregate
   from the events stream, and the goodput ledger charges the campaign's
   open→close windows to the ``incident`` phase.
+- **hang**: the forensics chain — a seed-chosen rank wedges in a GIL-holding
+  sleep while its peer blocks in a barrier it never reaches. Convergence =
+  ``/hangz`` names the victim mid-stall (census saved to ``hangz.json``),
+  ``hang_detected`` carries the location beacon, the victim captured a
+  ``stack_dump``, the ``hang_census`` implicates it, and the job restarts to
+  a successful round — with an identical forensics schedule across the two
+  per-seed runs.
 
 Every in-process scenario runs TWICE with the same seed and asserts the two
 injection schedules are identical — the reproducibility contract: a failure
@@ -579,6 +586,166 @@ def scenario_launcher(seed: int, workdir: str, timeout: float = 180.0):
     return injected
 
 
+# -- scenario: hang forensics ------------------------------------------------
+
+_HANG_WORKER = textwrap.dedent(
+    """
+    import importlib, json, os, sys, threading, time
+    from tpu_resiliency.platform.store import CoordStore
+    from tpu_resiliency.utils import location
+    from tpu_resiliency.utils.events import record
+    from tpu_resiliency.watchdog.monitor_client import RankMonitorClient
+
+    inj = importlib.import_module("tpu_resiliency.inprocess.tools.inject_fault")
+    inj.GIL_SLEEP_CHUNK_S = 2.0
+
+    victim = int(sys.argv[1])
+    rank = int(os.environ["RANK"])
+    rnd = int(os.environ["TPU_FT_RESTART_COUNT"])
+
+    client = RankMonitorClient()
+    client.init_workload_monitoring()
+
+    def beats():
+        while True:
+            try:
+                client.send_heartbeat()
+            except Exception:
+                return
+            time.sleep(0.2)
+
+    threading.Thread(target=beats, daemon=True).start()
+    store = CoordStore(
+        os.environ["TPU_RESILIENCY_STORE_HOST"],
+        int(os.environ["TPU_RESILIENCY_STORE_PORT"]), prefix="hangsoak/",
+    )
+    for i in range(2):
+        location.note_step(i)
+        record("inprocess", "iteration_start", iteration=i)
+        store.barrier(f"step-{rnd}-{i}", rank, 2, timeout=60.0)
+
+    if rnd == 0:
+        if rank == victim:
+            client.start_section("step")
+            inj.inject_fault(inj.Fault.GIL_SLEEP, duration=60.0)
+            sys.exit(0)
+        try:
+            store.barrier("stall", rank, 2, timeout=120.0)
+        except Exception:
+            pass
+        time.sleep(120)
+        sys.exit(0)
+    print("recovered in round", rnd)
+    """
+)
+
+
+def scenario_hang(seed: int, workdir: str, timeout: float = 180.0):
+    """Seeded stall -> detection -> stack capture -> kill ladder -> restart.
+
+    The seed picks the victim rank; the schedule compared across the two
+    per-seed runs is the deterministic forensics chain (victim, detection
+    kind, ladder steps, recovery round). The last good ``/hangz`` census is
+    saved to ``<workdir>/hangz.json`` so downstream smoke legs can grep the
+    live view the operator would have seen.
+    """
+    import urllib.request
+
+    os.makedirs(workdir, exist_ok=True)
+    victim = seed % 2
+    script = os.path.join(workdir, "worker.py")
+    with open(script, "w") as f:
+        f.write(_HANG_WORKER)
+    events_file = os.path.join(workdir, "events.jsonl")
+    for stale in (events_file, os.path.join(workdir, "hangz.json")):
+        if os.path.exists(stale):
+            os.unlink(stale)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env.update(
+        JAX_PLATFORMS="cpu",
+        TPU_RESILIENCY_EVENTS_FILE=events_file,
+        PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    run_dir = os.path.join(workdir, "run")
+    out_path = os.path.join(workdir, "launcher.out")
+    cmd = [
+        sys.executable, "-m", "tpu_resiliency.launcher.launch",
+        "--standalone", "--nproc-per-node", "2", "--max-restarts", "2",
+        "--rdzv-last-call", "0.2", "--monitor-interval", "0.1",
+        "--telemetry-port", "0",
+        "--ft-param-initial_rank_heartbeat_timeout", "15",
+        "--ft-param-rank_heartbeat_timeout", "1.0",
+        "--ft-param-workload_check_interval", "0.25",
+        "--ft-param-stack_dump_grace", "5.0",
+        "--run-dir", run_dir,
+        "--incidents-dir", os.path.join(workdir, "incidents"),
+        script, str(victim),
+    ]
+    # File-backed stdio: monitors/workers inherit these fds, so pipes would
+    # deadlock once full and never EOF while any child lives.
+    with open(out_path, "w") as out:
+        proc = subprocess.Popen(
+            cmd, stdout=out, stderr=subprocess.STDOUT, env=env, cwd=workdir
+        )
+    hangz = None
+    try:
+        port_file = os.path.join(run_dir, "telemetry.port")
+        deadline = time.time() + 60
+        while not os.path.exists(port_file) and time.time() < deadline:
+            assert proc.poll() is None, open(out_path).read()[-2000:]
+            time.sleep(0.2)
+        port = int(open(port_file).read().strip())
+        deadline = time.time() + 90
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                doc = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/hangz", timeout=5).read())
+            except OSError:
+                time.sleep(0.2)
+                continue
+            if any(s.get("rank") == victim for s in doc.get("suspects", [])):
+                hangz = doc
+                break
+            time.sleep(0.2)
+        assert hangz is not None, "/hangz never named the seeded victim"
+        with open(os.path.join(workdir, "hangz.json"), "w") as f:
+            json.dump(hangz, f, indent=2)
+        rc = proc.wait(timeout=timeout)
+        assert rc == 0, f"hang chain rc={rc}\n" + open(out_path).read()[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    # -- the deterministic schedule ---------------------------------------
+    evs = read_events(events_file)
+    hangs = [e for e in evs if e.get("kind") == "hang_detected"]
+    assert len(hangs) == 1 and hangs[0].get("global_rank") == victim, hangs
+    assert "last seen in" in hangs[0].get("reason", ""), hangs[0]
+    ladder = tuple(
+        e.get("step") for e in evs
+        if e.get("kind") == "kill_ladder" and e.get("global_rank") == victim
+    )
+    # Two capture paths race inside the victim (monitor long-poll vs SIGUSR1
+    # nudge); under GIL starvation either may be the one that lands before
+    # SIGKILL — any victim capture satisfies the contract.
+    victim_dumped = any(
+        e.get("kind") == "stack_dump" and e.get("rank") == victim
+        for e in evs
+    )
+    assert victim_dumped, "victim never captured a stack dump"
+    census_evs = [e for e in evs if e.get("kind") == "hang_census"]
+    assert census_evs and any(
+        s.get("rank") == victim for s in (census_evs[0].get("suspects") or [])
+    ), census_evs
+    recovered = max(
+        (e.get("round", 0) for e in evs if e.get("kind") == "round_succeeded"),
+        default=None,
+    )
+    assert recovered is not None, "no successful round after the hang"
+    return (victim, ladder, recovered)
+
+
 # -- driver ------------------------------------------------------------------
 
 
@@ -626,6 +793,14 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert m1 == m2, f"mixed schedule not reproducible:\n{m1}\n{m2}"
     out["mixed_injections"] = [list(i) for i in m1]
     out["mixed_workdir"] = mixed_dir
+    # Hang forensics chain (seeded stall -> detection -> capture -> ladder ->
+    # restart), twice per seed: the forensics schedule must reproduce exactly.
+    hang_dir = os.path.join(workdir, f"hang_{seed}")
+    h1 = scenario_hang(seed, hang_dir)
+    h2 = scenario_hang(seed, hang_dir)
+    assert h1 == h2, f"hang schedule not reproducible:\n{h1}\n{h2}"
+    out["hang_schedule"] = [h1[0], list(h1[1]), h1[2]]
+    out["hang_workdir"] = hang_dir
     if with_launcher:
         counts = scenario_launcher(seed, os.path.join(workdir, f"launcher_{seed}"))
         out["launcher_injections"] = {f"{c}.{k}": n for (c, k), n in counts.items()}

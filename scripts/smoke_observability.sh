@@ -126,7 +126,7 @@ python -m tpu_resiliency.launcher.launch \
     "$GP/worker.py" "$GP/stop" "$GP/ckpt" &
 GP_PID=$!
 python - "$GP" <<'PY'
-import json, os, sys, time, urllib.request
+import json, os, sys, time, urllib.error, urllib.request
 
 gp = sys.argv[1]
 port_file = os.path.join(gp, "run", "telemetry.port")
@@ -156,7 +156,11 @@ prom = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).rea
 assert "tpu_goodput_ratio" in prom, prom[:2000]
 assert "tpu_time_attributed_seconds_total" in prom, prom[:2000]
 assert "tpu_step_seconds_bucket" in prom, prom[:2000]
-hz = json.loads(urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+try:
+    hz = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=5).read())
+except urllib.error.HTTPError as e:
+    hz = json.loads(e.read())  # 503 mid-restart still carries the document
 assert "healthy" in hz, hz
 print(f"goodput live OK: ratio={summary['goodput_ratio']} phases={ph}")
 PY
@@ -179,5 +183,39 @@ python -m tpu_resiliency.tools.metrics_dump "$MIXED_DIR/events.jsonl" --format p
 python -m tpu_resiliency.tools.metrics_dump "$MIXED_DIR/events.jsonl" --format prom | \
     grep -q "tpu_remediation_actions_total" || { echo "FAIL: tpu_remediation_actions_total missing"; exit 1; }
 python -m tpu_resiliency.tools.events_summary "$MIXED_DIR/events.jsonl" --kind incident_closed --no-timeline > /dev/null
+
+echo "== smoke: hang forensics (/hangz census + stack dumps + incident table)"
+HANG_DIR="$WORKDIR/chaos/hang_1234"
+# The live /hangz view captured mid-stall must name the seeded victim and a
+# blocked barrier with missing ranks.
+python - "$HANG_DIR/hangz.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "tpu-hangz-1", doc.get("schema")
+assert doc["suspects"], "no suspects in the captured /hangz census"
+assert any(b.get("missing") for b in doc["barriers"]), doc["barriers"]
+assert any(r.get("stuck_s") for r in doc["ranks"]), doc["ranks"]
+print(f"/hangz OK: suspects={[s['rank'] for s in doc['suspects']]} "
+      f"open_barriers={len(doc['barriers'])}")
+PY
+# The rendered incident table shows who was stuck where and who never
+# arrived. Captured once: `grep -q` would close the pipe early and turn the
+# CLI's deliberate SIGPIPE exit (141) into a pipefail failure.
+HANG_REPORT=$(python -m tpu_resiliency.tools.incident_report "$HANG_DIR/incidents")
+echo "$HANG_REPORT" | sed 's/^/    /'
+echo "$HANG_REPORT" | grep -q "hang census" \
+    || { echo "FAIL: incident report lost the hang census table"; exit 1; }
+echo "$HANG_REPORT" | grep -q "never arrived" \
+    || { echo "FAIL: census table lost the missing ranks"; exit 1; }
+# The new metric families aggregate from the hang run's events stream.
+python -m tpu_resiliency.tools.metrics_dump "$HANG_DIR/events.jsonl" --format prom | \
+    grep -q "tpu_stack_dumps_total" || { echo "FAIL: tpu_stack_dumps_total missing"; exit 1; }
+python -m tpu_resiliency.tools.metrics_dump "$HANG_DIR/events.jsonl" --format prom | \
+    grep -q "tpu_hang_suspects_total" || { echo "FAIL: tpu_hang_suspects_total missing"; exit 1; }
+# --kind composes: slice the stream to the forensics chain only.
+python -m tpu_resiliency.tools.events_summary "$HANG_DIR/events.jsonl" \
+    --kind hang_detected,stack_dump,kill_ladder,hang_census --no-timeline | sed 's/^/    /'
+python -m tpu_resiliency.tools.store_info --help | grep -q -- "--barriers" \
+    || { echo "FAIL: store_info lost --barriers"; exit 1; }
 
 echo "smoke_observability: PASS ($WORKDIR)"

@@ -139,7 +139,37 @@ def test_unknown_path_is_404_with_directory(server):
         _get(srv.port, "/nope")
     assert ei.value.code == 404
     doc = json.loads(ei.value.read())
-    assert set(doc["endpoints"]) == {"/metrics", "/goodput", "/healthz"}
+    assert set(doc["endpoints"]) == {"/metrics", "/goodput", "/healthz", "/hangz"}
+
+
+def test_hangz_serves_census(server):
+    srv, _ = server
+    census = {
+        "ranks": [{"rank": 1, "stuck_s": 12.0, "where": "section=step"}],
+        "barriers": [{"name": "b", "missing": [1], "waiters": 1}],
+        "suspects": [{"rank": 1, "score": 2.0, "reasons": ["missing from 'b'"]}],
+    }
+    srv.census_fn = lambda: census
+    status, body, ctype = _get(srv.port, "/hangz")
+    assert status == 200 and "json" in ctype
+    doc = json.loads(body)
+    assert doc["schema"] == "tpu-hangz-1"
+    assert doc["suspects"][0]["rank"] == 1
+    assert doc["ranks"][0]["where"] == "section=step"
+    # A wedged census source degrades the document, never the endpoint —
+    # /hangz exists precisely for wedged moments.
+    srv.census_fn = lambda: (_ for _ in ()).throw(RuntimeError("store gone"))
+    status, body, _ = _get(srv.port, "/hangz")
+    assert status == 200
+    assert "store gone" in json.loads(body)["error"]
+
+
+def test_hangz_without_census_source(server):
+    srv, _ = server
+    status, body, _ = _get(srv.port, "/hangz")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["schema"] == "tpu-hangz-1" and "error" in doc
 
 
 def test_local_events_feed_the_served_registry(server):

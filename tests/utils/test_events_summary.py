@@ -75,8 +75,22 @@ def test_kind_filter_and_no_timeline(tmp_path):
     )
     text = out.getvalue()
     assert "worker_failed" in text and "rendezvous_round:" not in text
-    # Counts still cover everything (the filter narrows the timeline only).
-    assert "rendezvous rounds: 1" in text
+    # The footer counts the filtered slice — what the timeline shows is what
+    # the counts summarize.
+    assert "rendezvous rounds" not in text
+    assert "worker failures: 1" in text
+    assert "1 events" in text
+
+    # Comma-separated kinds widen the slice; the footer follows.
+    out_multi = io.StringIO()
+    events_summary.summarize(
+        events_summary.read_events(path), out=out_multi,
+        kind="worker_failed,rendezvous_round",
+    )
+    multi = out_multi.getvalue()
+    assert "worker_failed" in multi and "rendezvous_round" in multi
+    assert "rendezvous rounds: 1" in multi and "worker failures: 1" in multi
+    assert "2 events" in multi
 
     out2 = io.StringIO()
     events_summary.summarize(

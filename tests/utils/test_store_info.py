@@ -56,6 +56,29 @@ def test_introspection_ops_and_report():
         server.close()
 
 
+def test_barriers_census_report():
+    """--barriers renders the live census: waiter ages, MISSING, absent."""
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        c = KVClient("127.0.0.1", server.port)
+        c.barrier_join("rdzv/round-3", rank=0, world_size=3, timeout=0.0, wait=False)
+        c.barrier_join("rdzv/round-3", rank=2, world_size=3, timeout=0.0,
+                       wait=False, on_behalf=True)
+        out = io.StringIO()
+        store_info.report_barriers(c, prefix="", out=out)
+        text = out.getvalue()
+        assert "open barrier rounds: 1" in text
+        assert "rdzv/round-3" in text and "1/3 arrived" in text
+        assert "r0 waiting" in text
+        assert "MISSING: [1]" in text
+        assert "absent (proxied dead): [2]" in text
+        # CLI flag wiring: exit 0, same content.
+        assert store_info.main([f"127.0.0.1:{server.port}", "--barriers"]) == 0
+        c.close()
+    finally:
+        server.close()
+
+
 def test_cli_main_against_live_and_dead_endpoints(capsys):
     server = KVServer(host="127.0.0.1", port=0)
     try:

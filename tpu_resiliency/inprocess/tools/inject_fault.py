@@ -33,6 +33,7 @@ class Fault(enum.Enum):
     SEGFAULT = enum.auto()  # native crash
     LOCK_GIL = enum.auto()  # hold the GIL forever in a helper thread
     SLEEP = enum.auto()  # block the calling thread (soft timeout)
+    GIL_SLEEP = enum.auto()  # hold the GIL in long chunks for `duration` seconds
     EXIT = enum.auto()  # os._exit without cleanup
     DEVICE_HANG = enum.auto()  # dispatch a never-terminating compiled program
     DEVICE_ERROR = enum.auto()  # kill the XLA runtime: every later dispatch raises
@@ -55,6 +56,36 @@ def _lock_gil() -> None:
     pythonapi.PyGILState_Ensure.restype = ctypes.c_void_p
     pythonapi.PyGILState_Ensure()
     libc.sleep(3600)  # blocks holding the GIL: no other thread can run Python
+
+
+#: seconds per GIL-holding chunk of :data:`Fault.GIL_SLEEP`. Detection design
+#: point: a chunk must exceed the heartbeat timeout under test (no beat can
+#: land mid-chunk), while the ~instantaneous gap between chunks is the moment
+#: the hang-forensics stack capture (``utils/stackdump.py``) can run — a
+#: bounded, observable version of the unbounded LOCK_GIL wedge.
+GIL_SLEEP_CHUNK_S = 2.0
+
+
+def _gil_sleep(duration: float, chunk_s: Optional[float] = None) -> None:
+    """Hold the GIL in ``chunk_s`` blocks until ``duration`` elapses.
+
+    ``ctypes.PyDLL`` calls do NOT release the GIL (unlike ``CDLL``), so every
+    other Python thread — heartbeats included — freezes for each chunk;
+    between chunks the interpreter briefly schedules the starved threads,
+    which is where a requested stack dump captures this frame. ``chunk_s``
+    defaults to :data:`GIL_SLEEP_CHUNK_S` at call time so tests can retune
+    the module constant against their detection timeouts."""
+    if chunk_s is None:
+        chunk_s = GIL_SLEEP_CHUNK_S
+    libc = ctypes.PyDLL(None, use_errno=True)
+    deadline = time.monotonic() + duration
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return
+        hold_us = int(min(chunk_s, remaining) * 1e6)
+        if hold_us > 0:
+            libc.usleep(hold_us)  # GIL held for the whole call
 
 
 def _device_hang() -> None:
@@ -151,6 +182,9 @@ def inject_fault(
             return
         if fault == Fault.SLEEP:
             time.sleep(duration)
+            return
+        if fault == Fault.GIL_SLEEP:
+            _gil_sleep(duration)
             return
         if fault == Fault.EXIT:
             os._exit(3)

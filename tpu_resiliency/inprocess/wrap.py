@@ -53,7 +53,7 @@ from tpu_resiliency.inprocess.rank_assignment import (
 )
 from tpu_resiliency.inprocess.state import Mode, State
 from tpu_resiliency.platform.store import host_store, store_addr_from_env
-from tpu_resiliency.utils import flight_recorder
+from tpu_resiliency.utils import flight_recorder, location
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.tracing import span
@@ -483,6 +483,7 @@ class CallWrapper:
                 self.monitor_process.start_iteration(iteration)
 
             frozen = state.freeze()
+            location.note_step(iteration)
             record_event(
                 "inprocess", "iteration_start", iteration=iteration,
                 initial_rank=state.initial_rank, active_rank=state.active_rank,

@@ -183,8 +183,149 @@ class ElasticAgent:
             events_file=os.environ.get(EVENTS_FILE_ENV) or None,
             fetch_snapshots=fetch_snapshots,
             health_fn=self.health,
+            census_fn=self.hang_census,
         )
         self.telemetry.start()
+
+    # -- hang forensics ----------------------------------------------------
+
+    def hang_census(self) -> dict:
+        """The live blocked-collective census (the ``/hangz`` document).
+
+        Three sources folded into one answer to "who is stuck where, and who
+        never arrived": every rank monitor's ``StatusMsg`` (last-known
+        location beacon + heartbeat staleness), the coordination store's
+        ``barrier_census`` op (open barrier rounds with waiter ages and
+        missing ranks), and a deterministic suspect ranking over both.
+        Best-effort by design: an unreachable monitor or store degrades the
+        census, never the caller.
+        """
+        from tpu_resiliency.utils import location as location_mod
+
+        ranks: list[dict] = []
+        for path in list(self._monitor_sockets):
+            payload = self._monitor_status(path)
+            if not payload:
+                continue
+            stuck = payload.get("last_hb_age_s")
+            if not isinstance(stuck, (int, float)):
+                stuck = payload.get("connected_age_s")
+            ranks.append({
+                "rank": payload.get("rank"),
+                "pid": payload.get("pid"),
+                "stuck_s": round(stuck, 3) if isinstance(stuck, (int, float)) else None,
+                "last_hb_age_s": payload.get("last_hb_age_s"),
+                "hb_timeout_s": payload.get("hb_timeout_s"),
+                "location": payload.get("location"),
+                "location_age_s": payload.get("location_age_s"),
+                "where": location_mod.describe(
+                    payload.get("location"), age_s=payload.get("location_age_s")
+                ) or None,
+                "open_sections": payload.get("open_sections"),
+                "terminated": payload.get("terminated"),
+                "kill_pending": payload.get("kill_pending"),
+            })
+        ranks.sort(key=lambda r: (r["rank"] is None, r["rank"]))
+        barriers: list[dict] = []
+        census_error = None
+        try:
+            raw = self.store.client.barrier_census()
+        except Exception as e:  # store wedged/gone: serve what we have
+            raw, census_error = {}, repr(e)
+        for name in sorted(raw):
+            b = raw[name]
+            arrived = b.get("arrived") or {}
+            barriers.append({
+                "name": name,
+                "generation": b.get("generation"),
+                "world_size": b.get("world_size"),
+                "arrived": arrived,
+                "missing": b.get("missing") or [],
+                "absent": b.get("absent") or [],
+                "waiters": len(arrived),
+                "oldest_wait_s": max(arrived.values(), default=0.0),
+                "open_age_s": b.get("open_age_s"),
+            })
+        doc = {
+            "schema": "tpu-hangz-1",
+            "ts": time.time(),
+            "node_id": self.cfg.node_id,
+            "ranks": ranks,
+            "barriers": barriers,
+            "barrier_waiters": sum(b["waiters"] for b in barriers),
+            "suspects": self._rank_suspects(ranks, barriers),
+        }
+        if census_error:
+            doc["barrier_census_error"] = census_error
+        return doc
+
+    @staticmethod
+    def _monitor_status(path: str) -> Optional[dict]:
+        from tpu_resiliency.watchdog.data import StatusMsg
+
+        try:
+            sock = ipc.connect(path, timeout=1.0)
+        except (OSError, ConnectionError):
+            return None
+        try:
+            sock.settimeout(2.0)
+            ipc.write_object(sock, StatusMsg())
+            reply = ipc.read_object(sock)
+        except (OSError, EOFError, ConnectionError):
+            return None
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        payload = getattr(reply, "payload", None)
+        if isinstance(payload, dict) and payload.get("connected"):
+            return payload
+        return None
+
+    @staticmethod
+    def _rank_suspects(ranks: list[dict], barriers: list[dict]) -> list[dict]:
+        """Deterministic suspect ranking: a rank missing from barriers that
+        others are parked in is the prime suspect; heartbeat silence past the
+        timeout and a watchdog verdict corroborate."""
+        scores: dict[int, float] = {}
+        reasons: dict[int, list[str]] = {}
+
+        def implicate(rank, weight: float, why: str) -> None:
+            if not isinstance(rank, int):
+                return
+            scores[rank] = scores.get(rank, 0.0) + weight
+            reasons.setdefault(rank, []).append(why)
+
+        for b in barriers:
+            if not b["waiters"]:
+                continue  # nobody is blocked on this round yet
+            for r in b["missing"]:
+                implicate(
+                    r, 2.0,
+                    f"missing from barrier {b['name']!r} "
+                    f"({b['waiters']} waiting, oldest {b['oldest_wait_s']:.0f}s)",
+                )
+        for row in ranks:
+            r = row.get("rank")
+            hb_age, hb_timeout = row.get("last_hb_age_s"), row.get("hb_timeout_s")
+            if (
+                isinstance(hb_age, (int, float))
+                and isinstance(hb_timeout, (int, float))
+                and hb_age > hb_timeout
+            ):
+                implicate(
+                    r, 1.0,
+                    f"heartbeat silent for {hb_age:.0f}s (timeout {hb_timeout:.0f}s)",
+                )
+            if row.get("kill_pending"):
+                implicate(r, 3.0, f"watchdog verdict: {row['kill_pending']}")
+            elif row.get("terminated"):
+                implicate(r, 3.0, "terminated by watchdog")
+        return [
+            {"rank": r, "score": round(scores[r], 3), "reasons": reasons[r]}
+            for r in sorted(scores, key=lambda r: (-scores[r], r))
+        ]
 
     def health(self) -> dict:
         """The /healthz document: this agent's current health decision."""
@@ -561,6 +702,29 @@ class ElasticAgent:
                 node_id=cfg.node_id, global_rank=f.global_rank,
                 exitcode=f.exitcode, detail=f.describe(),
             )
+        # Snapshot the hang census NOW, while the surviving ranks' monitors
+        # still hold their sessions and the blocked barriers are still open —
+        # group.stop() below destroys both halves of the evidence. One
+        # ``hang_census`` record per failure (not per /hangz scrape) feeds
+        # tpu_hang_suspects_total / tpu_rank_blocked_seconds.
+        census: Optional[dict] = None
+        if self._monitor_sockets:
+            try:
+                census = self.hang_census()
+                record_event(
+                    "launcher", "hang_census",
+                    node_id=cfg.node_id, round=outcome.round,
+                    suspects=census.get("suspects"),
+                    blocked={
+                        str(r["rank"]): r["stuck_s"]
+                        for r in census.get("ranks", [])
+                        if r.get("rank") is not None and r.get("stuck_s") is not None
+                    },
+                    barrier_waiters=census.get("barrier_waiters"),
+                    open_barriers=len(census.get("barriers", [])),
+                )
+            except Exception:
+                log.exception("hang census at failure time failed; continuing")
         if self.incidents is not None:
             # After the worker_failed records: the engine's pre-buffer scan
             # anchors time-to-detect on the earliest fault evidence.
@@ -568,6 +732,7 @@ class ElasticAgent:
                 "worker_failed",
                 detail="; ".join(f.describe() for f in failures),
                 ranks=sorted(f.global_rank for f in failures),
+                census=census,
             )
         group.stop(cfg.term_grace)
         # Budget accounting lives in run() (epoch deltas); here we only pre-check

@@ -129,6 +129,9 @@ class _OpenIncident:
     act_ts: Optional[float] = None
     last_iteration_before: Optional[int] = None
     first_iteration_after: Optional[int] = None
+    #: hang census captured at open time (``ElasticAgent.hang_census``): who
+    #: was stuck where, which barriers were open, who never arrived
+    census: Optional[dict] = None
 
 
 class IncidentEngine:
@@ -274,12 +277,17 @@ class IncidentEngine:
         detail: str = "",
         ranks: Optional[list] = None,
         fault_ts: Optional[float] = None,
+        census: Optional[dict] = None,
     ) -> str:
         """Open an incident (idempotent: a second fault folds into the open
-        one). Returns the incident id."""
+        one). Returns the incident id. ``census``: an optional hang-census
+        snapshot (per-rank locations, open barriers, suspects) embedded
+        verbatim in the artifact."""
         if self._open is not None:
             if ranks:
                 self._open.ranks = sorted(set(self._open.ranks) | set(ranks))
+            if census is not None and self._open.census is None:
+                self._open.census = census
             return self._open.incident_id
         now = time.time()
         if fault_ts is None:
@@ -293,6 +301,7 @@ class IncidentEngine:
             opened_ts=now,
             fault_ts=min(fault_ts, now),
             ranks=sorted(ranks or []),
+            census=census,
         )
         # Iterations seen before the fault — the steps-lost baseline.
         last_iter = None
@@ -364,6 +373,7 @@ class IncidentEngine:
             "fault_ts": inc.fault_ts,
             "slo": slo,
             "chain": chain,
+            "census": inc.census,
             "events": window[-MAX_WINDOW_EVENTS:],
             "flight": flights,
         }

@@ -19,6 +19,12 @@ dir), serving three endpoints:
   bytes appended since the last one.
 - ``GET /healthz`` — the agent's current health decision as JSON; HTTP 200
   when healthy, 503 when not (load-balancer / watchdog friendly).
+- ``GET /hangz`` — the live blocked-collective census as JSON
+  (``schema: tpu-hangz-1``): per-rank last-known location + stuck duration
+  (from each rank's monitor), every open barrier round with its arrived /
+  missing / absent ranks and waiter ages (the store's ``barrier_census``
+  op), and ranked hang suspects — "who is stuck where, and who never
+  arrived", while the job is still wedged.
 
 Each ``/metrics`` or ``/goodput`` request also refreshes the ledger and
 publishes attribution deltas back through the event stream
@@ -65,6 +71,7 @@ class TelemetryServer:
         registry: Optional[MetricsRegistry] = None,
         fetch_snapshots: Optional[Callable[[], list]] = None,
         health_fn: Optional[Callable[[], dict]] = None,
+        census_fn: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.ledger = GoodputLedger()
@@ -74,6 +81,7 @@ class TelemetryServer:
         self.events_file = events_file
         self.fetch_snapshots = fetch_snapshots
         self.health_fn = health_fn
+        self.census_fn = census_fn
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         #: byte offset of the last complete line consumed from events_file
@@ -126,7 +134,7 @@ class TelemetryServer:
                 f.write(f"{port}\n")
             os.replace(tmp, self.port_file)
         log.info(f"telemetry endpoint on http://{self._host}:{port} "
-                 f"(/metrics /goodput /healthz)")
+                 f"(/metrics /goodput /healthz /hangz)")
         return port
 
     def stop(self) -> None:
@@ -166,11 +174,24 @@ class TelemetryServer:
                     doc = {"healthy": False, "error": repr(e)}
             status = 200 if doc.get("healthy") else 503
             self._respond(req, status, _json_body(doc), "application/json")
+        elif path == "/hangz":
+            if self.census_fn is None:
+                doc = {"schema": "tpu-hangz-1", "error": "no census source wired"}
+            else:
+                try:
+                    doc = dict(self.census_fn())
+                except Exception as e:
+                    # A wedged store/monitor must degrade the census, not the
+                    # endpoint — /hangz exists precisely for wedged moments.
+                    doc = {"schema": "tpu-hangz-1", "error": repr(e)}
+            doc.setdefault("schema", "tpu-hangz-1")
+            self._respond(req, 200, _json_body(doc), "application/json")
         else:
             self._respond(
                 req, 404,
                 _json_body({"error": f"unknown path {path!r}",
-                            "endpoints": ["/metrics", "/goodput", "/healthz"]}),
+                            "endpoints": ["/metrics", "/goodput", "/healthz",
+                                          "/hangz"]}),
                 "application/json",
             )
 

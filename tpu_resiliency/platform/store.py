@@ -77,7 +77,7 @@ _IDEMPOTENT_OPS = frozenset({
     "ping", "get", "getv", "check", "set", "delete", "touch", "stale",
     "prefix_get", "prefix_clear", "num_keys", "keys", "barriers",
     "wait_changed", "list_get", "list_clear", "set_get", "set_add",
-    "barrier_status", "barrier_del",
+    "barrier_status", "barrier_del", "barrier_census",
 })
 
 #: Ops where a blind retry double-applies (increment, append, CAS, barrier
@@ -157,6 +157,11 @@ class _Barrier:
     absent: set = dataclasses.field(default_factory=set)
     #: world size of the last round that opened, for detecting elastic changes
     last_world: int = 0
+    #: per-rank arrival instants of the in-progress round (server monotonic) —
+    #: the ``barrier_census`` waiter-age source; cleared on release
+    arrived_at: dict = dataclasses.field(default_factory=dict)
+    #: when the in-progress round opened (first join); 0 between rounds
+    opened_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -761,7 +766,9 @@ class KVServer:
         if b.world_size and covered >= b.world_size:
             b.generation += 1
             b.arrived = set()  # absent stays: dead ranks stay dead for future rounds
+            b.arrived_at = {}
             b.world_size = 0
+            b.opened_at = 0.0
             return True
         return False
 
@@ -839,6 +846,7 @@ class KVServer:
                 # rank numbering and must not count toward the new round.
                 b.absent = set()
             b.last_world = world_size
+            b.opened_at = time.monotonic()
         b.world_size = world_size
         gen = b.generation
         if req.get("on_behalf", False):
@@ -856,6 +864,7 @@ class KVServer:
                 return self._ok(None)  # idempotent re-registration
             raise BarrierOverflow(f"barrier {name!r}: rank {rank} joined twice")
         b.arrived.add(rank)
+        b.arrived_at[rank] = time.monotonic()
         if len(b.arrived | b.absent) > world_size:
             raise BarrierOverflow(
                 f"barrier {name!r}: {len(b.arrived | b.absent)} arrivals > "
@@ -897,6 +906,44 @@ class KVServer:
                 "world_size": b.world_size,
             }
         )
+
+    def _op_barrier_census(self, req: dict) -> dict:
+        """Snapshot of every barrier with an in-progress round: who arrived
+        (with waiter ages), who is proxied absent, and — the hang-forensics
+        payoff — who is *missing*: the ranks the waiters are blocked on.
+
+        ``prefix`` optionally scopes the scan. One response answers "what is
+        the job waiting on, and on whom" without touching any value keys —
+        the live half of the ``/hangz`` census and ``tpu-store-info
+        --barriers``.
+        """
+        prefix = req.get("prefix", "")
+        now = time.monotonic()
+        out = {}
+        for name, b in self._barriers.items():
+            if prefix and not name.startswith(prefix):
+                continue
+            if not b.world_size:
+                continue  # between rounds: nobody is waiting here
+            arrived = {
+                int(r): round(max(0.0, now - ts), 3)
+                for r, ts in b.arrived_at.items()
+                if r in b.arrived
+            }
+            known = set(b.arrived) | set(b.absent)
+            missing = sorted(
+                r for r in range(b.world_size) if r not in known
+            )
+            out[name] = {
+                "generation": b.generation,
+                "world_size": b.world_size,
+                "arrived": arrived,
+                "absent": sorted(b.absent),
+                "missing": missing,
+                "open_age_s": round(max(0.0, now - b.opened_at), 3)
+                if b.opened_at else 0.0,
+            }
+        return self._ok(out)
 
     def _op_touch(self, req: dict) -> dict:
         """Store the *server's* wall time under `key`. Heartbeat freshness must be
@@ -1229,24 +1276,39 @@ class KVClient:
         wait: bool = True,
         on_behalf: bool = False,
     ) -> Optional[int]:
+        req = {
+            "op": "barrier",
+            "name": name,
+            "rank": rank,
+            "world_size": world_size,
+            "timeout": timeout,
+            "wait": wait,
+            "on_behalf": on_behalf,
+        }
+        if wait and not on_behalf:
+            # A blocking join is THE place a rank gets stuck in a collective:
+            # tag the process's location beacon for the duration so the
+            # watchdog's hang diagnosis can name the barrier.
+            from tpu_resiliency.utils import location as location_mod
+
+            with location_mod.barrier(name):
+                return self._barrier_call(req, name, timeout)
+        return self._barrier_call(req, name, timeout if wait else 0.0)
+
+    def _barrier_call(self, req: dict, name: str, timeout: float) -> Optional[int]:
         try:
-            return self._call(
-                {
-                    "op": "barrier",
-                    "name": name,
-                    "rank": rank,
-                    "world_size": world_size,
-                    "timeout": timeout,
-                    "wait": wait,
-                    "on_behalf": on_behalf,
-                },
-                op_timeout=timeout if wait else 0.0,
-            )
+            return self._call(req, op_timeout=timeout)
         except StoreTimeoutError as e:
             raise BarrierTimeout(f"barrier {name!r} timed out after {timeout}s") from e
 
     def barrier_status(self, name: str) -> Optional[dict]:
         return self._call({"op": "barrier_status", "name": name})
+
+    def barrier_census(self, prefix: str = "") -> dict[str, dict]:
+        """Every in-progress barrier round under ``prefix``: arrived ranks
+        with waiter ages, proxied-absent ranks, and the missing ranks the
+        round is blocked on (``platform/store.py:_op_barrier_census``)."""
+        return self._call({"op": "barrier_census", "prefix": prefix})
 
     def barrier_del(self, name: str) -> bool:
         return self._call({"op": "barrier_del", "name": name})
@@ -1348,6 +1410,12 @@ class StoreView:
 
     def barrier_status(self, name: str) -> Optional[dict]:
         return self.client.barrier_status(self._k(name))
+
+    def barrier_census(self, prefix: str = "") -> dict[str, dict]:
+        """Census of this view's in-progress barriers, names view-relative."""
+        raw = self.client.barrier_census(self._k(prefix))
+        start = len(self.prefix)
+        return {k[start:]: v for k, v in raw.items()}
 
     def barrier_del(self, name: str) -> bool:
         return self.client.barrier_del(self._k(name))

@@ -13,6 +13,9 @@ Usage::
 
     python -m tpu_resiliency.tools.events_summary run_events.jsonl
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --kind worker_failed
+    # comma-separated kinds compose with the time/trace slicers; the footer
+    # counts the filtered slice
+    python -m tpu_resiliency.tools.events_summary ev.jsonl --kind hang_detected,kill_ladder,stack_dump
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --no-timeline
     python -m tpu_resiliency.tools.events_summary run_events.jsonl --follow
     # slice to one incident: absolute epoch, ISO-8601, or stream-relative +SECS
@@ -63,11 +66,21 @@ def parse_when(spec: str) -> tuple[float, bool]:
     return dt.timestamp(), False
 
 
+def parse_kinds(spec: Optional[str]) -> Optional[frozenset]:
+    """``--kind`` operand → kind set (comma-separated; None/empty → None)."""
+    if spec is None:
+        return None
+    kinds = frozenset(k.strip() for k in spec.split(",") if k.strip())
+    return kinds or None
+
+
 def make_filter(
-    since: Optional[str], until: Optional[str], trace: Optional[str], t0: float
+    since: Optional[str], until: Optional[str], trace: Optional[str], t0: float,
+    kinds: Optional[frozenset] = None,
 ):
-    """Record predicate for the --since/--until/--trace slicers; ``t0``
-    resolves relative (+SECS) bounds."""
+    """Record predicate for the --since/--until/--trace/--kind slicers;
+    ``t0`` resolves relative (+SECS) bounds. The kind set composes with the
+    time/trace bounds, so timeline AND footer reflect one slice."""
     lo = hi = None
     if since is not None:
         s, rel = parse_when(since)
@@ -83,6 +96,8 @@ def make_filter(
         if hi is not None and (not isinstance(ts, (int, float)) or ts > hi):
             return False
         if trace is not None and rec.get("trace_id") != trace:
+            return False
+        if kinds is not None and rec.get("kind") not in kinds:
             return False
         return True
 
@@ -166,9 +181,11 @@ def summarize(
     keep=None,
 ) -> None:
     """``keep``: optional record predicate (the --since/--until/--trace slice).
-    Sliced records drive both timeline and footer — that's the point of
-    slicing — but ``t+`` offsets stay anchored to the FULL stream's first
-    event, so a sliced view's timestamps line up with the unsliced one."""
+    ``kind``: comma-separated kind filter, part of the same slice — timeline
+    AND footer reflect it (counting kinds the filter excluded would make the
+    footer disagree with the timeline it summarizes). Sliced records drive
+    both, but ``t+`` offsets stay anchored to the FULL stream's first event,
+    so a sliced view's timestamps line up with the unsliced one."""
     out = sys.stdout if out is None else out  # resolved at call time, not import
     records = [r for r in records if "ts" in r and "kind" in r]
     if not records:
@@ -176,14 +193,17 @@ def summarize(
         return
     records.sort(key=lambda r: r["ts"])
     t0 = records[0]["ts"]
-    if keep is not None:
-        records = [r for r in records if keep(r)]
+    kinds = parse_kinds(kind)
+    if keep is not None or kinds is not None:
+        records = [
+            r for r in records
+            if (keep is None or keep(r)) and (kinds is None or r["kind"] in kinds)
+        ]
         if not records:
             print("no events in the selected slice", file=out)
             return
-    shown = [r for r in records if kind is None or r["kind"] == kind]
     if timeline:
-        for r in shown:
+        for r in records:
             print(format_line(r, t0), file=out)
     _footer(
         Counter(r["kind"] for r in records),
@@ -322,6 +342,7 @@ def _follow(
     t0: Optional[float] = None
     last_ts = 0.0
     keep = None  # built once t0 is known (relative bounds need it)
+    kinds = parse_kinds(kind)
 
     def emit() -> None:
         nonlocal t0, last_ts, keep
@@ -331,14 +352,13 @@ def _follow(
                     continue
                 if t0 is None:
                     t0 = rec["ts"]
-                    keep = make_filter(since, until, trace, t0)
+                    keep = make_filter(since, until, trace, t0, kinds=kinds)
                 if not keep(rec):
                     continue
                 counts[rec["kind"]] += 1
                 pids.add(rec.get("pid"))
                 last_ts = max(last_ts, rec["ts"])
-                if kind is None or rec["kind"] == kind:
-                    print(format_line(rec, t0), flush=True)
+                print(format_line(rec, t0), flush=True)
         except KeyboardInterrupt:
             pass
         if counts:
@@ -366,7 +386,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         description="Render a tpu-resiliency structured event stream as a timeline"
     )
     ap.add_argument("events_file")
-    ap.add_argument("--kind", help="show only this event kind in the timeline")
+    ap.add_argument(
+        "--kind",
+        help="show only these event kinds (comma-separated); composes with "
+        "--since/--until/--trace, and the footer counts the filtered slice",
+    )
     ap.add_argument(
         "--since",
         help="drop records before this time: epoch seconds, ISO-8601, or "

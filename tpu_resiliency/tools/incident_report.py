@@ -78,6 +78,26 @@ def render(doc: dict, out, show_events: bool = False, show_flight: bool = False)
         file=out,
     )
 
+    census = doc.get("census")
+    if isinstance(census, dict):
+        _render_census(census, out)
+
+    ev_dumps = [
+        r for r in doc.get("events", []) if r.get("kind") == "stack_dump"
+    ]
+    if ev_dumps:
+        by_rank = {}
+        for d in ev_dumps:
+            by_rank.setdefault(d.get("rank"), []).append(d)
+        print(
+            f"\nstack dumps in window: {len(ev_dumps)} "
+            + ", ".join(
+                f"rank {r}: {len(ds)} ({ds[-1].get('reason')})"
+                for r, ds in sorted(by_rank.items(), key=lambda kv: str(kv[0]))
+            ),
+            file=out,
+        )
+
     chain = doc.get("chain", [])
     print(f"\ncausal chain ({len(chain)} milestones):", file=out)
     for m in chain:
@@ -100,6 +120,18 @@ def render(doc: dict, out, show_events: bool = False, show_flight: bool = False)
             reasons = [
                 r.get("reason") for r in records if r.get("kind") == "flight_flush"
             ]
+            dumps = [r for r in records if r.get("kind") == "stack_dump"]
+            if dumps:
+                n_threads = sum(
+                    d.get("thread_count") or len(d.get("threads") or [])
+                    for d in dumps
+                )
+                print(
+                    f"  flight-{ident}: {len(dumps)} stack dump(s) "
+                    f"({n_threads} thread stacks) — reasons "
+                    f"{[d.get('reason') for d in dumps]}",
+                    file=out,
+                )
             span = ""
             tss = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
             if tss:
@@ -137,6 +169,48 @@ def render(doc: dict, out, show_events: bool = False, show_flight: bool = False)
         for r in evs:
             if isinstance(r.get("ts"), (int, float)) and r.get("kind"):
                 print("  " + format_line(r, t0), file=out)
+
+
+def _render_census(census: dict, out) -> None:
+    """The hang-census table: who was stuck where, who never arrived."""
+    ranks = census.get("ranks") or []
+    barriers = census.get("barriers") or []
+    suspects = census.get("suspects") or []
+    print(f"\nhang census ({len(ranks)} rank(s), "
+          f"{len(barriers)} open barrier(s)):", file=out)
+    for r in ranks:
+        stuck = r.get("stuck_s")
+        stuck_s = f"{stuck:.1f}s" if isinstance(stuck, (int, float)) else "?"
+        flags = []
+        if r.get("kill_pending"):
+            flags.append("KILL-PENDING")
+        if r.get("terminated"):
+            flags.append("TERMINATED")
+        print(
+            f"  rank {r.get('rank')} (pid {r.get('pid')}): stuck {stuck_s}"
+            + (f" — {r['where']}" if r.get("where") else "")
+            + (f" [{' '.join(flags)}]" if flags else ""),
+            file=out,
+        )
+    for b in barriers:
+        arrived = b.get("arrived") or {}
+        waiters = ", ".join(
+            f"r{k}({v:.0f}s)" if isinstance(v, (int, float)) else f"r{k}"
+            for k, v in sorted(arrived.items(), key=lambda kv: str(kv[0]))
+        )
+        print(
+            f"  barrier {b.get('name')}: {len(arrived)}/{b.get('world_size')} "
+            f"arrived [{waiters}]"
+            + (f", never arrived {b['missing']}" if b.get("missing") else "")
+            + (f", absent {b['absent']}" if b.get("absent") else ""),
+            file=out,
+        )
+    if suspects:
+        print("  suspects:", file=out)
+        for s in suspects:
+            why = "; ".join(s.get("reasons") or [])
+            print(f"    rank {s.get('rank')} (score {s.get('score')}): {why}",
+                  file=out)
 
 
 def _list(directory: str, out) -> int:

@@ -18,6 +18,8 @@ Usage::
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --prefix launcher-jobs/
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stale hb/ --max-age 10
+    # live blocked-collective census: arrived/missing/absent + waiter ages
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --barriers
 """
 
 from __future__ import annotations
@@ -82,6 +84,37 @@ def report(client: KVClient, prefix: str, stale_prefix: Optional[str],
             )
 
 
+def report_barriers(client: KVClient, prefix: str, out=None) -> None:
+    """The live barrier census (``barrier_census`` op): every in-progress
+    round's arrived ranks with waiter ages, the missing ranks the round is
+    blocked on, and proxied-absent ranks — the "who never arrived" view an
+    operator needs while the job is still wedged."""
+    out = sys.stdout if out is None else out
+    census = client.barrier_census(prefix)
+    scope = f" under {prefix!r}" if prefix else ""
+    print(f"open barrier rounds{scope}: {len(census)}", file=out)
+    for name in sorted(census):
+        b = census[name]
+        arrived = b.get("arrived") or {}
+        waiters = ", ".join(
+            f"r{k} waiting {v:.1f}s" if isinstance(v, (int, float)) else f"r{k}"
+            for k, v in sorted(arrived.items(), key=lambda kv: str(kv[0]))
+        )
+        print(
+            f"  {name}: gen {b.get('generation')}, "
+            f"{len(arrived)}/{b.get('world_size')} arrived "
+            f"(open {b.get('open_age_s', 0):.1f}s)",
+            file=out,
+        )
+        if waiters:
+            print(f"    arrived: {waiters}", file=out)
+        if b.get("missing"):
+            print(f"    MISSING: {b['missing']} (the ranks everyone is "
+                  f"blocked on)", file=out)
+        if b.get("absent"):
+            print(f"    absent (proxied dead): {b['absent']}", file=out)
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Introspect a live tpu-resiliency coordination store"
@@ -93,6 +126,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="also scan touch-stamps under PREFIX for staleness",
     )
     ap.add_argument("--max-age", type=float, default=10.0)
+    ap.add_argument(
+        "--barriers", action="store_true",
+        help="render only the live barrier census: per wait key, who arrived "
+        "(with waiter ages), who is missing, who was proxied absent",
+    )
     args = ap.parse_args(argv)
     host, _, port_s = args.endpoint.partition(":")
     try:
@@ -112,9 +150,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     try:
-        if pipe_safe(
-            lambda: report(client, args.prefix, args.stale, args.max_age)
-        ):
+        body = (
+            (lambda: report_barriers(client, args.prefix)) if args.barriers
+            else (lambda: report(client, args.prefix, args.stale, args.max_age))
+        )
+        if pipe_safe(body):
             return SIGPIPE_EXIT
     except (OSError, StoreError) as e:
         print(f"store at {args.endpoint} failed mid-report: {e}", file=sys.stderr)

@@ -187,9 +187,17 @@ def set_context_provider(
     _context_provider = fn
 
 
-def add_sink(sink: Callable[[Event], None]) -> None:
+def add_sink(sink: Callable[[Event], None], *, front: bool = False) -> None:
+    """Register a sink. ``front=True`` puts it FIRST in dispatch order —
+    reserved for crash-surviving sinks (the flight recorder): when a process
+    dies mid-``record()`` (e.g. a SIGKILL racing a hang-forensics stack
+    dump), the sink that persists the event must be the one that already
+    ran."""
     with _sinks_lock:
-        _sinks.append(sink)
+        if front:
+            _sinks.insert(0, sink)
+        else:
+            _sinks.append(sink)
 
 
 def remove_sink(sink: Callable[[Event], None]) -> None:

@@ -271,7 +271,7 @@ def install(
             # Re-register if a clear_sinks() dropped us (idempotent: remove
             # first so repeated installs never double-feed the ring).
             events.remove_sink(_recorder)
-            events.add_sink(_recorder)
+            events.add_sink(_recorder, front=True)
             return _recorder
         if _recorder is not None:
             events.remove_sink(_recorder)
@@ -280,7 +280,10 @@ def install(
             directory, capacity=capacity, install_handlers=install_handlers
         )
         _wired_for = directory
-        events.add_sink(_recorder)
+        # FIRST in sink order: if the process dies mid-record (a SIGKILL
+        # racing a stack dump captured in a starved-GIL window), the
+        # crash-surviving ring must be the sink that already persisted it.
+        events.add_sink(_recorder, front=True)
         return _recorder
 
 

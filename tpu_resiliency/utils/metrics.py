@@ -581,6 +581,40 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "tpu_kill_ladder_total", "termination signals by step",
             step=str(rec.get("step", "?")),
         ).inc()
+    elif kind == "stack_dump":
+        reg.counter(
+            "tpu_stack_dumps_total",
+            "all-thread stack captures by reason (hang forensics)",
+            reason=str(rec.get("reason", "?")).split(":", 1)[0],
+        ).inc()
+    elif kind == "hang_census":
+        # One census per hang verdict (the launcher's failure path), not per
+        # /hangz scrape — scrapes are read-only so the suspect counter stays
+        # "suspects per incident", not "suspects times curl".
+        suspects = rec.get("suspects")
+        if isinstance(suspects, list):
+            for s in suspects:
+                r = s.get("rank") if isinstance(s, dict) else s
+                if isinstance(r, int):
+                    reg.counter(
+                        "tpu_hang_suspects_total",
+                        "ranks implicated by a hang census, by rank",
+                        rank=str(r),
+                    ).inc()
+        blocked = rec.get("blocked")
+        if isinstance(blocked, dict):
+            for r, secs in sorted(blocked.items()):
+                if isinstance(secs, (int, float)):
+                    reg.gauge(
+                        "tpu_rank_blocked_seconds",
+                        "per-rank stuck duration at the last hang census",
+                        rank=str(r),
+                    ).set(secs)
+        if isinstance(rec.get("barrier_waiters"), (int, float)):
+            reg.gauge(
+                "tpu_barrier_waiters",
+                "ranks parked in open barrier rounds at the last census",
+            ).set(rec["barrier_waiters"])
     elif kind == "budget_exhausted":
         reg.counter(
             "tpu_budget_exhausted_total", "restart budget exhaustions"

@@ -30,6 +30,12 @@ class FaultToleranceConfig:
     # enforcement
     rank_termination_signal: int = signal.SIGKILL
     log_level: str = "INFO"
+    # hang forensics: on a hang verdict the monitor first requests an
+    # all-thread stack dump from its rank AND every sibling rank's monitor
+    # (the blocked waiters are half the story), waits out the grace, then
+    # runs the kill ladder. 0 (or stack_dump_on_hang=False) kills immediately.
+    stack_dump_on_hang: bool = True
+    stack_dump_grace: float = 1.5
     # restart policy knobs consumed by the launcher
     restart_check_interval: float = 1.0
     # pluggable host/device health checks run by the monitor

@@ -87,6 +87,12 @@ class InitMsg:
     rank_info: RankInfo
     # client pushes any previously persisted state (calculated timeouts)
     client_state: Optional[dict] = None
+    #: what forensics paths this rank supports (``{"dump_signal": bool,
+    #: "dump_poll": bool}``). Read with ``getattr`` server-side — absent on
+    #: old-build clients (version skew). ``dump_signal`` gates the monitor's
+    #: SIGUSR1 nudge: the default SIGUSR1 disposition kills, so it is only
+    #: sent to ranks that declared a handler.
+    capabilities: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -101,6 +107,10 @@ class HeartbeatMsg:
     rank: int
     timestamp: float = dataclasses.field(default_factory=time.monotonic)
     state: Optional[dict] = None  # optional piggy-backed client state
+    #: last-known-location beacon (``utils/location.py`` snapshot). Optional
+    #: and read with ``getattr`` server-side: a mixed old/new fleet during an
+    #: in-job restart must interoperate in both directions (version skew).
+    location: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -109,6 +119,38 @@ class SectionMsg:
     action: SectionAction
     name: Optional[str] = None
     timestamp: float = dataclasses.field(default_factory=time.monotonic)
+    #: same skew contract as :class:`HeartbeatMsg.location`
+    location: Optional[dict] = None
+
+
+@dataclasses.dataclass
+class DumpStacksMsg:
+    """Ask a monitor to trigger an all-thread stack dump in its rank.
+
+    Anyone holding the monitor socket may send it: the watchdog's sibling
+    broadcast before the kill ladder, an operator tool, or a test. The
+    monitor wakes the rank's parked :class:`WaitDumpMsg` long-poll (and
+    nudges the rank with SIGUSR1 as a belt-and-braces second path)."""
+
+    reason: str = "operator"
+
+
+@dataclasses.dataclass
+class WaitDumpMsg:
+    """The rank's dump-listener long-poll: parks server-side until a dump is
+    requested (``seen_gen`` differs from the server's dump generation) or
+    ``timeout`` elapses. Reply is ``OkMsg(payload={"gen", "reason"})``; the
+    client dumps whenever the generation moved."""
+
+    seen_gen: int = 0
+    timeout: float = 30.0
+
+
+@dataclasses.dataclass
+class StatusMsg:
+    """Monitor introspection for the launcher's ``/hangz`` census: replies
+    ``OkMsg(payload={rank, pid, last_hb_age_s, location, location_age_s,
+    open_sections, terminated, ...})``."""
 
 
 @dataclasses.dataclass

@@ -19,6 +19,8 @@ from typing import Optional
 
 from tpu_resiliency.exceptions import FaultToleranceError
 from tpu_resiliency.platform import ipc
+from tpu_resiliency.utils import location as location_mod
+from tpu_resiliency.utils import stackdump
 from tpu_resiliency.utils.logging import RankLoggerAdapter, get_logger
 from tpu_resiliency.watchdog.data import (
     ErrorMsg,
@@ -32,12 +34,17 @@ from tpu_resiliency.watchdog.data import (
     SectionMsg,
     SectionTimeouts,
     UpdateTimeoutsMsg,
+    WaitDumpMsg,
     WorkloadAction,
     WorkloadControlRequest,
 )
 from tpu_resiliency.watchdog.timeouts import TimeoutsCalc
 
 log = get_logger(__name__)
+
+#: server-side park per dump long-poll; the listener's socket timeout rides
+#: comfortably above it
+DUMP_POLL_S = 20.0
 
 
 class RankMonitorClient:
@@ -46,7 +53,7 @@ class RankMonitorClient:
     #: rank it exists to protect). The server re-inits sessions on reconnect.
     RECONNECT_RETRIES = 2
 
-    def __init__(self):
+    def __init__(self, enable_stack_dumps: bool = True):
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._socket_path: Optional[str] = None
@@ -56,6 +63,11 @@ class RankMonitorClient:
         self.section_timeouts: Optional[SectionTimeouts] = None
         self.timeouts_calc: Optional[TimeoutsCalc] = None
         self._loaded_state: Optional[dict] = None
+        #: hang forensics: SIGUSR1 trigger + dump-listener long-poll thread
+        self.enable_stack_dumps = enable_stack_dumps
+        self._dump_stop = threading.Event()
+        self._dump_thread: Optional[threading.Thread] = None
+        self._dump_sock: Optional[socket.socket] = None
         self.log = RankLoggerAdapter(log, role="client")
 
     @property
@@ -84,8 +96,22 @@ class RankMonitorClient:
         self.rank_info = rank_info
         self.log.rank = rank_info.global_rank
         self._socket_path = socket_path
+        # Install the operator dump path BEFORE the session exists: once the
+        # monitor sees our InitMsg capabilities it may SIGUSR1 us, so the
+        # handler must already be chained (main-thread init only; elsewhere
+        # the capability is simply not declared).
+        signal_ok = (
+            stackdump.install_signal_trigger() if self.enable_stack_dumps else False
+        )
         self._sock = ipc.connect(socket_path)
-        reply = self._request(InitMsg(rank_info=rank_info, client_state=self._loaded_state))
+        reply = self._request(InitMsg(
+            rank_info=rank_info,
+            client_state=self._loaded_state,
+            capabilities={
+                "dump_signal": signal_ok,
+                "dump_poll": self.enable_stack_dumps,
+            },
+        ))
         if not isinstance(reply, InitReplyMsg):
             raise FaultToleranceError(f"bad init reply: {reply!r}")
         self.cfg = reply.config
@@ -93,15 +119,83 @@ class RankMonitorClient:
         self.section_timeouts = reply.section_timeouts
         self.timeouts_calc = TimeoutsCalc(safety_factor=self.cfg.safety_factor)
         self.timeouts_calc.reset()
+        if self.enable_stack_dumps:
+            self._dump_stop.clear()
+            self._dump_thread = threading.Thread(
+                target=self._dump_listener, args=(socket_path,),
+                name="monitor-dump-listener", daemon=True,
+            )
+            self._dump_thread.start()
         self.log.info(f"workload monitoring initialized via {socket_path}")
 
     def shutdown_workload_monitoring(self) -> None:
+        self._dump_stop.set()
+        dump_sock = self._dump_sock
+        if dump_sock is not None:
+            try:
+                dump_sock.close()  # unblocks the listener's parked recv
+            except OSError:
+                pass
         with self._lock:
             if self._sock is not None:
                 try:
                     self._sock.close()
                 finally:
                     self._sock = None
+
+    def _dump_listener(self, socket_path: str) -> None:
+        """Long-poll the monitor for stack-dump requests on a DEDICATED
+        connection (the shared request socket must stay free for heartbeats).
+
+        This thread is the capture path that works when the main thread is
+        parked in a GIL-releasing native wait (a wedged collective,
+        ``block_until_ready``) where CPython can never run a signal handler;
+        a genuinely GIL-holding hang defers the capture to the next moment
+        the GIL frees (see ``utils/stackdump.py``)."""
+        seen: Optional[int] = None
+        while not self._dump_stop.is_set():
+            try:
+                sock = ipc.connect(socket_path, timeout=5.0)
+            except (OSError, ConnectionError):
+                if self._dump_stop.wait(2.0):
+                    return
+                continue
+            self._dump_sock = sock
+            try:
+                sock.settimeout(DUMP_POLL_S + 30.0)
+                while not self._dump_stop.is_set():
+                    # First poll syncs to the server's current generation
+                    # without dumping: a request fired before we attached
+                    # belongs to a previous incarnation.
+                    ipc.write_object(
+                        sock,
+                        WaitDumpMsg(
+                            seen_gen=-1 if seen is None else seen,
+                            timeout=0.0 if seen is None else DUMP_POLL_S,
+                        ),
+                    )
+                    reply = ipc.read_object(sock)
+                    payload = getattr(reply, "payload", None)
+                    if not isinstance(payload, dict):
+                        continue
+                    gen = payload.get("gen")
+                    if not isinstance(gen, int):
+                        continue
+                    if seen is not None and gen != seen:
+                        stackdump.dump_stacks(
+                            str(payload.get("reason") or "monitor_request")
+                        )
+                    seen = gen
+            except (OSError, EOFError, ConnectionError):
+                pass
+            finally:
+                self._dump_sock = None
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if self._dump_stop.wait(0.5):
+                return
 
     def _request(self, msg):
         """One request/reply round trip, self-healing across transport faults.
@@ -157,7 +251,15 @@ class RankMonitorClient:
             # retried InitMsg itself re-inits.
             ipc.write_object(
                 self._sock,
-                InitMsg(rank_info=self.rank_info, client_state=self.state_dict()),
+                InitMsg(
+                    rank_info=self.rank_info,
+                    client_state=self.state_dict(),
+                    capabilities={
+                        "dump_signal": self.enable_stack_dumps
+                        and stackdump._trigger_pipe is not None,
+                        "dump_poll": self.enable_stack_dumps,
+                    },
+                ),
             )
             reply = ipc.read_object(self._sock)
             if not isinstance(reply, InitReplyMsg):
@@ -166,25 +268,36 @@ class RankMonitorClient:
     # -- per-step signals --------------------------------------------------
 
     def send_heartbeat(self) -> None:
-        self._request(HeartbeatMsg(rank=self.rank_info.global_rank))
+        # Every heartbeat carries the last-known-location beacon: the
+        # monitor's "last seen in ..." hang diagnosis is only as fresh as the
+        # final message that got through before the stall.
+        self._request(HeartbeatMsg(
+            rank=self.rank_info.global_rank, location=location_mod.snapshot(),
+        ))
         self.timeouts_calc.update_on_heartbeat()
 
     def start_section(self, name: str) -> None:
-        self._request(
-            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.OPEN, name=name)
-        )
+        location_mod.enter_section(name)
+        self._request(SectionMsg(
+            rank=self.rank_info.global_rank, action=SectionAction.OPEN,
+            name=name, location=location_mod.snapshot(),
+        ))
         self.timeouts_calc.update_on_section_open(name)
 
     def end_section(self, name: str) -> None:
-        self._request(
-            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.CLOSE, name=name)
-        )
+        location_mod.exit_section(name)
+        self._request(SectionMsg(
+            rank=self.rank_info.global_rank, action=SectionAction.CLOSE,
+            name=name, location=location_mod.snapshot(),
+        ))
         self.timeouts_calc.update_on_section_close(name)
 
     def end_all_sections(self) -> None:
-        self._request(
-            SectionMsg(rank=self.rank_info.global_rank, action=SectionAction.CLOSE_ALL)
-        )
+        location_mod.exit_section(None)
+        self._request(SectionMsg(
+            rank=self.rank_info.global_rank, action=SectionAction.CLOSE_ALL,
+            location=location_mod.snapshot(),
+        ))
         for name in list(self.timeouts_calc.section_open_since):
             self.timeouts_calc.update_on_section_close(name)
 
