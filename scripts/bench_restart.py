@@ -3,8 +3,8 @@
 The reference claims the benefit qualitatively — in-process restart "removes
 scheduler job launch, container start, interpreter init, dependency load, CUDA
 context creation from the recovery path" (``docs/source/inprocess/index.rst:13-22``)
-— but publishes no numbers (BASELINE.md). This harness measures both restart layers
-of THIS framework on the same machine:
+— but publishes no numbers (BASELINE.md). This harness measures the restart
+layers of THIS framework on the same machine:
 
 - **In-process engine latency** (world 2, forked ranks): a rank's fn raises; the
   latency is fault → fn re-entry on the SAME process, covering quiesce, abort,
@@ -12,12 +12,22 @@ of THIS framework on the same machine:
   engine adds on top of the user's own re-init. Measured on the faulting rank and
   on the healthy peer (whose figure adds cross-rank fault propagation).
 - **In-job respawn latency** (tpu-ft-launcher, 2 workers): a worker exits nonzero;
-  the latency is worker exit → re-spawned worker's ``main()`` entry, covering agent
-  detection, the rendezvous round, process spawn, and interpreter+import startup.
+  the latency is worker exit → re-spawned worker's ``main()`` entry, decomposed
+  from the launcher's own event stream into **detect** (fault injection →
+  ``wait_change`` return, the ``failure_detected`` event) / **teardown**
+  (failure handling + worker stop) / **rendezvous** (restart request → next
+  round placed) / **promote + first-step-ready** (round placed → promoted
+  worker's first Python statement). The warm leg parks runtime-warmed spares
+  and rides the fast-path rendezvous; the cold leg is the full ladder + spawn.
+- **Fast-path rendezvous micro-bench**: N simulated agents on loopback run
+  replacement rounds with the full open/join/close ladder vs the single-CAS
+  round-reuse fast path.
+- **Compile-cache restart leg**: a jitting worker crashes once; round 1 must
+  record a persistent-compilation-cache **hit** and a (much) cheaper re-jit.
 
 Usage::
 
-    python scripts/bench_restart.py [--restarts N] [--out FILE]
+    python scripts/bench_restart.py [--restarts N] [--out FILE] [--smoke]
 
 Prints one JSON line per layer and writes ``BENCH_restart.json``.
 """
@@ -29,9 +39,11 @@ import json
 import multiprocessing as mp
 import os
 import socket
+import statistics
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -139,13 +151,27 @@ def bench_inprocess(n_restarts: int) -> dict:
 
 # ------------------------------------------------------------------- in-job --
 
+# Round 0 rank 0: optionally wait for a warm spare (deterministic promotion —
+# detection+rendezvous are now fast enough that an immediate crash can beat
+# the spare's own warm-up), stamp the fault instant, exit 1. Round 1: stamp
+# re-entry.
 WORKER = """
-import os, sys, time
+import glob, os, sys, time
 stamp_dir = sys.argv[1]
+spares_glob = sys.argv[2] if len(sys.argv) > 2 else ""
 count = int(os.environ.get("TPU_FT_RESTART_COUNT", "0"))
 with open(os.path.join(stamp_dir, f"entry_{count}_{os.environ['RANK']}"), "w") as f:
     f.write(repr(time.time()))
 if count == 0 and os.environ["RANK"] == "0":
+    if spares_glob:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            ready = [p for p in glob.glob(spares_glob) if not p.endswith(".tmp")]
+            if ready:
+                break
+            time.sleep(0.02)
+        else:
+            sys.exit(17)  # spare never went warm: fail loudly, not flakily
     with open(os.path.join(stamp_dir, "exit_0"), "w") as f:
         f.write(repr(time.time()))
     sys.exit(1)
@@ -153,16 +179,23 @@ time.sleep(0.5)
 """
 
 
-def bench_injob(warm_spares: int = 0) -> dict:
-    """Respawn latency, decomposed from the launcher's own structured event stream
-    (wall-clock, same clock as the worker stamps): worker exit → failure detection →
-    next rendezvous round closing → respawned worker's first Python statement. The
-    last segment is dominated by the environment's interpreter/plugin startup tax,
-    measured separately as a median-of-3 floor with the same env.
+def bench_injob(warm_spares: int = 0, fast_path: bool = True) -> dict:
+    """Respawn latency, decomposed from the launcher's own structured event
+    stream (wall-clock, same clock as the worker stamps):
 
-    ``warm_spares`` > 0 measures the warm path: parked pre-imported
-    interpreters (``launcher/park.py``) serve the restart round, removing the
-    interpreter floor from the critical path."""
+    - ``detect_ms``: fault injection (the worker's exit stamp) →
+      ``failure_detected`` (the supervise loop's ``wait_change`` return) —
+      reaper-event wakeup, identical for cold and promoted workers.
+    - ``teardown_ms``: ``failure_detected`` → ``restart_requested`` (failure
+      records, hang census, worker-group stop).
+    - ``rendezvous_ms``: ``restart_requested`` → the replacement
+      ``rendezvous_round`` (fast path: one CAS + barrier; ladder otherwise).
+    - ``promote_ms`` / ``first_step_ready_ms``: round placed →
+      ``worker_promoted`` → the promoted worker's first Python statement
+      (cold runs report the combined segment as ``spawn_and_startup_ms``).
+
+    The interpreter/plugin startup tax is measured separately as a
+    median-of-3 floor with the same env."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     floors = []
@@ -179,24 +212,28 @@ def bench_injob(warm_spares: int = 0) -> dict:
         stamps = os.path.join(td, "stamps")
         os.makedirs(stamps)
         events = os.path.join(td, "events.jsonl")
+        run_dir = os.path.join(td, "run")
+        argv = [
+            sys.executable, "-m", "tpu_resiliency.launcher.launch",
+            "--nproc-per-node", "2", "--max-restarts", "2",
+            # Private ephemeral store: the default endpoint port may be
+            # transiently occupied by unrelated jobs/tests on this host.
+            "--rdzv-endpoint", "127.0.0.1:0",
+            "--monitor-interval", "0.1",
+            "--events-file", events,
+            "--run-dir", run_dir,
+            "--warm-spares", str(warm_spares),
+            "--warm-spare-preload", "json",
+            "--warm-spare-warmup", "runtime" if warm_spares else "imports",
+        ]
+        if not fast_path:
+            argv.append("--no-rdzv-fast-path")
+        argv.append(worker)
+        argv.append(stamps)
+        if warm_spares:
+            argv.append(os.path.join(run_dir, "spares", "ready_*"))
         proc = subprocess.run(
-            [
-                sys.executable, "-m", "tpu_resiliency.launcher.launch",
-                "--nproc-per-node", "2", "--max-restarts", "2",
-                # Private ephemeral store: the default endpoint port may be
-                # transiently occupied by unrelated jobs/tests on this host.
-                "--rdzv-endpoint", "127.0.0.1:0",
-                "--monitor-interval", "0.1",
-                "--events-file", events,
-                "--warm-spares", str(warm_spares),
-                "--warm-spare-preload", "json",
-                worker, stamps,
-            ],
-            env=env,
-            capture_output=True,
-            text=True,
-            timeout=180,
-            cwd=td,
+            argv, env=env, capture_output=True, text=True, timeout=180, cwd=td,
         )
         assert proc.returncode == 0, proc.stderr[-2000:]
 
@@ -205,28 +242,218 @@ def bench_injob(warm_spares: int = 0) -> dict:
                 return float(f.read())
 
         evs = [json.loads(line) for line in open(events)]
-        t_fail = next(e["ts"] for e in evs if e.get("kind") == "worker_failed")
-        rounds = [e["ts"] for e in evs if e.get("kind") == "rendezvous_round"]
-        t_round1 = next(ts for ts in rounds if ts > t_fail)
+
+        def first_ts(kind, after=0.0):
+            return next(
+                e["ts"] for e in evs if e.get("kind") == kind and e["ts"] > after
+            )
 
         t_exit = read("exit_0")
         t_reentry = read("entry_1_0")
-        return {
+        t_detect = first_ts("failure_detected")
+        t_req = first_ts("restart_requested")
+        rounds = [e["ts"] for e in evs if e.get("kind") == "rendezvous_round"]
+        t_round1 = next(ts for ts in rounds if ts > t_detect)
+        out = {
             "respawn_ms": (t_reentry - t_exit) * 1e3,
-            "detect_ms": (t_fail - t_exit) * 1e3,
-            "rendezvous_ms": (t_round1 - t_fail) * 1e3,
-            # monitor forks + Popen of both workers (concurrent) + one interpreter
-            # startup on the critical path
-            "spawn_and_startup_ms": (t_reentry - t_round1) * 1e3,
+            "detect_ms": (t_detect - t_exit) * 1e3,
+            "teardown_ms": (t_req - t_detect) * 1e3,
+            "rendezvous_ms": (t_round1 - t_req) * 1e3,
+            "fast_path_rendezvous": any(
+                e.get("kind") == "rendezvous_fast_path"
+                and e.get("outcome") == "reused" for e in evs
+            ),
             "python_startup_floor_ms": startup_ms,
         }
+        if warm_spares:
+            promos = [
+                e["ts"] for e in evs
+                if e.get("kind") == "worker_promoted"
+                and e.get("outcome") == "promoted" and e.get("round", 0) >= 1
+            ]
+            assert promos, "warm leg never promoted a spare"
+            t_promo = min(promos)
+            out["promote_ms"] = (t_promo - t_round1) * 1e3
+            # Clamped: the promoted shim starts executing the instant the spec
+            # hits its pipe, which can beat the launcher's own event stamp by
+            # a fraction of a millisecond.
+            out["first_step_ready_ms"] = max(0.0, (t_reentry - t_promo) * 1e3)
+        else:
+            out["spawn_and_startup_ms"] = (t_reentry - t_round1) * 1e3
+        return out
+
+
+# -------------------------------------------------- fast-path rendezvous ----
+
+
+def bench_rendezvous_fastpath(nodes: int = 16, rounds: int = 8) -> dict:
+    """Replacement-round latency, full ladder vs fast path: N simulated agents
+    on one loopback store run ``rounds`` restart rounds per mode; the figure
+    is the wall time from the restart request until EVERY agent is placed."""
+    from tpu_resiliency.launcher.rendezvous import (
+        RendezvousSettings,
+        StoreRendezvous,
+    )
+    from tpu_resiliency.platform.store import CoordStore, KVServer
+
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+
+        def run_mode(fast: bool) -> list[float]:
+            prefix = f"bench_{'fast' if fast else 'ladder'}/"
+            stores, rdzvs = [], []
+            for i in range(nodes):
+                st = CoordStore("127.0.0.1", server.port, prefix=prefix)
+                rdzvs.append(
+                    StoreRendezvous(
+                        st, f"n{i}",
+                        RendezvousSettings(
+                            min_nodes=nodes, max_nodes=nodes,
+                            last_call_timeout=0.3,
+                            keep_alive_interval=0.1, keep_alive_timeout=10.0,
+                            poll_interval=0.05, fast_path=fast,
+                        ),
+                    )
+                )
+                stores.append(st)
+
+            def place_all(prev: int) -> None:
+                errs: list = []
+
+                def run(r):
+                    try:
+                        r.next_round(prev)
+                    except Exception as e:
+                        errs.append(e)
+
+                ts = [threading.Thread(target=run, args=(r,)) for r in rdzvs]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(30.0)
+                assert not errs, errs
+
+            place_all(-1)
+            times = []
+            for rnd in range(rounds):
+                rdzvs[0].request_restart(f"bench {rnd}")
+                t0 = time.monotonic()
+                place_all(rnd)
+                times.append((time.monotonic() - t0) * 1e3)
+            for r in rdzvs:
+                r.stop_keepalive()
+            for s in stores:
+                s.close()
+            return times
+
+        ladder = run_mode(False)
+        fast = run_mode(True)
+        return {
+            "nodes": nodes,
+            "rounds": rounds,
+            "full_ladder_ms": {
+                "median": statistics.median(ladder),
+                "min": min(ladder), "max": max(ladder),
+            },
+            "fast_path_ms": {
+                "median": statistics.median(fast),
+                "min": min(fast), "max": max(fast),
+            },
+            "speedup": statistics.median(ladder) / statistics.median(fast),
+        }
+    finally:
+        server.close()
+
+
+# ------------------------------------------------------- compile cache ------
+
+JIT_WORKER = """
+import json, os, sys, time
+from tpu_resiliency.platform import device
+device.apply_platform_env()  # applies the compile cache + records its event
+import jax, jax.numpy as jnp
+count = int(os.environ.get("TPU_FT_RESTART_COUNT", "0"))
+t0 = time.monotonic()
+f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+jax.block_until_ready(f(jnp.ones((256, 256), jnp.float32)))
+jit_ms = (time.monotonic() - t0) * 1e3
+with open(os.path.join(sys.argv[1], f"jit_{count}.json"), "w") as fh:
+    json.dump({"jit_ms": jit_ms}, fh)
+if count == 0:
+    sys.exit(1)
+"""
+
+
+def bench_compile_cache() -> dict:
+    """A jitting worker crashes once; the replacement round must find the
+    persistent compilation cache warm (outcome=hit) and re-jit cheaper."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    with tempfile.TemporaryDirectory() as td:
+        worker = os.path.join(td, "worker.py")
+        with open(worker, "w") as f:
+            f.write(JIT_WORKER)
+        stamps = os.path.join(td, "stamps")
+        os.makedirs(stamps)
+        events = os.path.join(td, "events.jsonl")
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tpu_resiliency.launcher.launch",
+                "--standalone", "--nproc-per-node", "1", "--max-restarts", "2",
+                "--no-ft-monitors", "--monitor-interval", "0.1",
+                "--events-file", events,
+                "--compile-cache-dir", os.path.join(td, "compile_cache"),
+                "--run-dir", os.path.join(td, "run"),
+                worker, stamps,
+            ],
+            env=env, capture_output=True, text=True, timeout=300, cwd=td,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        evs = [json.loads(line) for line in open(events)]
+        cc = [e for e in evs if e.get("kind") == "compile_cache"]
+        assert len(cc) >= 2, cc
+        outcomes = [e["outcome"] for e in cc]
+
+        def read(name):
+            return json.load(open(os.path.join(stamps, name)))
+
+        return {
+            "first_jit_ms": read("jit_0.json")["jit_ms"],
+            "restart_jit_ms": read("jit_1.json")["jit_ms"],
+            "outcomes": outcomes,
+            "restart_hit": outcomes[-1] == "hit",
+            "cache_bytes": cc[-1].get("bytes", 0),
+        }
+
+
+# -------------------------------------------------------------------- main --
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--restarts", type=int, default=5)
     ap.add_argument("--out", default=os.path.join(REPO_ROOT, "BENCH_restart.json"))
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="reduced-rep sanity pass for CI: runs every leg once and asserts "
+        "the structural claims (promotion, fast path, cache hit) without "
+        "writing the committed bench file",
+    )
     args = ap.parse_args()
+
+    if args.smoke:
+        injob_warm = bench_injob(warm_spares=2)
+        print(json.dumps({"layer": "in-job-warm", **injob_warm}))
+        assert injob_warm["fast_path_rendezvous"], "fast-path rendezvous not taken"
+        assert "promote_ms" in injob_warm, "no promotion on the warm path"
+        fastpath = bench_rendezvous_fastpath(nodes=2, rounds=2)
+        print(json.dumps({"layer": "rendezvous-fastpath", **fastpath}))
+        cache = bench_compile_cache()
+        print(json.dumps({"layer": "compile-cache", **cache}))
+        assert cache["restart_hit"], cache
+        print(json.dumps({"bench_restart_smoke": "PASS"}))
+        return
 
     inproc = bench_inprocess(args.restarts)
     print(json.dumps({"layer": "in-process", **inproc}))
@@ -234,14 +461,23 @@ def main() -> None:
     print(json.dumps({"layer": "in-job", **injob}))
     injob_warm = bench_injob(warm_spares=2)
     print(json.dumps({"layer": "in-job-warm", **injob_warm}))
+    fastpath = bench_rendezvous_fastpath()
+    print(json.dumps({"layer": "rendezvous-fastpath", **fastpath}))
+    cache = bench_compile_cache()
+    print(json.dumps({"layer": "compile-cache", **cache}))
 
     speedup = injob["respawn_ms"] / inproc["faulting_rank_ms"]["median"]
     summary = {
         "in_process": inproc,
         "in_job": injob,
         "in_job_warm_spares": injob_warm,
+        "rendezvous_fastpath": fastpath,
+        "compile_cache": cache,
         "speedup_in_process_vs_in_job": speedup,
         "warm_spare_respawn_speedup": injob["respawn_ms"] / injob_warm["respawn_ms"],
+        "warm_vs_in_process_ratio": (
+            injob_warm["respawn_ms"] / inproc["faulting_rank_ms"]["median"]
+        ),
     }
     with open(args.out, "w") as f:
         json.dump(summary, f, indent=2)
@@ -251,6 +487,8 @@ def main() -> None:
         "in_job_ms": round(injob["respawn_ms"], 1),
         "in_job_warm_ms": round(injob_warm["respawn_ms"], 1),
         "speedup": round(speedup, 1),
+        "fastpath_rendezvous_speedup": round(fastpath["speedup"], 2),
+        "compile_cache_restart_hit": cache["restart_hit"],
     }))
 
 

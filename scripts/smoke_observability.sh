@@ -61,6 +61,9 @@ print(f"metrics OK: {len(m)} families, restarts={int(restarts)}")
 PY
 python -m tpu_resiliency.tools.metrics_dump "$EVENTS" | sed 's/^/    /'
 
+echo "== smoke: restart latency (warm-spare promotion + fast-path rendezvous + compile-cache hit)"
+python scripts/bench_restart.py --smoke
+
 echo "== smoke: pipelined checkpoint save (spans + staging metrics)"
 python scripts/bench_ckpt_save.py --smoke
 

@@ -1,0 +1,210 @@
+"""Restart fast-path rendezvous (round reuse): a replacement round with
+unchanged agent membership closes with a single CAS + one confirmation
+barrier instead of the full open/join/last-call/close ladder — and every
+ineligibility (digest mismatch, dead member, store trouble mid-path) degrades
+to the full ladder, never to a wrong world."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.launcher.rendezvous import (
+    RendezvousSettings,
+    StoreRendezvous,
+    _membership_digest,
+)
+from tpu_resiliency.platform import chaos
+from tpu_resiliency.platform.store import CoordStore
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    chaos.clear_plan()
+    yield
+    chaos.clear_plan()
+
+
+def make_rdzv(port, node_id, **kw):
+    defaults = dict(
+        min_nodes=2,
+        max_nodes=2,
+        join_timeout=20.0,
+        last_call_timeout=0.3,
+        keep_alive_interval=0.1,
+        keep_alive_timeout=2.0,
+        poll_interval=0.05,
+        fast_path_timeout=3.0,
+    )
+    defaults.update(kw)
+    store = CoordStore("127.0.0.1", port, prefix="rdzv/")
+    return StoreRendezvous(store, node_id, RendezvousSettings(**defaults)), store
+
+
+def _place_all(nodes, prev_round=-1, timeout=30.0):
+    """next_round() on every node concurrently; {node_id: outcome}."""
+    outs, errs = {}, {}
+
+    def run(nid, r):
+        try:
+            outs[nid] = r.next_round(prev_round)
+        except Exception as e:  # surfaced by the caller's assert
+            errs[nid] = e
+
+    ts = [
+        threading.Thread(target=run, args=(nid, r)) for nid, r in nodes
+    ]
+    for t in ts:
+        t.start()
+        time.sleep(0.02)  # deterministic join order on round 0
+    for t in ts:
+        t.join(timeout)
+    assert not errs, errs
+    assert len(outs) == len(nodes), (sorted(outs), errs)
+    return outs
+
+
+def test_unchanged_membership_rides_the_fast_path(kv_server):
+    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b")]
+    pairs = [("a", nodes[0][0]), ("b", nodes[1][0])]
+    try:
+        outs0 = _place_all(pairs)
+        assert {o.round for o in outs0.values()} == {0}
+        assert not any(o.fast for o in outs0.values())
+        ranks0 = {nid: o.node_rank for nid, o in outs0.items()}
+        nodes[0][0].request_restart("worker died")
+        outs1 = _place_all(pairs, prev_round=0)
+        assert {o.round for o in outs1.values()} == {1}
+        assert all(o.fast for o in outs1.values()), outs1
+        # Round reuse preserves the placement exactly.
+        assert {nid: o.node_rank for nid, o in outs1.items()} == ranks0
+        # The reused round carries the bumped restart epoch.
+        assert all(o.epoch == 1 for o in outs1.values())
+        # And fast rounds are themselves reusable.
+        nodes[1][0].request_restart("again")
+        outs2 = _place_all(pairs, prev_round=1)
+        assert all(o.fast and o.round == 2 for o in outs2.values())
+    finally:
+        for r, s in nodes:
+            r.stop_keepalive()
+            s.close()
+
+
+def test_membership_change_takes_the_full_ladder(kv_server):
+    """A dead member changes the membership: the digest no longer matches and
+    the replacement round must re-rank through the full ladder (here: the
+    former spare gets promoted into the active set)."""
+    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b", "c")]
+    pairs = [(n, r) for n, (r, s) in zip(("a", "b", "c"), nodes)]
+    try:
+        outs0 = _place_all(pairs)
+        assert outs0["c"].is_spare
+        # "a" dies for good: keep-alive goes stale.
+        nodes[0][0].leave()
+        nodes[0][1].close()
+        time.sleep(2.2)  # past keep_alive_timeout
+        nodes[1][0].request_restart("a died")
+        survivors = pairs[1:]
+        outs1 = _place_all(survivors, prev_round=0)
+        assert {o.round for o in outs1.values()} == {1}
+        assert not any(o.fast for o in outs1.values()), outs1
+        assert sorted(
+            o.node_rank for o in outs1.values() if o.node_rank is not None
+        ) == [0, 1]
+    finally:
+        for r, s in nodes[1:]:
+            r.stop_keepalive()
+            s.close()
+
+
+def test_stale_membership_memory_does_not_reuse(kv_server):
+    """A node whose remembered placement is for a DIFFERENT round than the
+    stale state must not fast-close it."""
+    rdzv, store = make_rdzv(kv_server.port, "a", min_nodes=1, max_nodes=1)
+    try:
+        out0 = rdzv.next_round()
+        assert out0.round == 0 and not out0.fast
+        # Forge memory for a different round: eligibility must fail.
+        rdzv._last_membership = (7, _membership_digest(["a"], []))
+        out1_state = store.try_get("state")
+        assert out1_state["round"] == 0
+        rdzv.request_restart("x")
+        out1 = rdzv.next_round(0)
+        assert out1.round == 1 and not out1.fast
+    finally:
+        rdzv.stop_keepalive()
+        store.close()
+
+
+def test_store_trouble_mid_fast_path_degrades_to_full_ladder(kv_server, monkeypatch):
+    """A confirmation barrier that dies mid-fast-path abandons the reused
+    round; the full ladder re-forms the world and both nodes still place."""
+    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b")]
+    pairs = [("a", nodes[0][0]), ("b", nodes[1][0])]
+    try:
+        outs0 = _place_all(pairs)
+        assert {o.round for o in outs0.values()} == {0}
+        # Node a's confirmation barrier raises StoreError once.
+        real_join = nodes[0][1].barrier_join
+        state = {"failed": False}
+
+        def flaky_join(name, *a, **kw):
+            if "fastbar/" in name and not state["failed"]:
+                state["failed"] = True
+                raise StoreError("injected: store lost mid-fast-path")
+            return real_join(name, *a, **kw)
+
+        monkeypatch.setattr(nodes[0][1], "barrier_join", flaky_join)
+        nodes[0][0].request_restart("worker died")
+        outs1 = _place_all(pairs, prev_round=0)
+        assert state["failed"], "fast path never reached its barrier"
+        # Both placed in the same (post-abandon) round via the full ladder.
+        assert len({o.round for o in outs1.values()}) == 1
+        assert {o.node_rank for o in outs1.values()} == {0, 1}
+        assert not any(o.fast for o in outs1.values()), outs1
+    finally:
+        for r, s in nodes:
+            r.stop_keepalive()
+            s.close()
+
+
+@pytest.mark.chaos
+def test_chaos_reset_on_the_cas_still_places(kv_server):
+    """Seeded connection resets across the fast path's store traffic (the CAS
+    ride the store channel): the client's transparent retry or the ladder
+    fallback must still place both nodes — never a wedge, never an error."""
+    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b")]
+    pairs = [("a", nodes[0][0]), ("b", nodes[1][0])]
+    try:
+        outs0 = _place_all(pairs)
+        assert {o.round for o in outs0.values()} == {0}
+        nodes[0][0].request_restart("worker died")
+        # Resets at staggered call indices so the injection lands across the
+        # dead-check / epoch-read / CAS sequence on both nodes' clients.
+        chaos.install_plan(chaos.ChaosPlan.parse(
+            "1234:store.send.reset@at=0+2+5"
+        ))
+        outs1 = _place_all(pairs, prev_round=0)
+        assert len({o.round for o in outs1.values()}) == 1
+        assert {o.node_rank for o in outs1.values()} == {0, 1}
+    finally:
+        chaos.clear_plan()
+        for r, s in nodes:
+            r.stop_keepalive()
+            s.close()
+
+
+def test_fast_path_disabled_by_setting(kv_server):
+    nodes = [make_rdzv(kv_server.port, n, fast_path=False) for n in ("a", "b")]
+    pairs = [("a", nodes[0][0]), ("b", nodes[1][0])]
+    try:
+        _place_all(pairs)
+        nodes[0][0].request_restart("x")
+        outs1 = _place_all(pairs, prev_round=0)
+        assert {o.round for o in outs1.values()} == {1}
+        assert not any(o.fast for o in outs1.values())
+    finally:
+        for r, s in nodes:
+            r.stop_keepalive()
+            s.close()

@@ -154,9 +154,12 @@ class TestShim:
         finally:
             spare.kill()
 
-    def test_pool_tops_up_after_reap_plus_promotion(self, tmp_path):
-        """A dead spare reaped in the same acquire() that promotes a warm one
-        must not shrink the pool below size."""
+    def test_acquire_never_spawns_and_replenish_tops_up(self, tmp_path, monkeypatch):
+        """The promotion hot path: acquire() (even one that reaps a dead spare)
+        must NEVER block on a replacement Popen — spawning is replenish()'s
+        job, run off the critical path."""
+        import tpu_resiliency.launcher.park as park_mod
+
         pool = WarmSparePool(2, str(tmp_path), preload="json")
         try:
             deadline = time.monotonic() + 30
@@ -166,10 +169,18 @@ class TestShim:
             # One spare "dies" (warm, so it's not a startup death).
             pool._spares[0].proc.kill()
             pool._spares[0].proc.wait(timeout=10)
-            got = pool.acquire()
+
+            def forbidden_spawn(*a, **k):
+                raise AssertionError("acquire() spawned a replacement spare")
+
+            monkeypatch.setattr(park_mod, "spawn_spare", forbidden_spawn)
+            got = pool.acquire()  # would raise if it tried to spawn
             assert got is not None
-            assert len(pool._spares) == 2  # reap + promotion both replaced
+            assert pool._spares == []  # reaped + promoted, nothing spawned
             got.kill()
+            monkeypatch.undo()
+            assert pool.replenish() == 2
+            assert len(pool._spares) == 2
         finally:
             pool.close()
 
@@ -181,14 +192,16 @@ class TestShim:
             deadline = time.monotonic() + 60
             while pool.size > 0 and time.monotonic() < deadline:
                 assert pool.acquire() is None
+                pool.replenish()
                 time.sleep(0.2)
             assert pool.size == 0
             assert pool.acquire() is None
+            assert pool.replenish() == 0
             assert pool._spares == []
         finally:
             pool.close()
 
-    def test_pool_acquire_replenishes_and_closes(self, tmp_path):
+    def test_pool_acquire_replenish_cycle_and_close(self, tmp_path):
         pool = WarmSparePool(2, str(tmp_path), preload="json")
         try:
             deadline = time.monotonic() + 30
@@ -198,6 +211,7 @@ class TestShim:
             s1 = pool.acquire()
             assert s1 is not None
             s1.kill()
+            pool.replenish()
             # Replenished: back to 2 eventually.
             deadline = time.monotonic() + 30
             while pool.warm_count < 2 and time.monotonic() < deadline:
@@ -206,6 +220,149 @@ class TestShim:
         finally:
             pool.close()
         assert pool.warm_count == 0
+
+    def test_pool_stats_shape_for_healthz(self, tmp_path):
+        """The /healthz `warm_spares` block: size/parked/warm/deepest."""
+        pool = WarmSparePool(1, str(tmp_path), preload="json")
+        try:
+            deadline = time.monotonic() + 30
+            while pool.warm_count < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.stats() == {
+                "size": 1, "parked": 1, "warm": 1, "deepest": 1,
+            }
+        finally:
+            pool.close()
+        assert pool.stats()["parked"] == 0
+
+    def test_acquire_prefers_deepest_park_depth(self, tmp_path):
+        """With a runtime-warmed and an imports-only spare both parked, the
+        promotion must take the deeper one."""
+        import json as json_mod
+
+        pool = WarmSparePool(2, str(tmp_path), preload="json")
+        try:
+            deadline = time.monotonic() + 30
+            while pool.warm_count < 2 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert pool.warm_count == 2
+            # Simulate one spare having completed the runtime warmup phase.
+            deep = pool._spares[1]
+            with open(deep.ready_file + ".tmp", "w") as f:
+                json_mod.dump({"pid": deep.proc.pid, "depth": 2}, f)
+            os.replace(deep.ready_file + ".tmp", deep.ready_file)
+            got = pool.acquire()
+            assert got is deep
+            assert got.park_depth == 2
+            got.kill()
+        finally:
+            pool.close()
+
+
+class TestWarmupPhase:
+    """The optional park warmup phase: depth protocol, crash accounting, and
+    the promotion parity contract (warmup must not leak env/sys.path drift
+    into the promoted worker)."""
+
+    def _wait_warm(self, spare, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if spare.warm:
+                return
+            assert spare.alive, "spare died while parking"
+            time.sleep(0.02)
+        raise AssertionError("spare never became warm")
+
+    def test_ready_file_reports_park_depth(self, tmp_path):
+        """imports-only parks at depth 1; a completed warmup parks at depth 2
+        — the ready file is the protocol."""
+        shallow = spawn_spare(str(tmp_path / "a"), 0, preload="json")
+        deep = spawn_spare(
+            str(tmp_path / "b"), 0, preload="json", warmup="os:getcwd"
+        )
+        try:
+            self._wait_warm(shallow)
+            self._wait_warm(deep)
+            assert shallow.park_depth == 1
+            assert deep.park_depth == 2
+            body = json.loads(open(deep.ready_file).read())
+            assert body == {"pid": deep.proc.pid, "depth": 2}
+        finally:
+            shallow.kill()
+            deep.kill()
+
+    def test_runtime_warmup_parks_at_depth_2(self, tmp_path):
+        """The built-in platform-safe warmup (device.warm_runtime) completes
+        under JAX_PLATFORMS=cpu and reports depth 2."""
+        spare = spawn_spare(str(tmp_path), 0, preload="json", warmup="runtime")
+        try:
+            self._wait_warm(spare, timeout=120.0)
+            assert spare.park_depth == 2
+        finally:
+            spare.kill()
+
+    def test_warmup_crash_is_a_startup_death(self, tmp_path):
+        """A warmup that raises must kill the spare BEFORE its ready file
+        exists, so the pool counts a startup death (and a doomed warmup
+        disables the pool) instead of promoting a half-warm interpreter."""
+        spare = spawn_spare(
+            str(tmp_path), 0, preload="json", warmup="definitely_not_a_module:boom"
+        )
+        try:
+            assert spare.proc.wait(timeout=60) != 0
+            assert not os.path.exists(spare.ready_file)
+        finally:
+            spare.kill()
+        pool = WarmSparePool(
+            1, str(tmp_path / "pool"), preload="json",
+            warmup="definitely_not_a_module:boom",
+        )
+        try:
+            deadline = time.monotonic() + 60
+            while pool.size > 0 and time.monotonic() < deadline:
+                assert pool.acquire() is None
+                pool.replenish()
+                time.sleep(0.2)
+            assert pool.size == 0
+        finally:
+            pool.close()
+
+    def test_promoted_worker_env_and_sys_path_match_cold_spawn(self, tmp_path):
+        """Promotion parity THROUGH the warmup phase: a runtime-warmed spare's
+        promoted worker must see byte-identical os.environ and sys.path to a
+        cold `python script.py` with the same round env (modulo the two
+        promotion-marker vars, which exist by design)."""
+        script = tmp_path / "dump.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import json, os, sys
+                with open(sys.argv[1], "w") as f:
+                    json.dump({"env": dict(os.environ), "path": sys.path}, f)
+                """
+            )
+        )
+        round_env = dict(os.environ)
+        round_env["TPU_TEST_ROUND_VAR"] = "x"
+        cold_out = tmp_path / "cold.json"
+        r = subprocess.run(
+            [sys.executable, str(script), str(cold_out)],
+            env=round_env, timeout=60, cwd=os.getcwd(),
+        )
+        assert r.returncode == 0
+        spare = spawn_spare(str(tmp_path), 0, preload="json", warmup="runtime")
+        try:
+            self._wait_warm(spare, timeout=120.0)
+            warm_out = tmp_path / "warm.json"
+            proc = spare.unpark([str(script), str(warm_out)], round_env)
+            assert proc.wait(timeout=60) == 0
+        finally:
+            spare.kill()
+        cold = json.loads(cold_out.read_text())
+        warm = json.loads(warm_out.read_text())
+        markers = {PROMOTED_ENV, "TPU_FT_WARM_SPARE_DEPTH"}
+        assert {k: v for k, v in warm["env"].items() if k not in markers} == cold["env"]
+        assert warm["path"] == cold["path"]
 
 
 def test_restart_round_promoted_from_warm_spare(tmp_path):

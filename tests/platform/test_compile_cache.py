@@ -1,0 +1,142 @@
+"""Persistent compilation cache: manifest integrity, the corrupt-entry →
+cold-compile (never a crash) posture, env plumbing, and the compile_cache
+event → tpu_compile_cache_total bridge."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpu_resiliency.platform import compile_cache
+from tpu_resiliency.utils.metrics import MetricsRegistry, observe_record
+
+JIT_SNIPPET = """
+import json, os, sys, time
+from tpu_resiliency.platform import device
+device.apply_platform_env()
+import jax, jax.numpy as jnp
+t0 = time.monotonic()
+f = jax.jit(lambda x: jnp.tanh(x @ x.T).sum())
+val = float(jax.block_until_ready(f(jnp.ones((32, 32), jnp.float32))))
+out = {"compile_ms": (time.monotonic() - t0) * 1e3, "val": val}
+with open(sys.argv[1], "w") as fh:
+    json.dump(out, fh)
+"""
+
+
+def _run_jit_worker(tmp_path, cache_dir, tag, extra_env=None):
+    out = tmp_path / f"out_{tag}.json"
+    events_file = tmp_path / f"events_{tag}.jsonl"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env[compile_cache.CACHE_DIR_ENV] = str(cache_dir)
+    env["TPU_RESILIENCY_EVENTS_FILE"] = str(events_file)
+    env.update(extra_env or {})
+    r = subprocess.run(
+        [sys.executable, "-c", JIT_SNIPPET, str(out)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    evs = [
+        json.loads(ln) for ln in events_file.read_text().splitlines()
+    ] if events_file.exists() else []
+    cc = [e for e in evs if e.get("kind") == "compile_cache"]
+    return json.loads(out.read_text()), cc
+
+
+def _entries(cache_dir):
+    return sorted(
+        n for n in os.listdir(cache_dir) if n.endswith("-cache")
+    )
+
+
+def test_cold_then_warm_start_hits(tmp_path):
+    cache = tmp_path / "cc"
+    got0, cc0 = _run_jit_worker(tmp_path, cache, "cold")
+    assert len(cc0) == 1 and cc0[0]["outcome"] == "miss", cc0
+    assert _entries(cache), "no cache entries written"
+    got1, cc1 = _run_jit_worker(tmp_path, cache, "warm")
+    assert len(cc1) == 1 and cc1[0]["outcome"] == "hit", cc1
+    assert cc1[0]["entries"] >= 1 and cc1[0]["bytes"] > 0
+    assert got1["val"] == got0["val"]
+
+
+def test_truncated_entry_is_purged_to_cold_compile(tmp_path):
+    """The ckpt-style integrity posture: a truncated cache entry costs exactly
+    one cold compile and an outcome=miss_corrupt event — never a crash."""
+    cache = tmp_path / "cc"
+    _run_jit_worker(tmp_path, cache, "seed")
+    compile_cache.write_manifest(str(cache))
+    victims = _entries(cache)
+    assert victims
+    for name in victims:
+        p = cache / name
+        with open(p, "r+b") as f:
+            f.truncate(max(1, os.path.getsize(p) // 2))
+    got, cc = _run_jit_worker(tmp_path, cache, "corrupt")
+    assert len(cc) == 1 and cc[0]["outcome"] == "miss_corrupt", cc
+    assert cc[0]["purged"] == len(victims)
+    assert got["val"] == pytest.approx(got["val"])
+    # The purged programs were re-compiled and re-cached.
+    assert _entries(cache)
+
+
+def test_sweep_leaves_unmanifested_entries_alone(tmp_path):
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    (cache / "newentry-cache").write_bytes(b"x" * 64)
+    stats = compile_cache.sweep(str(cache))
+    assert stats == {"entries": 1, "bytes": 64, "purged": 0, "unverified": 1}
+    assert (cache / "newentry-cache").exists()
+
+
+def test_manifest_roundtrip_and_mismatch_purge(tmp_path):
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    (cache / "a-cache").write_bytes(b"alpha")
+    (cache / "b-cache").write_bytes(b"bravo")
+    assert compile_cache.write_manifest(str(cache)) == 2
+    # Flip a bit in one entry.
+    (cache / "a-cache").write_bytes(b"alphA")
+    stats = compile_cache.sweep(str(cache))
+    assert stats["purged"] == 1
+    assert not (cache / "a-cache").exists()
+    assert (cache / "b-cache").exists()
+    # A deleted (evicted) entry is NOT corruption.
+    os.unlink(cache / "b-cache")
+    compile_cache.write_manifest(str(cache))
+    assert compile_cache.sweep(str(cache))["purged"] == 0
+
+
+def test_corrupt_manifest_is_tolerated(tmp_path):
+    cache = tmp_path / "cc"
+    cache.mkdir()
+    (cache / compile_cache.MANIFEST_NAME).write_text("{not json")
+    (cache / "a-cache").write_bytes(b"alpha")
+    stats = compile_cache.sweep(str(cache))
+    assert stats["purged"] == 0 and stats["entries"] == 1
+
+
+def test_observe_record_maps_compile_cache_events():
+    reg = MetricsRegistry()
+    observe_record(
+        {"kind": "compile_cache", "outcome": "hit", "bytes": 4096}, reg
+    )
+    observe_record(
+        {"kind": "compile_cache", "outcome": "miss_corrupt", "bytes": 0}, reg
+    )
+    snap = reg.snapshot()["metrics"]
+    outcomes = {
+        e["labels"]["outcome"]: e["value"]
+        for e in snap["tpu_compile_cache_total"]
+    }
+    assert outcomes == {"hit": 1.0, "miss_corrupt": 1.0}
+    assert snap["tpu_compile_cache_bytes"][0]["value"] == 0.0
+
+
+def test_outcome_classification():
+    assert compile_cache.outcome_of({"entries": 0, "purged": 0}) == "miss"
+    assert compile_cache.outcome_of({"entries": 3, "purged": 0}) == "hit"
+    assert compile_cache.outcome_of({"entries": 3, "purged": 1}) == "miss_corrupt"

@@ -141,6 +141,20 @@ class CallWrapper:
         self.state = State.from_env()
         self._atomic_lock = threading.RLock()
 
+        # Persistent compilation cache (launcher --compile-cache-dir): applied
+        # BEFORE the wrapped fn can trace/compile anything, so a restarted
+        # incarnation's first step loads the previous round's executables.
+        # One-shot per process; records the compile_cache event
+        # (hit / miss / miss_corrupt + bytes) that feeds
+        # tpu_compile_cache_total{outcome} and the goodput ledger's restart
+        # attribution. Failures degrade to a cold compile, never an error.
+        try:
+            from tpu_resiliency.platform import compile_cache
+
+            compile_cache.apply_from_env()
+        except Exception:
+            log.debug("compile cache apply failed", exc_info=True)
+
         host, port = store_addr_from_env()
         if wrapper.store_host is not None:
             host = wrapper.store_host

@@ -73,6 +73,14 @@ class AgentConfig:
     #: serialization (BENCH_restart.json decomposition). 0 disables.
     warm_spares: int = 0
     warm_spare_preload: str = "jax"
+    #: park phase for spares: "imports" (preloads only), "runtime" (the
+    #: platform-safe device.warm_runtime pre-init), or a custom
+    #: "module:function" spec. Deeper-warmed spares are promoted first.
+    warm_spare_warmup: str = "imports"
+    #: restart fast-path rendezvous (round reuse): replacement rounds with
+    #: unchanged agent membership close with one CAS + one barrier instead of
+    #: the full open/join/close ladder
+    rdzv_fast_path: bool = True
     #: directory for incident artifacts + flight-recorder dumps; empty
     #: disables the incident plane (``launcher/incident.py``). Exported to
     #: workers as $TPU_RESILIENCY_FLIGHT_DIR so every rank keeps a
@@ -122,6 +130,7 @@ class ElasticAgent:
                 keep_alive_interval=cfg.keep_alive_interval,
                 keep_alive_timeout=cfg.keep_alive_timeout,
                 upscaling_enabled=cfg.upscaling_enabled,
+                fast_path=cfg.rdzv_fast_path,
             ),
         )
         self.restarter = RestarterStateMachine("InJob", strict=False)
@@ -340,6 +349,13 @@ class ElasticAgent:
         }
         if self.incidents is not None:
             doc["incident_open"] = bool(self.incidents.is_open)
+        if self._spare_pool is not None:
+            # Warm-spare pool state: is there standby capacity for the next
+            # restart round, and how deep is it warmed?
+            try:
+                doc["warm_spares"] = self._spare_pool.stats()
+            except Exception:
+                pass
         return doc
 
     # -- lifecycle ---------------------------------------------------------
@@ -365,6 +381,7 @@ class ElasticAgent:
                     self.cfg.warm_spares,
                     self.cfg.run_dir,
                     preload=self.cfg.warm_spare_preload,
+                    warmup=self.cfg.warm_spare_warmup,
                 )
             while True:
                 try:
@@ -621,6 +638,19 @@ class ElasticAgent:
                 # round's workers — they'd keep holding the TPU devices.
                 group.stop(cfg.term_grace)
             self._stop_monitors()
+            # Post-round: re-digest the compile-cache manifest so entries this
+            # round's workers wrote are integrity-covered even if the workers
+            # died without their exit hooks (SIGKILL, OOM). On a thread — a
+            # large cache's CRC pass must not sit on the restart path.
+            try:
+                from tpu_resiliency.platform import compile_cache
+
+                threading.Thread(
+                    target=compile_cache.refresh_manifest_from_env,
+                    daemon=True, name="compile-cache-manifest",
+                ).start()
+            except Exception:
+                pass
 
     def _supervise(self, group: WorkerGroup, outcome: RendezvousOutcome) -> str:
         cfg = self.cfg
@@ -643,6 +673,15 @@ class ElasticAgent:
                 )
                 return self._await_group_completion(outcome, epoch0)
             if state is GroupState.FAILED:
+                # Stamped the instant wait_change returned with a failure —
+                # BEFORE error-file reads, the hang census, or teardown — so
+                # the bench's "detect" segment measures exactly fault
+                # injection → reaper-event wakeup, on cold and promoted
+                # workers alike.
+                record_event(
+                    "launcher", "failure_detected", round=outcome.round,
+                    node_id=cfg.node_id,
+                )
                 return self._handle_failure(group, outcome)
             # -- running: watch the control plane --------------------------
             if self.rdzv.shutdown_reason() is not None:

@@ -116,6 +116,31 @@ def build_parser() -> argparse.ArgumentParser:
         default="jax",
         help="comma-separated modules each warm spare imports while parked",
     )
+    p.add_argument(
+        "--warm-spare-warmup",
+        default="imports",
+        help="park phase for warm spares: 'imports' (preloads only, default), "
+        "'runtime' (platform-safe runtime warmup: plugin discovery, tracing "
+        "machinery, CPU/loopback backend pre-init — device grabbing stays "
+        "strictly post-promotion), or a custom 'module:function' spec; "
+        "deeper-warmed spares are promoted first",
+    )
+    p.add_argument(
+        "--compile-cache-dir",
+        default=None,
+        help="persistent XLA compilation cache shared across restart rounds "
+        "(exports $JAX_COMPILATION_CACHE_DIR + "
+        "$TPU_RESILIENCY_COMPILE_CACHE_DIR to workers): a respawned worker's "
+        "first step loads the previous round's executables instead of "
+        "re-tracing/re-compiling; corrupt entries are swept to a cold "
+        "compile, never a crash",
+    )
+    p.add_argument(
+        "--no-rdzv-fast-path",
+        action="store_true",
+        help="disable restart fast-path rendezvous (round reuse); replacement "
+        "rounds always take the full open/join/close ladder",
+    )
     p.add_argument("--term-grace", type=float, default=15.0)
     p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
     p.add_argument(
@@ -177,6 +202,7 @@ _STORE_TRUE_FLAGS = {
     "--upscaling-enabled",
     "--no-ft-monitors",
     "--no-python",
+    "--no-rdzv-fast-path",
     "--module",
     "-m",
     "--standalone",
@@ -343,6 +369,24 @@ def main(argv: Optional[list[str]] = None) -> int:
         os.environ[EVENTS_FILE_ENV] = os.path.abspath(args.events_file)
     if args.metrics_file:
         os.environ[METRICS_FILE_ENV] = os.path.abspath(args.metrics_file)
+    if args.compile_cache_dir:
+        from tpu_resiliency.platform import compile_cache
+
+        cache_dir = os.path.abspath(args.compile_cache_dir)
+        # Both exports on purpose: TPU_RESILIENCY_* drives this package's
+        # integrity sweep + compile_cache event in workers that import it;
+        # JAX_COMPILATION_CACHE_DIR makes plain-JAX workers (no tpu_resiliency
+        # import) cache too. Sweep HERE, before any worker starts, so a cache
+        # corrupted between jobs is purged exactly once up front.
+        os.environ[compile_cache.CACHE_DIR_ENV] = cache_dir
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+        swept = compile_cache.sweep(cache_dir)
+        if swept.get("purged"):
+            log.warning(
+                f"compile cache sweep purged {swept['purged']} corrupt "
+                f"entries from {cache_dir} (cold compiles will follow)"
+            )
     # Trace identity rides the same single-export pattern: mint here (the root
     # of the process tree) so every agent/worker/monitor event shares one
     # trace_id and spans stitch cross-process (tools/trace_export.py).
@@ -402,6 +446,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         store_port=store_port,
         warm_spares=args.warm_spares,
         warm_spare_preload=args.warm_spare_preload,
+        warm_spare_warmup=args.warm_spare_warmup,
+        rdzv_fast_path=not args.no_rdzv_fast_path,
         incidents_dir=(
             os.path.abspath(args.incidents_dir) if args.incidents_dir else ""
         ),

@@ -9,9 +9,18 @@ removes it:
 
 - A :class:`WarmSparePool` keeps N **parked interpreters** that have already
   imported the expensive modules (``jax`` by default) but have NOT initialized
-  any platform/backend state — parking happens strictly before rank assignment,
+  any device-owning backend — parking happens strictly before rank assignment,
   rendezvous, or device use, so a promoted spare is indistinguishable from a
   fresh interpreter to the workload.
+- An optional **runtime warmup phase** (``--warm-spare-warmup runtime``) goes
+  one park level deeper: after the imports, the shim runs a platform-safe
+  warmup (``platform/device.py:warm_runtime``) — backend *plugin discovery*
+  without initialization, the backend-free tracing machinery, and CPU/loopback
+  backend pre-init only where it cannot conflict with the dying worker's
+  device lease (``$JAX_PLATFORMS=cpu`` workloads). Device-grabbing stays
+  strictly post-promotion. The achieved **park depth** (1 = imports,
+  2 = runtime-warm) is reported in the ready file so promotion can prefer the
+  deepest-warmed spare.
 - On a restart round, ``WorkerGroup.start`` *promotes* a warm spare instead of
   paying the spawn: the per-round spec (argv, env, log paths) is written down
   an inherited pipe, and the shim in this module applies it and runs the user
@@ -30,11 +39,20 @@ Promotion parity contract: the shim REPLACES ``os.environ`` with the round env
 and ``sys.path[0]`` at the script exactly as ``python script.py`` would (for
 ``-m`` workers ``sys.path[0]`` stays the working directory, as
 ``python -m`` does), and splices round-env ``PYTHONPATH`` entries that were
-not present at park time into ``sys.path``. One caveat remains by design: an
-env var that a *preloaded* module reads at import time must already be present
-in the launcher's environment (true for ``JAX_PLATFORMS`` workflows here:
-workers re-select platforms at runtime via
-``platform.device.apply_platform_env``).
+not present at park time into ``sys.path``. The warmup phase is bound by the
+same contract: it must not mutate ``os.environ`` or ``sys.path``, and a
+warmup that raises kills the spare *before* its ready file exists, so the
+pool counts it as a startup death (doomed warmups disable the pool instead of
+respawning forever). One caveat remains by design: an env var that a
+*preloaded* module reads at import time must already be present in the
+launcher's environment (true for ``JAX_PLATFORMS`` workflows here: workers
+re-select platforms at runtime via ``platform.device.apply_platform_env``).
+
+Pool discipline (the restart hot path): ``acquire()`` only *selects* — it
+reaps the dead, prefers the deepest-warmed spare, and never spawns. Top-up is
+``replenish()``, which ``WorkerGroup.start`` runs on a background thread
+*after* the round's workers are up, so promotion latency never includes a
+replacement ``Popen``.
 """
 
 from __future__ import annotations
@@ -44,17 +62,41 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 from typing import Optional
 
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 #: exported into a promoted spare's env so workloads/tests can observe promotion
 PROMOTED_ENV = "TPU_FT_WARM_SPARE"
+#: the promoted spare's park depth (1 = imports, 2 = runtime-warm)
+PROMOTED_DEPTH_ENV = "TPU_FT_WARM_SPARE_DEPTH"
+
+#: ``--warm-spare-warmup`` value meaning "imports only, no warmup phase"
+WARMUP_IMPORTS = "imports"
+#: alias for the built-in platform-safe runtime warmup
+WARMUP_RUNTIME = "runtime"
+_WARMUP_RUNTIME_SPEC = "tpu_resiliency.platform.device:warm_runtime"
 
 
 # ------------------------------------------------------------------ the shim --
+
+
+def _run_warmup(spec: str) -> None:
+    """Resolve and run the warmup callable (``module:function``; ``runtime``
+    aliases the built-in platform-safe warmup). Any failure propagates: the
+    shim dies before writing its ready file, which the pool counts as a
+    startup death rather than promoting a half-warm interpreter."""
+    if spec == WARMUP_RUNTIME:
+        spec = _WARMUP_RUNTIME_SPEC
+    mod_name, _, fn_name = spec.partition(":")
+    import importlib
+
+    fn = getattr(importlib.import_module(mod_name), fn_name or "warm_runtime")
+    fn()
 
 
 def _apply_spec_and_run(spec: dict) -> None:
@@ -111,14 +153,19 @@ def _apply_spec_and_run(spec: dict) -> None:
         exec(code, mod.__dict__)
 
 
-def _serve_parked(go_fd: int, ready_file: str, preload: str) -> None:
-    """Import the expensive modules, announce readiness, then block on the
-    launcher's pipe until a round spec arrives (or EOF: launcher gone)."""
+def _serve_parked(go_fd: int, ready_file: str, preload: str, warmup: str) -> None:
+    """Import the expensive modules, run the optional warmup phase, announce
+    readiness (with the achieved park depth), then block on the launcher's
+    pipe until a round spec arrives (or EOF: launcher gone)."""
     for mod in filter(None, preload.split(",")):
         __import__(mod)
+    depth = 1
+    if warmup and warmup != WARMUP_IMPORTS:
+        _run_warmup(warmup)
+        depth = 2
     tmp = ready_file + ".tmp"
     with open(tmp, "w") as f:
-        f.write(str(os.getpid()))
+        json.dump({"pid": os.getpid(), "depth": depth}, f)
     os.replace(tmp, ready_file)
 
     with os.fdopen(go_fd, "r") as go:
@@ -135,8 +182,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument("--go-fd", type=int, required=True)
     ap.add_argument("--ready-file", required=True)
     ap.add_argument("--preload", default="jax")
+    ap.add_argument("--warmup", default=WARMUP_IMPORTS)
     args = ap.parse_args(argv)
-    _serve_parked(args.go_fd, args.ready_file, args.preload)
+    _serve_parked(args.go_fd, args.ready_file, args.preload, args.warmup)
     return 0
 
 
@@ -160,6 +208,21 @@ class ParkedSpare:
     def alive(self) -> bool:
         return self.proc.poll() is None
 
+    @property
+    def park_depth(self) -> int:
+        """The ready file's reported depth: 0 not warm, 1 imports, 2 runtime.
+        A legacy plain-pid ready file reads as depth 1."""
+        if not self.warm:
+            return 0
+        try:
+            with open(self.ready_file) as f:
+                body = f.read().strip()
+            if body.startswith("{"):
+                return int(json.loads(body).get("depth", 1))
+            return 1
+        except (OSError, ValueError):
+            return 1
+
     def unpark(
         self,
         argv: list[str],
@@ -169,6 +232,7 @@ class ParkedSpare:
     ) -> subprocess.Popen:
         env = dict(env)
         env[PROMOTED_ENV] = "1"
+        env[PROMOTED_DEPTH_ENV] = str(self.park_depth)
         spec = {"argv": list(argv), "env": env, "stdout": stdout, "stderr": stderr}
         payload = memoryview((json.dumps(spec) + "\n").encode())
         while payload:
@@ -210,7 +274,10 @@ class ParkedSpare:
         self._cleanup_files()
 
 
-def spawn_spare(run_dir: str, spare_id: int, preload: str = "jax") -> ParkedSpare:
+def spawn_spare(
+    run_dir: str, spare_id: int, preload: str = "jax",
+    warmup: str = WARMUP_IMPORTS,
+) -> ParkedSpare:
     """Spawn one parked shim; the returned spare's pipe write-end is the only
     handle the launcher needs (spec on promote, close on release)."""
     os.makedirs(run_dir, exist_ok=True)
@@ -232,6 +299,8 @@ def spawn_spare(run_dir: str, spare_id: int, preload: str = "jax") -> ParkedSpar
                 ready,
                 "--preload",
                 preload,
+                "--warmup",
+                warmup,
             ],
             env=dict(os.environ),
             start_new_session=True,
@@ -246,42 +315,49 @@ def spawn_spare(run_dir: str, spare_id: int, preload: str = "jax") -> ParkedSpar
 
 
 class WarmSparePool:
-    """Keeps ``size`` parked interpreters ready; replenishes on acquire.
+    """Keeps ``size`` parked interpreters ready.
 
     Spawning a spare is a non-blocking ``Popen`` (~ms for the parent); the
     spare pays its import bill in the background while the current round runs,
     so by the time a restart needs it the interpreter floor is already paid.
+
+    ``acquire()`` is promotion-hot-path-safe: it only reaps and selects
+    (deepest park depth first) — it NEVER spawns. Call :meth:`replenish`
+    off the critical path (``WorkerGroup.start`` does, on a background
+    thread after the round's workers are up) to top the pool back up.
     """
 
-    def __init__(self, size: int, run_dir: str, preload: str = "jax"):
+    def __init__(
+        self, size: int, run_dir: str, preload: str = "jax",
+        warmup: str = WARMUP_IMPORTS,
+    ):
         self.size = size
         self.run_dir = os.path.join(run_dir, "spares")
         self.preload = preload
+        self.warmup = warmup
         self._spares: list[ParkedSpare] = []
         self._next_id = 0
+        self._lock = threading.Lock()
         self._startup_deaths = 0  # consecutive died-before-warm spares
-        for _ in range(size):
-            self._spawn()
+        self.replenish()
 
-    def _spawn(self) -> None:
+    def _spawn_locked(self) -> None:
         sid = self._next_id
         self._next_id += 1
-        self._spares.append(spawn_spare(self.run_dir, sid, self.preload))
+        self._spares.append(
+            spawn_spare(self.run_dir, sid, self.preload, self.warmup)
+        )
 
-    def acquire(self) -> Optional[ParkedSpare]:
-        """A warm spare (removed from the pool), or None — callers fall back to
-        a cold spawn, so a dead/cold pool degrades to exactly the poolless
-        behavior. The pool is topped back up to ``size`` on every call,
-        whatever was reaped or promoted."""
+    def _reap_locked(self) -> None:
+        """Drop dead spares; track consecutive startup deaths so a doomed
+        preload/warmup (e.g. a typo'd module) disables the pool with a
+        diagnostic instead of respawning dying interpreters on every round
+        forever. The tracebacks went to the launcher's stderr."""
         live: list[ParkedSpare] = []
         for s in self._spares:
             if s.alive:
                 live.append(s)
                 continue
-            # Died before ever becoming warm = its preload/startup failed
-            # (traceback went to the launcher's stderr). A systematic startup
-            # failure (e.g. a typo'd --warm-spare-preload) must not respawn
-            # doomed interpreters on every round forever.
             died_cold = not os.path.exists(s.ready_file) and s.proc.poll() != 0
             self._startup_deaths = self._startup_deaths + 1 if died_cold else 0
             s.kill()  # reap the zombie + remove its ready file
@@ -289,29 +365,73 @@ class WarmSparePool:
         if self.size > 0 and self._startup_deaths >= 2 * self.size:
             log.error(
                 f"warm-spare pool disabled: {self._startup_deaths} spares died "
-                f"during startup (bad --warm-spare-preload={self.preload!r}? "
-                "see the launcher's stderr for their tracebacks); restart "
-                "rounds will cold-spawn"
+                f"during startup (bad --warm-spare-preload={self.preload!r} or "
+                f"--warm-spare-warmup={self.warmup!r}? see the launcher's "
+                "stderr for their tracebacks); restart rounds will cold-spawn"
             )
             self.size = 0
-        found: Optional[ParkedSpare] = None
-        for i, spare in enumerate(self._spares):
-            if spare.warm:
-                found = spare
-                del self._spares[i]
-                break
-        while len(self._spares) < self.size:
-            self._spawn()
-        return found
+
+    def _record_state_locked(self) -> None:
+        # The pool gauge (tpu_warm_spares_warm) rides the event stream like
+        # every other metric: one record per state change, not a poller.
+        record_event(
+            "launcher", "warm_spare_pool",
+            size=self.size, parked=len(self._spares),
+            warm=sum(1 for s in self._spares if s.warm),
+        )
+
+    def acquire(self) -> Optional[ParkedSpare]:
+        """The deepest-warmed spare (removed from the pool), or None — callers
+        fall back to a cold spawn, so a dead/cold pool degrades to exactly the
+        poolless behavior. Selection only: replacements are spawned by
+        :meth:`replenish`, never here — promotion must not block on a
+        ``Popen``."""
+        with self._lock:
+            self._reap_locked()
+            best_i, best_depth = -1, 0
+            for i, spare in enumerate(self._spares):
+                depth = spare.park_depth
+                if depth > best_depth:
+                    best_i, best_depth = i, depth
+            found = self._spares.pop(best_i) if best_i >= 0 else None
+            self._record_state_locked()
+            return found
+
+    def replenish(self) -> int:
+        """Reap the dead and spawn spares until the pool is back at ``size``;
+        returns how many were spawned. Safe to call from a background thread
+        (WorkerGroup.start does, after the round's workers are up)."""
+        with self._lock:
+            self._reap_locked()
+            spawned = 0
+            while len(self._spares) < self.size:
+                self._spawn_locked()
+                spawned += 1
+            if spawned:
+                self._record_state_locked()
+            return spawned
 
     @property
     def warm_count(self) -> int:
-        return sum(1 for s in self._spares if s.warm)
+        with self._lock:
+            return sum(1 for s in self._spares if s.warm)
+
+    def stats(self) -> dict:
+        """Pool state for /healthz: size, parked, warm, deepest park depth."""
+        with self._lock:
+            depths = [s.park_depth for s in self._spares]
+            return {
+                "size": self.size,
+                "parked": len(self._spares),
+                "warm": sum(1 for d in depths if d > 0),
+                "deepest": max(depths, default=0),
+            }
 
     def close(self) -> None:
-        for s in self._spares:
-            s.kill()
-        self._spares = []
+        with self._lock:
+            for s in self._spares:
+                s.kill()
+            self._spares = []
 
 
 if __name__ == "__main__":

@@ -155,23 +155,43 @@ class WorkerGroup:
             if spare is not None:
                 # Promote a parked pre-imported interpreter: it applies env and
                 # redirection itself (dup2 on the given paths) and runs the
-                # script as __main__ — no spawn, no import bill.
+                # script as __main__ — no spawn, no import bill. The pool
+                # handed us its deepest-warmed spare; replacements are spawned
+                # AFTER the round is up (see the replenish thread below), so
+                # nothing here ever blocks on a Popen.
                 try:
+                    depth = spare.park_depth
                     proc = spare.unpark(
                         self.argv, env, stdout=stdout_path, stderr=stderr_path
                     )
-                    log.info(f"rank {grank}: promoted warm spare pid {proc.pid}")
+                    log.info(
+                        f"rank {grank}: promoted warm spare pid {proc.pid} "
+                        f"(park depth {depth})"
+                    )
                     # worker_pid, not pid: 'pid' is the Event's own identity
                     # field (the recording process — this launcher).
                     record_event(
                         "launcher", "worker_promoted", round=round_no,
                         global_rank=grank, worker_pid=proc.pid,
+                        outcome="promoted", park_depth=depth,
                     )
                 except OSError:
                     # The spare died between acquire() and the pipe write
                     # (EPIPE); fall through to a cold spawn.
                     spare.kill()
                     log.warning(f"rank {grank}: warm spare died at promotion; cold spawn")
+                    record_event(
+                        "launcher", "worker_promoted", round=round_no,
+                        global_rank=grank, outcome="dead_at_promotion",
+                    )
+            elif self.spare_pool is not None and self.spare_pool.size > 0:
+                # A pool exists but had nothing warm to give: the cold spawn
+                # below is a fallback worth counting (it IS the latency the
+                # pool exists to remove).
+                record_event(
+                    "launcher", "worker_promoted", round=round_no,
+                    global_rank=grank, outcome="cold_fallback",
+                )
             if proc is None:
                 if stdout_path is not None:
                     stdout = open(stdout_path, "ab")
@@ -202,10 +222,24 @@ class WorkerGroup:
             threading.Thread(
                 target=self._reap_and_signal, args=(w.proc,), daemon=True
             ).start()
+        if self.spare_pool is not None:
+            # Top the pool back up OFF the promotion critical path: the round's
+            # workers are already running; replacement Popen cost lands on a
+            # background thread, not on restart latency.
+            threading.Thread(
+                target=self._replenish_pool, daemon=True,
+                name="spare-replenish",
+            ).start()
         log.info(
             f"started {self.nproc} workers (global ranks "
             f"{first_global_rank}..{first_global_rank + self.nproc - 1} of {world_size})"
         )
+
+    def _replenish_pool(self) -> None:
+        try:
+            self.spare_pool.replenish()
+        except Exception:
+            log.exception("warm-spare pool replenish failed")
 
     def _reap_and_signal(self, proc: subprocess.Popen) -> None:
         try:
@@ -269,7 +303,14 @@ class WorkerGroup:
         while time.monotonic() < deadline:
             if all(w.exitcode is not None for w in self.workers):
                 break
-            time.sleep(0.1)
+            # The reaper threads set _change the instant any worker exits, so
+            # this wait returns in ~ms once the last one dies — teardown is on
+            # the restart critical path and must not poll it away in 100 ms
+            # ticks. Clear first (the exit that triggered this stop already
+            # set it); an exit racing the clear is caught by the timeout
+            # re-check. State truth stays with the poll above.
+            self._change.clear()
+            self._change.wait(0.02)
         for w in self.workers:
             if w.exitcode is None:
                 log.warning(f"worker rank {w.global_rank} ignored signal; SIGKILL")

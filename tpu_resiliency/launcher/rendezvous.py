@@ -15,17 +15,27 @@ same mechanism the in-process layer uses, rather than a bespoke keep-alive proto
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
 import threading
 import time
 from typing import Callable, Optional
 
-from tpu_resiliency.exceptions import FaultToleranceError, StoreError
+from tpu_resiliency.exceptions import BarrierTimeout, FaultToleranceError, StoreError
 from tpu_resiliency.platform.store import CoordStore, StoreView
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 from tpu_resiliency.utils.tracing import span
 
 log = get_logger(__name__)
+
+
+def _membership_digest(active: list[str], spares: list[str]) -> str:
+    """Order-sensitive digest of a round's cast: identical digest ⇒ identical
+    agents in identical rank order, so reusing the placement is sound."""
+    return hashlib.sha1(
+        json.dumps([list(active), list(spares)]).encode()
+    ).hexdigest()
 
 
 @dataclasses.dataclass
@@ -40,6 +50,14 @@ class RendezvousSettings:
     keep_alive_timeout: float = 20.0
     upscaling_enabled: bool = False
     poll_interval: float = 0.25
+    #: restart fast path: when a replacement round has the same agent
+    #: membership as the round being replaced (only worker processes changed),
+    #: re-admit the group with a single CAS + one barrier round instead of the
+    #: full open/join/last-call/close ladder
+    fast_path: bool = True
+    #: how long a fast-round member waits for its peers' confirmation barrier
+    #: before abandoning the reused round back to the full ladder
+    fast_path_timeout: float = 5.0
 
 
 @dataclasses.dataclass
@@ -53,6 +71,9 @@ class RendezvousOutcome:
     #: were still spawning workers (reading the epoch only at supervise start
     #: would lose those)
     epoch: int = 0
+    #: True when this placement came from the restart fast path (round reuse:
+    #: one CAS + one barrier instead of the full open/join/close ladder)
+    fast: bool = False
 
     @property
     def is_spare(self) -> bool:
@@ -82,6 +103,10 @@ class StoreRendezvous:
         self.s = settings
         self._ka_thread: Optional[threading.Thread] = None
         self._ka_stop = threading.Event()
+        #: (round, membership digest) of the last round this node was placed
+        #: in — the fast path's reuse key: a replacement round may ride the
+        #: single-CAS path only against exactly this membership
+        self._last_membership: Optional[tuple[int, str]] = None
 
     # -- keep-alive --------------------------------------------------------
 
@@ -185,7 +210,13 @@ class StoreRendezvous:
             "rendezvous", "rendezvous.round",
             prev_round=prev_round, node_id=self.node_id,
         ):
-            return self._next_round(prev_round)
+            out = self._next_round(prev_round)
+        # Remember the placed round's membership: the reuse key a future
+        # replacement round's fast path is gated on. Placement-less outcomes
+        # (idle-spare store-loss exits) must not seed a reuse key.
+        if out.active:
+            self._last_membership = (out.round, _membership_digest(out.active, out.spares))
+        return out
 
     def _next_round(self, prev_round: int) -> RendezvousOutcome:
         self.start_keepalive()
@@ -214,6 +245,15 @@ class StoreRendezvous:
                 )
             # Case 1: no state yet, or the last closed round is stale → open anew.
             if cur is None or (cur["status"] == "closed" and cur["round"] <= prev_round):
+                # Restart fast path first: when the stale round's membership is
+                # exactly the cast we were placed with (same agents, same
+                # order — only worker processes changed), one CAS republishes
+                # it as the replacement round and the loop re-reads straight
+                # into the acceptance barrier below. Any ineligibility (digest
+                # mismatch, dead agent, waiting upscaler, store hiccup) falls
+                # through to the full open/join/close ladder unchanged.
+                if cur is not None and self._try_fast_reuse(cur, prev_round):
+                    continue
                 # A REOPENED round expects the previous round's whole cast
                 # (actives, spares, waiting): whoever reopens first must not
                 # close a splinter world at last-call while a still-live peer
@@ -244,12 +284,20 @@ class StoreRendezvous:
             # Case 2: a closed round newer than what we had.
             if cur["status"] == "closed":
                 if me in cur["active"]:
+                    # A fast-reused round is only real once every active
+                    # confirms through its barrier — a member that diverged to
+                    # the full ladder (it saw a dead peer first) must starve
+                    # the barrier and force the reopen, not leave a splinter
+                    # world supervising orphaned workers.
+                    if cur.get("fast_from") and not self._confirm_fast_round(cur):
+                        continue  # abandoned: state has moved, re-read it
                     return RendezvousOutcome(
                         round=cur["round"],
                         node_rank=cur["active"].index(me),
                         active=list(cur["active"]),
                         spares=list(cur["spares"]),
                         epoch=cur.get("epoch", 0),
+                        fast=bool(cur.get("fast_from")),
                     )
                 if me in cur["spares"]:
                     return RendezvousOutcome(
@@ -258,6 +306,7 @@ class StoreRendezvous:
                         active=list(cur["active"]),
                         spares=list(cur["spares"]),
                         epoch=cur.get("epoch", 0),
+                        fast=bool(cur.get("fast_from")),
                     )
                 # Late arrival: advertise for the next (upscale) round.
                 if me not in cur.get("waiting", {}):
@@ -407,6 +456,117 @@ class StoreRendezvous:
             f"(node {me}, waiting for round > {prev_round})"
         )
 
+    # -- restart fast path (round reuse) -----------------------------------
+
+    def _try_fast_reuse(self, cur: dict, prev_round: int) -> bool:
+        """Attempt the single-CAS round reuse against stale closed state
+        ``cur``. True ⇒ a CAS was attempted (ours or a peer won the race) and
+        the caller should re-read state; False ⇒ ineligible, take the full
+        ladder. Eligibility is strict — any doubt degrades to the ladder:
+
+        - we were placed in exactly ``prev_round`` and ``cur`` IS that round;
+        - the membership digest matches our remembered placement (same agents,
+          same rank order — the "only locally-promoted ranks changed" case);
+        - no member of the cast is keep-alive-dead, and nobody is waiting for
+          an upscale round (both need the ladder's re-ranking).
+        """
+        if not self.s.fast_path or cur["round"] != prev_round:
+            return False
+        mem = self._last_membership
+        if mem is None or mem[0] != prev_round:
+            return False
+        digest = _membership_digest(cur.get("active", []), cur.get("spares", []))
+        if digest != mem[1]:
+            return False
+        me = self.node_id
+        if me not in cur["active"] and me not in cur["spares"]:
+            return False
+        if cur.get("waiting"):
+            return False
+        try:
+            if self.dead_nodes() & (set(cur["active"]) | set(cur["spares"])):
+                return False
+            epoch = self.restart_epoch()
+        except StoreError:
+            return False
+        nxt = {
+            "round": prev_round + 1,
+            "status": "closed",
+            "seq": cur["seq"] + 1,
+            "participants": {n: i for i, n in enumerate(cur["active"])},
+            "waiting": {},
+            "active": list(cur["active"]),
+            "spares": list(cur["spares"]),
+            "epoch": epoch,
+            "fast_from": digest,
+            # A later full reopen still owes the whole cast its mid-teardown
+            # grace, exactly as a ladder-closed round would.
+            "expected": sorted(set(cur["active"]) | set(cur["spares"])),
+        }
+        try:
+            ok = self._cas(cur, nxt)
+        except StoreError:
+            return False
+        if ok:
+            log.info(
+                f"[{me}] fast-path rendezvous: reused round {prev_round} "
+                f"membership as round {prev_round + 1}"
+            )
+            record_event(
+                "rendezvous", "rendezvous_fast_path", outcome="reused",
+                round=prev_round + 1, node_id=me, digest=digest,
+            )
+        # CAS failure means the state moved under us (a peer fast-closed the
+        # same round, or opened the full ladder) — either way, re-read.
+        return True
+
+    def _confirm_fast_round(self, cur: dict) -> bool:
+        """Active member's confirmation barrier for a fast-reused round. True
+        once every active arrived; False after abandoning the round (barrier
+        starved or store hiccup) — the caller re-reads state and proceeds
+        down the full ladder."""
+        me = self.node_id
+        try:
+            self.store.barrier_join(
+                f"fastbar/{cur['round']}",
+                cur["active"].index(me),
+                len(cur["active"]),
+                self.s.fast_path_timeout,
+            )
+            return True
+        except (BarrierTimeout, StoreError) as e:
+            log.warning(
+                f"[{me}] fast-path round {cur['round']} confirmation failed "
+                f"({e!r}); abandoning to the full ladder"
+            )
+            self._abandon_fast_round(cur)
+            return False
+
+    def _abandon_fast_round(self, cur: dict) -> None:
+        """Demote a fast-reused round that never confirmed: CAS it to an open
+        round so the full ladder re-forms the world. Best-effort — if the CAS
+        fails someone else already moved the state, which is just as good."""
+        nxt = {
+            "round": cur["round"] + 1,
+            "status": "open",
+            "seq": 1,
+            "participants": {self.node_id: 0},
+            "waiting": {},
+            "active": [],
+            "spares": [],
+            "expected": sorted(
+                set(cur.get("active", [])) | set(cur.get("spares", []))
+            ),
+        }
+        try:
+            if self._cas(cur, nxt):
+                record_event(
+                    "rendezvous", "rendezvous_fast_path", outcome="abandoned",
+                    round=cur["round"], node_id=self.node_id,
+                )
+        except StoreError:
+            pass
+
     def mark_exited(self) -> None:
         """Record that this agent's process is leaving (success or failure)."""
         self.store.set(f"exit/{self.node_id}", True)
@@ -499,6 +659,11 @@ class RestartWatcher:
 
     def stop(self) -> None:
         """Non-blocking: flag the thread down; it exits after its current
-        parked wait (daemon — it cannot outlive the process)."""
+        parked wait (daemon — it cannot outlive the process). No join, not
+        even a bounded one: stop() runs in the round-teardown path of every
+        restart, and the thread is parked in a multi-second store wait — a
+        100 ms join timeout here was a flat 100 ms tax on EVERY respawn
+        (visible as the rendezvous segment of BENCH_restart's decomposition).
+        A wake racing the flag is harmless: wake_fn only sets an Event whose
+        consumer re-reads store state for truth."""
         self._stop.set()
-        self._thread.join(timeout=0.1)

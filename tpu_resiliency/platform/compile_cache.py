@@ -1,0 +1,241 @@
+"""Persistent XLA compilation cache that survives in-job restarts.
+
+Every restarted worker used to re-trace and re-compile its step function from
+scratch — on real models that is the dominant residual cost of a warm-spare
+respawn (the interpreter floor is already paid, the XLA compile is not). This
+module wires JAX's persistent compilation cache (``jax_compilation_cache_dir``)
+into the launcher's env plumbing so round N+1's first step loads round N's
+executables instead of recompiling:
+
+- ``tpu-ft-launcher --compile-cache-dir DIR`` exports
+  :data:`CACHE_DIR_ENV` (and ``JAX_COMPILATION_CACHE_DIR`` for workers that
+  never import this package) to every worker, scoped under the run dir by
+  convention so one job's cache never collides with another's.
+- Workers apply it through :func:`apply_from_env` (called by
+  ``inprocess/wrap.py`` at engine start and by
+  ``platform/device.py:apply_platform_env``), which records ONE
+  ``compile_cache`` event per process — outcome ``hit`` (valid entries were
+  waiting), ``miss`` (cold cache), or ``miss_corrupt`` (damaged entries were
+  purged) — feeding ``tpu_compile_cache_total{outcome}`` and the goodput
+  ledger's restart attribution.
+
+Integrity posture (the ``ckpt`` plane's rule, applied here): a corrupt cache
+entry costs a cold compile, NEVER a crash and never a wrong executable. JAX
+itself degrades unreadable entries to a warning, but only at first use deep in
+a compile path; the sweep here verifies entries against a CRC **manifest**
+up front and deletes mismatches, so damage is detected, counted, and evented
+at process start — the same posture as the checkpoint recovery ladder's
+"quarantine, then recompute". Entries newer than the manifest (written after
+the last manifest refresh, e.g. by a worker that was SIGKILLed) cannot be
+judged and are left for JAX's own decode-failure fallback.
+
+The manifest is refreshed by the launcher after every round (the one process
+that survives worker churn) and at worker interpreter exit — both
+best-effort: a missing or stale manifest only narrows detection, never
+correctness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: exported by the launcher; consumed by :func:`apply_from_env` in workers
+CACHE_DIR_ENV = "TPU_RESILIENCY_COMPILE_CACHE_DIR"
+
+#: integrity manifest file kept inside the cache dir (never a cache entry:
+#: JAX entry files end in ``-cache``)
+MANIFEST_NAME = "MANIFEST.tpures.json"
+
+#: only files with this suffix are cache entries (JAX writes ``<key>-cache``
+#: payloads plus tiny ``-atime`` stamps we ignore)
+_ENTRY_SUFFIX = "-cache"
+
+#: process-level latch: the cache is applied (and its event recorded) once
+_applied: Optional[dict] = None
+
+
+def _entry_names(path: str) -> list[str]:
+    try:
+        return sorted(
+            n for n in os.listdir(path) if n.endswith(_ENTRY_SUFFIX)
+        )
+    except OSError:
+        return []
+
+
+def _digest_file(p: str) -> tuple[int, int]:
+    """(size, crc32) of a file, streamed."""
+    crc = 0
+    size = 0
+    with open(p, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    return size, crc
+
+
+def scan(path: str) -> dict[str, list[int]]:
+    """{entry_name: [size, crc32]} for every cache entry currently on disk."""
+    out: dict[str, list[int]] = {}
+    for name in _entry_names(path):
+        try:
+            size, crc = _digest_file(os.path.join(path, name))
+        except OSError:
+            continue  # racing writer/deleter: skip, never raise
+        out[name] = [size, crc]
+    return out
+
+
+def write_manifest(path: str) -> int:
+    """Atomically record the current entry digests; returns the entry count.
+    Best-effort: an unwritable cache dir is a log line, not a failure."""
+    entries = scan(path)
+    doc = {"version": 1, "entries": entries}
+    tmp = os.path.join(path, f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    except OSError:
+        log.debug("compile-cache manifest write failed", exc_info=True)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return len(entries)
+
+
+def read_manifest(path: str) -> dict[str, list[int]]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            doc = json.load(f)
+        entries = doc.get("entries")
+        return entries if isinstance(entries, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def sweep(path: str) -> dict:
+    """Verify manifest-covered entries; purge mismatches (truncated, bit-flipped,
+    torn) so they cost a cold compile instead of a decode failure — or worse.
+
+    Returns ``{"entries", "bytes", "purged", "unverified"}`` where ``entries``/
+    ``bytes`` count the cache AFTER the purge and ``unverified`` counts entries
+    newer than the manifest (left in place for JAX's own fallback).
+    """
+    manifest = read_manifest(path)
+    purged = 0
+    for name, want in sorted(manifest.items()):
+        p = os.path.join(path, name)
+        if not os.path.exists(p):
+            continue  # evicted/cleaned: not corruption
+        try:
+            size, crc = _digest_file(p)
+        except OSError:
+            continue
+        if [size, crc] != list(want):
+            log.warning(
+                f"compile cache entry {name} fails integrity "
+                f"({size}B/crc{crc:08x} != manifest {want}); purging — "
+                "this program will cold-compile"
+            )
+            for victim in (p, p[: -len(_ENTRY_SUFFIX)] + "-atime"):
+                try:
+                    os.unlink(victim)
+                except OSError:
+                    pass
+            purged += 1
+    entries = 0
+    total = 0
+    names = _entry_names(path)
+    for name in names:
+        try:
+            total += os.path.getsize(os.path.join(path, name))
+            entries += 1
+        except OSError:
+            continue
+    unverified = sum(1 for n in names if n not in manifest)
+    return {
+        "entries": entries, "bytes": total,
+        "purged": purged, "unverified": unverified,
+    }
+
+
+def outcome_of(stats: dict) -> str:
+    """Classify a sweep for the ``compile_cache`` event / metric."""
+    if stats.get("purged"):
+        return "miss_corrupt"
+    return "hit" if stats.get("entries") else "miss"
+
+
+def enable(path: str) -> dict:
+    """Sweep ``path``, point JAX's persistent compilation cache at it, and
+    register an exit-time manifest refresh. Returns the sweep stats.
+
+    Every failure mode degrades to a cold compile: an unusable directory or a
+    JAX without the cache config simply leaves caching off."""
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError:
+        log.warning(f"compile cache dir {path!r} unusable; caching disabled")
+        return {"entries": 0, "bytes": 0, "purged": 0, "unverified": 0,
+                "enabled": False}
+    stats = sweep(path)
+    stats["enabled"] = True
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Loopback/test programs compile in microseconds; without a zero
+        # threshold nothing under 1 s would ever be cached and every restart
+        # bench would read as a miss.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        log.warning("JAX persistent compilation cache unavailable", exc_info=True)
+        stats["enabled"] = False
+        return stats
+    import atexit
+
+    atexit.register(lambda: write_manifest(path))
+    return stats
+
+
+def apply_from_env(record: bool = True) -> Optional[dict]:
+    """Apply :data:`CACHE_DIR_ENV` once per process; None when unset or when
+    already applied. On first application records the ``compile_cache``
+    event (hit / miss / miss_corrupt + entry count and bytes)."""
+    global _applied
+    path = os.environ.get(CACHE_DIR_ENV, "")
+    if not path or _applied is not None:
+        return None
+    stats = enable(path)
+    stats["outcome"] = outcome_of(stats)
+    _applied = stats
+    if record and stats.get("enabled"):
+        from tpu_resiliency.utils.events import record as record_event
+
+        record_event(
+            "platform", "compile_cache",
+            outcome=stats["outcome"], entries=stats["entries"],
+            bytes=stats["bytes"], purged=stats["purged"],
+            unverified=stats["unverified"], dir=path,
+        )
+    return stats
+
+
+def refresh_manifest_from_env() -> None:
+    """Launcher-side post-round manifest refresh: covers workers that died
+    without their atexit hook (SIGKILL, OOM). Cheap — CRC of a few files."""
+    path = os.environ.get(CACHE_DIR_ENV, "")
+    if path and os.path.isdir(path):
+        write_manifest(path)

@@ -152,11 +152,77 @@ def apply_platform_env() -> None:
     Plugin boot code (sitecustomize) may force-select its platform via
     ``jax.config`` at interpreter start, after which the env var alone no longer
     wins. Scripts that honor ``JAX_PLATFORMS=cpu`` (benches, tools) call this
-    once before any backend use."""
+    once before any backend use. Also applies the launcher-exported persistent
+    compilation cache (``--compile-cache-dir``) when present, so a restarted
+    worker's first step loads the previous round's executables instead of
+    re-compiling."""
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    apply_compile_cache_env()
+
+
+def apply_compile_cache_env() -> None:
+    """Worker-side half of the ``--compile-cache-dir`` plumbing: point JAX's
+    persistent compilation cache at the launcher-exported directory (after the
+    integrity sweep — corrupt entries cost a cold compile, never a crash).
+    One-shot per process; a no-op when the launcher didn't export a dir."""
+    from tpu_resiliency.platform import compile_cache
+
+    compile_cache.apply_from_env()
+
+
+def warm_runtime() -> dict:
+    """Platform-safe runtime warmup for parked warm spares (``launcher/park.py``
+    ``--warm-spare-warmup runtime``): pre-pay everything a worker's first
+    backend use costs that does NOT touch an accelerator device.
+
+    The hard constraint: a parked spare coexists with the round's live workers
+    — and, at promotion time, with the *dying* worker whose device lease is
+    still held — so device-grabbing stays strictly post-promotion. Three
+    warmup levels, each gated:
+
+    - **plugin discovery**: enumerate (and import, which only *registers*)
+      PJRT plugin entry points — never initialize them.
+    - **tracing machinery**: a backend-free ``jax.eval_shape`` trace warms
+      jaxpr/lowering import chains.
+    - **CPU/loopback backend pre-init**: only when ``$JAX_PLATFORMS`` pins the
+      workload to ``cpu`` (tests, loopback benches, CPU jobs) — then the
+      backend the worker will use is the host CPU, which no dying worker can
+      hold a lease on, so full init + one dispatched op is safe and removes
+      backend-init from the promoted worker's first step.
+
+    Must not mutate ``os.environ`` or ``sys.path`` (promotion parity contract).
+    Raises on genuine breakage so the shim dies before writing its ready file
+    (startup death), rather than parking a half-warm interpreter.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    info: dict[str, Any] = {"plugins": 0, "traced": False, "cpu_init": False}
+    try:
+        from importlib import metadata
+
+        eps = metadata.entry_points()
+        group = (
+            eps.select(group="jax_plugins")
+            if hasattr(eps, "select")
+            else eps.get("jax_plugins", [])  # pre-3.10 metadata API
+        )
+        info["plugins"] = len(list(group))
+    except Exception:
+        pass  # discovery is best-effort; absence of plugins is normal
+    jax.eval_shape(
+        lambda x: jnp.tanh(x @ x.T).sum(),
+        jax.ShapeDtypeStruct((4, 4), jnp.float32),
+    )
+    info["traced"] = True
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        apply_platform_env()
+        jax.block_until_ready(jnp.zeros((8,), jnp.float32) + 1.0)
+        info["cpu_init"] = True
+    return info
 
 
 def device_liveness_probe(timeout: float = 30.0, device=None) -> bool:

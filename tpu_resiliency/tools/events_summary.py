@@ -121,9 +121,21 @@ def _fmt_worker_failed(p: dict) -> str:
 
 
 def _fmt_worker_promoted(p: dict) -> str:
+    outcome = p.get("outcome", "promoted")
+    if outcome == "dead_at_promotion":
+        return (
+            f"warm spare DIED at promotion -> rank {p.get('global_rank')} "
+            f"cold-spawned (round {p.get('round')})"
+        )
+    if outcome == "cold_fallback":
+        return (
+            f"no warm spare -> rank {p.get('global_rank')} cold-spawned "
+            f"(round {p.get('round')})"
+        )
+    depth = f", depth {p['park_depth']}" if p.get("park_depth") else ""
     return (
         f"warm spare promoted -> rank {p.get('global_rank')} "
-        f"(round {p.get('round')}, pid {p.get('worker_pid')})"
+        f"(round {p.get('round')}, pid {p.get('worker_pid')}{depth})"
     )
 
 

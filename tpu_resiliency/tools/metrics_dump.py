@@ -75,7 +75,6 @@ def render_report(reg: MetricsRegistry, out=None) -> None:
     for name, label in (
         ("tpu_rendezvous_rounds_total", "rendezvous rounds"),
         ("tpu_worker_failures_total", "worker failures"),
-        ("tpu_spare_promotions_total", "warm-spare promotions"),
         ("tpu_rank_terminations_total", "rank terminations"),
         ("tpu_budget_exhausted_total", "budget exhaustions"),
         ("tpu_ckpt_saves_total", "checkpoint saves"),
@@ -84,6 +83,22 @@ def render_report(reg: MetricsRegistry, out=None) -> None:
         n = _counter_total(reg, name)
         if n:
             print(f"    {label}: {int(n)}", file=out)
+    # Labelled restart-machinery rows: warm-spare promotion attempts by
+    # outcome (worker_promoted events), fast-path rendezvous, compile cache.
+    for name, label in (
+        ("tpu_spare_promotions_total", "warm-spare promotions"),
+        ("tpu_rendezvous_fast_path_total", "fast-path rendezvous"),
+        ("tpu_compile_cache_total", "compile cache"),
+    ):
+        by_outcome = {
+            dict(e["labels"]).get("outcome", "?"): e["value"]
+            for e in snap.get(name, [])
+        }
+        if by_outcome:
+            detail = " ".join(
+                f"{k}={int(v)}" for k, v in sorted(by_outcome.items())
+            )
+            print(f"    {label}: {detail}", file=out)
 
     span_lines = _latency_lines(reg, "tpu_span_seconds", "span")
     if span_lines:

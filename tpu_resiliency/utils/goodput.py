@@ -74,8 +74,9 @@ RESTART_SPANS = frozenset({"worker.spawn", "rendezvous.round", "inprocess.restar
 #: ``iteration_start`` (training actually resumed) is the restart cost an
 #: operator experiences — that whole span is charged to ``restart``.
 RESTART_EVIDENCE = frozenset({
-    "worker_failed", "restart_requested", "restart_signalled",
-    "hang_detected", "health_terminated", "rank_terminated",
+    "failure_detected", "worker_failed", "restart_requested",
+    "restart_signalled", "hang_detected", "health_terminated",
+    "rank_terminated",
 })
 
 #: spans whose duration is a caller-visible checkpoint stall
@@ -172,6 +173,9 @@ class GoodputLedger:
         self._step_max = 0.0
         #: rank -> {"first_ts", "last_ts", "train_s", "ckpt_stall_s", "steps"}
         self._ranks: dict[int, dict[str, float]] = {}
+        #: compile-cache outcomes (hit/miss/miss_corrupt) — restart-attribution
+        #: color: a "hit" restart skipped re-compilation, a "miss" paid it
+        self._compile_cache: dict[str, int] = {}
         #: per-phase seconds already published as goodput_update deltas
         self._published: dict[str, float] = {}
 
@@ -242,6 +246,9 @@ class GoodputLedger:
                 self._widen(ts - d)
             elif span in CKPT_STALL_SPANS:
                 self._stall(rec, ts, rank)
+        elif kind == "compile_cache":
+            outcome = str(rec.get("outcome", "?"))
+            self._compile_cache[outcome] = self._compile_cache.get(outcome, 0) + 1
         elif kind == "incident_opened":
             self._open_incidents.setdefault(rec.get("incident_id"), ts)
         elif kind == "incident_closed":
@@ -283,7 +290,7 @@ class GoodputLedger:
                 "phases": {p: 0.0 for p in (*PHASES, "unattributed")},
                 "goodput_ratio": 0.0, "steps": 0,
                 "step_seconds_mean": None, "step_seconds_max": None,
-                "ranks": {},
+                "ranks": {}, "compile_cache": {},
             }
         lo, hi = self._min_ts, self._max_ts
         wall = hi - lo
@@ -328,6 +335,9 @@ class GoodputLedger:
                 round(self._step_max, 6) if self._steps else None
             ),
             "ranks": ranks,
+            # Restart-attribution color: how many process starts found a warm
+            # compilation cache (skipped re-compile) vs paid a cold one.
+            "compile_cache": dict(sorted(self._compile_cache.items())),
         }
 
     def publish(

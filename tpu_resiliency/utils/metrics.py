@@ -568,9 +568,39 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
     elif kind == "worker_failed":
         reg.counter("tpu_worker_failures_total", "worker process failures").inc()
     elif kind == "worker_promoted":
+        # outcome: promoted | dead_at_promotion | cold_fallback (pre-label
+        # events from older builds read as plain promotions)
         reg.counter(
-            "tpu_spare_promotions_total", "warm-spare promotions"
+            "tpu_spare_promotions_total",
+            "warm-spare promotion attempts by outcome "
+            "(promoted | dead_at_promotion | cold_fallback)",
+            outcome=str(rec.get("outcome", "promoted")),
         ).inc()
+    elif kind == "warm_spare_pool":
+        if isinstance(rec.get("warm"), (int, float)):
+            reg.gauge(
+                "tpu_warm_spares_warm",
+                "parked spares currently warm (ready to promote)",
+            ).set(rec["warm"])
+    elif kind == "rendezvous_fast_path":
+        reg.counter(
+            "tpu_rendezvous_fast_path_total",
+            "restart fast-path rendezvous attempts by outcome "
+            "(reused | abandoned)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "compile_cache":
+        reg.counter(
+            "tpu_compile_cache_total",
+            "persistent compilation cache applications by outcome "
+            "(hit | miss | miss_corrupt)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.gauge(
+                "tpu_compile_cache_bytes",
+                "persistent compilation cache size at last application",
+            ).set(rec["bytes"])
     elif kind in ("hang_detected", "health_terminated"):
         reg.counter(
             "tpu_rank_terminations_total", "monitor-initiated terminations",
