@@ -1,0 +1,238 @@
+"""Benchmark elastic reshard: ranged peer fetch vs full-mirror retrieve.
+
+The scenario is the elastic headline: a 4-rank dp world checkpoints with
+layout meta, loses rank 3, and the 3 survivors resume resharded. Two ways to
+move the bytes a survivor newly owns:
+
+- **ranged** (`LocalCheckpointManager.load_resharded`): fetch ONLY the byte
+  ranges of the source shards the target rank's new blocks intersect, over
+  the `PeerExchange.fetch_ranges` wire op (per-range CRCs).
+- **full-mirror** (what the pre-reshard code forced): every needed source
+  container is retrieved WHOLE from a holder, then sliced locally — the
+  shape of `CliqueReplicationStrategy.retrieve`.
+
+Both paths run against the same on-disk root over loopback; the report
+records wall time and the peer bytes each moved. The interesting number is
+``bytes_ratio`` (ranged / full): the ranged path must move strictly fewer
+bytes — at this scenario's geometry roughly half a shard instead of whole
+containers — and the committed run is the regression anchor for
+``tests/checkpoint/test_reshard_perf.py``.
+
+    python scripts/bench_reshard.py [--mb 64] [--out BENCH_reshard.json]
+"""
+
+import argparse
+import concurrent.futures as cf
+import json
+import os
+import platform
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from tpu_resiliency.checkpoint import reshard as R  # noqa: E402
+from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm  # noqa: E402
+from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager  # noqa: E402
+from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy  # noqa: E402
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict  # noqa: E402
+from tpu_resiliency.platform.store import CoordStore, KVServer  # noqa: E402
+from tpu_resiliency.utils import events as tpu_events  # noqa: E402
+
+WORLD = [0, 1, 2, 3]
+SURVIVORS = [0, 1, 2]
+
+
+def _layout(mb: int):
+    # One dp-sharded tree of ~mb MB total: a handful of [rows, 4096] f32
+    # leaves, rows divisible by 4 so the saved world is uniform.
+    total = mb << 20
+    leaf_bytes = min(total, 16 << 20)
+    nleaves = max(1, total // leaf_bytes)
+    rows = leaf_bytes // (4096 * 4)
+    rows -= rows % 4
+    leaves = [R.LeafSpec((rows, 4096), "float32", ("dp",)) for _ in range(nleaves)]
+    return R.TreeLayout([("dp", 4)], WORLD, leaves)
+
+
+def _local_tree(layout, rank):
+    tree = {}
+    for i, spec in enumerate(layout.leaves):
+        shape = layout.box(i, rank).shape
+        rng = np.random.default_rng(rank * 1000 + i)
+        # Zero-padded keys: pytrees flatten in sorted-key order, which must
+        # match the layout's leaf order (save() validates this).
+        tree[f"leaf{i:03d}"] = rng.standard_normal(shape).astype(np.float32)
+    tree["step"] = 1
+    return tree
+
+
+def _run_world(ranks, fn, timeout=600):
+    with cf.ThreadPoolExecutor(max_workers=len(ranks)) as pool:
+        return [f.result(timeout=timeout) for f in [pool.submit(fn, r) for r in ranks]]
+
+
+def bench(mb: int) -> dict:
+    layout = _layout(mb)
+    srv = KVServer(host="127.0.0.1", port=0)
+    root = tempfile.mkdtemp(prefix="bench_reshard.")
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=120.0)
+        stores.append(s)
+        return s
+
+    def save_body(rank):
+        comm = StoreComm(mk(), rank, WORLD, timeout=120.0)
+        ex = PeerExchange(mk(), rank, timeout=120.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=2
+            )
+            mgr = LocalCheckpointManager(root, rank=rank, comm=comm, replication=strat)
+            mgr.save(
+                1, PyTreeStateDict(_local_tree(layout, rank)),
+                is_async=False, layout=layout,
+            )
+            mgr.close()
+        finally:
+            ex.close()
+
+    _run_world(WORLD, save_body)
+
+    seen = []
+    tpu_events.add_sink(seen.append)
+
+    # -- ranged path -------------------------------------------------------
+    def ranged_body(rank):
+        comm = StoreComm(mk(), rank, SURVIVORS, timeout=120.0, generation=1)
+        ex = PeerExchange(mk(), rank, timeout=120.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=2
+            )
+            mgr = LocalCheckpointManager(root, rank=rank, comm=comm, replication=strat)
+            t0 = time.perf_counter()
+            hollow, tensors, meta = mgr.load_resharded()
+            dt = time.perf_counter() - t0
+            mgr.close()
+            return dt, sum(t.nbytes for t in tensors)
+        finally:
+            ex.close()
+
+    ranged = _run_world(SURVIVORS, ranged_body)
+    ranged_s = max(dt for dt, _ in ranged)
+    ranged_peer = sum(
+        e.payload["bytes"] for e in seen
+        if e.kind == "reshard_fetch" and e.payload.get("via") == "peer"
+    )
+    ranged_local = sum(
+        e.payload["bytes"] for e in seen
+        if e.kind == "reshard_fetch" and e.payload.get("via") == "local"
+    )
+
+    # -- full-mirror baseline ---------------------------------------------
+    # Same target geometry, but every source container a rank cannot serve
+    # locally is fetched WHOLE (all leaves, full ranges) before slicing —
+    # the pre-reshard shape of recovery.
+    source = layout
+    target = source.retarget(SURVIVORS)
+    plan = R.build_plan(source, target)
+
+    def full_body(rank):
+        comm = StoreComm(mk(), rank, SURVIVORS, timeout=120.0, generation=2)
+        ex = PeerExchange(mk(), rank, timeout=120.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=2
+            )
+            mgr = LocalCheckpointManager(root, rank=rank, comm=comm, replication=strat)
+            held = {i.owner for i in mgr.local_ids() if i.iteration == 1}
+            all_held = comm.all_gather((rank, sorted(held)), tag="bench-held")
+            holders = {r: set(h) for r, h in all_held}
+            needed = set()
+            for seg in plan.for_rank(rank).segments:
+                if not (set(seg.owners) & held):
+                    needed.add(sorted(seg.owners)[0])
+            t0 = time.perf_counter()
+            moved = 0
+            for owner in sorted(needed):
+                holder = min(r for r, h in holders.items() if owner in h and r != rank)
+                full = [
+                    [i, 0, source.local_nbytes(i, owner)]
+                    for i in range(len(source.leaves))
+                ]
+                _, parts = ex.fetch_ranges(
+                    holder,
+                    {"session": 0, "iteration": 1, "owner": owner, "ranges": full},
+                )
+                moved += sum(memoryview(p).nbytes for p in parts)
+            dt = time.perf_counter() - t0
+            comm.barrier(tag="bench-full-done")
+            mgr.close()
+            return dt, moved
+        finally:
+            ex.close()
+
+    full = _run_world(SURVIVORS, full_body)
+    full_s = max(dt for dt, _ in full)
+    full_peer = sum(moved for _, moved in full)
+    tpu_events.remove_sink(seen.append)
+
+    for s in stores:
+        s.close()
+    srv.close()
+    import shutil
+
+    shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "host": platform.node(),
+        "world": len(WORLD),
+        "shrink_to": len(SURVIVORS),
+        "mb": mb,
+        "ranged_s": round(ranged_s, 4),
+        "ranged_peer_bytes": ranged_peer,
+        "ranged_local_bytes": ranged_local,
+        "full_s": round(full_s, 4),
+        "full_peer_bytes": full_peer,
+        "bytes_ratio": round(ranged_peer / full_peer, 4) if full_peer else None,
+        "speedup": round(full_s / ranged_s, 2) if ranged_s else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mb", type=int, default=64, help="total tree size (MB)")
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny payload, assert the bytes win, exit 0/1")
+    args = ap.parse_args(argv)
+    mb = 2 if args.smoke else args.mb
+    res = bench(mb)
+    print(json.dumps(res, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=2)
+            f.write("\n")
+    if args.smoke:
+        ok = (
+            res["full_peer_bytes"] > 0
+            and res["ranged_peer_bytes"] < res["full_peer_bytes"]
+        )
+        print(f"bench_reshard smoke: {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

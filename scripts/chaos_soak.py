@@ -1,6 +1,6 @@
 """Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Six scenarios, each asserting the job converges to a CORRECT final state
+Seven scenarios, each asserting the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -19,6 +19,12 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   variant): every rank agrees on and loads the older iteration. Both variants
   assert ``ckpt_quarantined`` events and ``tpu_ckpt_integrity_failures_total``
   in the aggregated metrics.
+- **elastic**: the shrink-and-continue chain — a 4-rank dp world checkpoints
+  with layout meta, the seed-chosen victim is preempted (disk gone), the
+  survivors resume resharded (``load_resharded``) and save at the shrunken
+  layout, then the victim returns wiped and the wide world reshards back up.
+  Convergence = every resumed world byte-identical, the shrink's peer traffic
+  strictly less than whole mirrors, ``tpu_reshard_*`` metrics aggregate.
 - **launcher**: the real ``tpu-ft-launcher`` restart chain (worker fails round
   0, succeeds round 1) with FT monitors on, under env-propagated chaos hitting
   the store AND ipc channels. Convergence = exit 0 + the events file shows at
@@ -316,6 +322,170 @@ def scenario_disk(seed: int, fallback: bool = False, spec: str | None = None):
         srv.close()
         shutil.rmtree(root, ignore_errors=True)
     return plan.schedule()
+
+
+# -- scenario: elastic shrink / resharded resume / re-expand ------------------
+
+#: A light network plan rides along (sender-retried, MUST converge) so the
+#: elastic chain is exercised under the same fault pressure as the others.
+ELASTIC_SPEC = "{seed}:p2p.send.reset@at=3;store.send.reset@at=7"
+
+
+def scenario_elastic(seed: int, spec: str | None = None):
+    """Seeded preemption of one rank mid-run → shrink → resharded resume →
+    save at the shrunken layout → re-expand → resharded resume again.
+
+    The seed picks the victim rank. Convergence = every resumed world's
+    reassembled global state is byte-identical to what the full world saved,
+    the shrink fetched strictly newly-owned ranges (peer bytes < a full
+    shard), and the ``tpu_reshard_*`` metrics aggregate from the events
+    stream. Returns ``(injection_schedule, victim, per-phase byte splits)`` —
+    the whole tuple must reproduce run-to-run per seed."""
+    import shutil
+    import numpy as np
+
+    from tpu_resiliency.checkpoint import reshard as ckpt_reshard
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils.metrics import aggregate
+
+    world = 4
+    victim = seed % world
+    survivors = [r for r in range(world) if r != victim]
+    plan = chaos.ChaosPlan.parse(spec or ELASTIC_SPEC.format(seed=seed))
+    chaos.install_plan(plan)
+    seen: list = []
+    tpu_events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0)
+    root = tempfile.mkdtemp(prefix="chaos_elastic.")
+    stores: list = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=30.0)
+        stores.append(s)
+        return s
+
+    G = np.arange(32 * 8, dtype=np.float32).reshape(32, 8) * 3.0
+    layout4 = ckpt_reshard.TreeLayout(
+        [("dp", world)], list(range(world)),
+        [ckpt_reshard.LeafSpec(G.shape, "float32", ("dp",))],
+    )
+
+    def mgr_for(rank, ranks, gen, ex):
+        comm = StoreComm(mk(), rank, ranks, timeout=60.0, generation=gen)
+        strat = CliqueReplicationStrategy(
+            comm, ex, replication_jump=1, replication_factor=2
+        )
+        return LocalCheckpointManager(
+            root, rank=rank, comm=comm, replication=strat, keep=2
+        )
+
+    def full_save(rank):
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            mgr = mgr_for(rank, list(range(world)), 0, ex)
+            tree = {"w": ckpt_reshard.slice_local([G], layout4, rank)[0],
+                    "step": 1}
+            mgr.save(1, PyTreeStateDict(tree), is_async=False, layout=layout4)
+            mgr.close()
+        finally:
+            ex.close()
+
+    def shrink_resume_and_save(rank):
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            mgr = mgr_for(rank, survivors, 1, ex)
+            hollow, tensors, meta = mgr.load_resharded()
+            got = np.asarray(tensors[0]).copy()
+            layout_m = ckpt_reshard.TreeLayout.from_meta(meta["layout"])
+            mgr.save(
+                2, PyTreeStateDict({"w": got, "step": 2}),
+                is_async=False, layout=layout_m,
+            )
+            mgr.close()
+            return got
+        finally:
+            ex.close()
+
+    def expand_resume(rank):
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            mgr = mgr_for(rank, list(range(world)), 2, ex)
+            hollow, tensors, meta = mgr.load_resharded()
+            got = np.asarray(tensors[0]).copy()
+            mgr.close()
+            return got, meta["iteration"]
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(full_save, r) for r in range(world)]:
+                f.result(timeout=120)
+        # The seeded preemption: the victim's node is gone (its disk with it).
+        shutil.rmtree(os.path.join(root, "s0", f"r{victim}"), ignore_errors=True)
+        with cf.ThreadPoolExecutor(max_workers=len(survivors)) as pool:
+            shrunk = [
+                f.result(timeout=120)
+                for f in [pool.submit(shrink_resume_and_save, r) for r in survivors]
+            ]
+        layout_m = layout4.retarget(survivors)
+        for rank, got in zip(survivors, shrunk):
+            want = ckpt_reshard.slice_local([G], layout_m, rank)[0]
+            assert np.array_equal(got, want), (
+                f"rank {rank}: shrunken resume not byte-identical"
+            )
+        # Re-expand: the victim returns with a wiped disk; the newest
+        # iteration is the SHRUNKEN world's save, so this leg is a true grow.
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            grown = [
+                f.result(timeout=120)
+                for f in [pool.submit(expand_resume, r) for r in range(world)]
+            ]
+        for rank, (got, it) in zip(range(world), grown):
+            want = ckpt_reshard.slice_local([G], layout4, rank)[0]
+            assert it == 2, f"rank {rank} resumed iteration {it}, wanted 2"
+            assert np.array_equal(got, want), (
+                f"rank {rank}: re-expanded resume not byte-identical"
+            )
+        plans = [e for e in seen if e.kind == "reshard_plan"]
+        directions = sorted({e.payload["direction"] for e in plans})
+        assert directions == ["grow", "shrink"], directions
+        fetches = [e for e in seen if e.kind == "reshard_fetch"]
+        shard_bytes = layout4.local_nbytes(0, 0)
+        shrink_peer = sum(
+            e.payload["bytes"] for e in fetches
+            if e.payload.get("via") == "peer"
+            and any(p.payload["direction"] == "shrink"
+                    and p.payload["rank"] == e.payload["rank"] for p in plans)
+        )
+        assert 0 < shrink_peer < len(survivors) * shard_bytes, (
+            f"shrink moved {shrink_peer} peer bytes (full shard is "
+            f"{shard_bytes}) — the ranged path should move strictly less "
+            f"than whole mirrors"
+        )
+        reg = aggregate([{"kind": e.kind, **e.payload} for e in seen])
+        prom = reg.to_prometheus()
+        for want in ("tpu_reshard_bytes_total", "tpu_reshard_ranks_total",
+                     'direction="shrink"', 'direction="grow"'):
+            assert want in prom, f"{want} missing:\n{prom[:2000]}"
+        splits = sorted(
+            (e.payload["rank"], e.payload["direction"],
+             e.payload["local_bytes"], e.payload["peer_bytes"])
+            for e in plans
+        )
+    finally:
+        chaos.clear_plan()
+        tpu_events.remove_sink(seen.append)
+        for s in stores:
+            s.close()
+        srv.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return (plan.schedule(), victim, splits)
 
 
 # -- scenario: mixed multi-fault campaign ------------------------------------
@@ -785,6 +955,14 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert f1 == f2, f"disk-fallback schedule not reproducible:\n{f1}\n{f2}"
     out["disk_injections"] = [list(i) for i in d1]
     out["disk_fallback_injections"] = [list(i) for i in f1]
+    # Elastic shrink → resharded resume → re-expand, twice per seed: the
+    # (injection schedule, victim, per-rank byte splits) must reproduce.
+    e1 = scenario_elastic(seed)
+    e2 = scenario_elastic(seed)
+    assert e1 == e2, f"elastic schedule not reproducible:\n{e1}\n{e2}"
+    out["elastic_victim"] = e1[1]
+    out["elastic_splits"] = [list(s) for s in e1[2]]
+    out["elastic_injections"] = [list(i) for i in e1[0]]
     # Mixed multi-fault campaign (straggler + network + disk), twice per seed:
     # the combined schedule must reproduce exactly like the single-channel ones.
     mixed_dir = os.path.join(workdir, f"mixed_{seed}")

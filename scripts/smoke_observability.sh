@@ -174,7 +174,38 @@ python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --goodput --forma
     python -c "import json,sys; d=json.load(sys.stdin); assert d['phases']['restart']>0 and d['phases']['ckpt_stall']>0, d" \
     || { echo "FAIL: offline --goodput lost the restart/ckpt attribution"; exit 1; }
 
-echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign)"
+echo "== smoke: elastic reshard (ranged fetch moves fewer bytes than full mirrors)"
+python scripts/bench_reshard.py --smoke
+
+echo "== smoke: elastic reshard plan preflight (ckpt_info --plan)"
+RS="$WORKDIR/reshard"
+mkdir -p "$RS"
+python - "$RS" <<'PY'
+import os, sys
+import numpy as np
+from tpu_resiliency.checkpoint import reshard as R
+from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+
+root = os.path.join(sys.argv[1], "root")
+G = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+layout = R.TreeLayout([("dp", 2)], [0, 1], [R.LeafSpec(G.shape, "float32", ("dp",))])
+for rank in (0, 1):
+    m = LocalCheckpointManager(root, rank=rank)
+    m.save(1, PyTreeStateDict({"w": R.slice_local([G], layout, rank)[0]}),
+           is_async=False, layout=layout)
+    m.close()
+PY
+python -m tpu_resiliency.tools.ckpt_info "$RS/root" --world 0 --plan | sed 's/^/    /'
+rm -rf "$RS/root/s0/r1"
+if python -m tpu_resiliency.tools.ckpt_info "$RS/root" --world 0 --plan > "$RS/plan.out" 2>&1; then
+    echo "FAIL: --plan missed the uncovered source rank"; exit 1
+else
+    grep -q "UNCOVERED" "$RS/plan.out" || { echo "FAIL: --plan exit 1 without naming the gap"; exit 1; }
+    echo "reshard plan OK: --plan caught the uncovered rank (exit 1 as designed)"
+fi
+
+echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign + elastic chain)"
 python scripts/chaos_soak.py --smoke --workdir "$WORKDIR/chaos"
 
 echo "== smoke: incident plane (artifact renders + tpu_incident_*/tpu_remediation_* metrics)"

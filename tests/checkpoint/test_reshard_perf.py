@@ -1,0 +1,43 @@
+"""Perf acceptance for elastic reshard (slow; tier-1 deselects ``-m slow``).
+
+Runs ``scripts/bench_reshard.py`` at a CI-sized payload and asserts the
+ACCEPTANCE byte claim: the ranged-fetch path moves strictly fewer peer bytes
+than a full-mirror retrieve of the same shrink. The committed 64 MB results
+live in ``BENCH_reshard.json``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.mark.slow
+def test_ranged_fetch_moves_strictly_fewer_bytes(tmp_path):
+    out = tmp_path / "bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_reshard.py"),
+            "--mb", "8", "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    res = json.loads(out.read_text())
+    assert res["full_peer_bytes"] > 0, res
+    # The acceptance criterion: strictly fewer bytes on the wire than a
+    # full-mirror retrieve (here the survivor's new block is a fraction of
+    # the source shard, so the margin is structural, not noise).
+    assert res["ranged_peer_bytes"] < res["full_peer_bytes"], res
+    assert res["bytes_ratio"] < 0.9, res
+    # And the local-slice path did real work (mirrors served in place).
+    assert res["ranged_local_bytes"] > 0, res

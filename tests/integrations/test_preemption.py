@@ -162,3 +162,86 @@ def test_no_distributed_client_is_noop():
     cb = PreemptionCheckpointCallback(on_preemption=lambda s, i: fired.append(i))
     ctx = run_training(lambda s, i: s, {"w": 0}, num_steps=5, callbacks=[cb])
     assert ctx.step == 5 and not fired and cb.preempted_at is None
+
+
+def test_notice_defers_until_inflight_save_commits(tmp_path, monkeypatch):
+    """REGRESSION (elastic reshard PR): a preemption notice landing DURING an
+    in-flight async save must wait for the commit/rename — otherwise the
+    grace-window save interleaves with the background writer and the "latest"
+    iteration at shrink time can be torn."""
+    import time as time_mod
+
+    import numpy as np
+
+    from tpu_resiliency.checkpoint import format as ckpt_format
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.integrations import PreemptionCheckpointCallback
+    from tpu_resiliency.integrations.loop import LoopContext
+
+    root = str(tmp_path / "ckpt")
+    mgr = LocalCheckpointManager(root, rank=0, comm=None)
+
+    # Slow the background container write down so the async save is
+    # deterministically still in flight when the notice fires.
+    real_write_stream = ckpt_format.write_stream
+
+    def slow_write_stream(path, chunks, fsync=True):
+        time_mod.sleep(0.4)
+        return real_write_stream(path, chunks, fsync=fsync)
+
+    monkeypatch.setattr(ckpt_format, "write_stream", slow_write_stream)
+    sd = PyTreeStateDict({"w": np.arange(64, dtype=np.float32), "step": 5})
+    mgr.save(5, sd, is_async=True)
+
+    observed = {}
+
+    def on_preemption(state, step):
+        # The contract under test: by the time the final save runs, the
+        # in-flight save has fully committed — visible container, no torn
+        # ``.dirty`` temp, nothing left in the async queue.
+        rdir = os.path.join(root, "s0", "r0")
+        names = os.listdir(rdir)
+        observed["names"] = names
+        observed["dirty"] = [n for n in names if n.endswith(".dirty")]
+        observed["committed"] = "iter_0000005_0_local.ckpt" in names
+        observed["queue_drained"] = mgr.queue.maybe_finalize_async_calls() == []
+
+    monkeypatch.setattr(
+        PreemptionCheckpointCallback, "_reached", staticmethod(lambda step: True)
+    )
+    cb = PreemptionCheckpointCallback(
+        on_preemption=on_preemption, ckpt_manager=mgr
+    )
+    ctx = LoopContext(step=6, state={"w": None})
+    cb.on_step_end(ctx)
+    mgr.close()
+    assert observed["committed"], observed
+    assert not observed["dirty"], observed
+    assert observed["queue_drained"], observed
+    assert ctx.should_stop and cb.preempted_at == 6
+
+
+def test_drain_failure_does_not_eat_the_grace_window():
+    """A broken background save must not block the final preemption save."""
+    from tpu_resiliency.integrations import PreemptionCheckpointCallback
+    from tpu_resiliency.integrations.loop import LoopContext
+
+    order = []
+
+    class BrokenMgr:
+        def maybe_finalize(self, blocking=False):
+            order.append(("drain", blocking))
+            raise RuntimeError("background writer died")
+
+    import unittest.mock as mock
+
+    with mock.patch.object(
+        PreemptionCheckpointCallback, "_reached", staticmethod(lambda s: True)
+    ):
+        cb = PreemptionCheckpointCallback(
+            on_preemption=lambda s, i: order.append(("save", i)),
+            ckpt_manager=BrokenMgr(),
+        )
+        cb.on_step_end(LoopContext(step=3))
+    assert order == [("drain", True), ("save", 3)]

@@ -36,6 +36,24 @@ def test_replication_scenario_converges_and_reproduces():
     assert "reset" in kinds and "truncate" in kinds
 
 
+def test_elastic_scenario_converges_and_reproduces():
+    """The shrink-and-continue chain (seeded victim preemption → resharded
+    resume → shrunken-layout save → re-expand): the (injection schedule,
+    victim, per-rank byte split) tuple reproduces from the seed, and the
+    byte-identity + strictly-fewer-peer-bytes assertions run inside the
+    scenario."""
+    e1 = chaos_soak.scenario_elastic(seed=77)
+    e2 = chaos_soak.scenario_elastic(seed=77)
+    assert e1 == e2, "same-seed elastic runs diverged"
+    schedule, victim, splits = e1
+    assert victim == 77 % 4
+    directions = {d for _, d, _, _ in splits}
+    assert directions == {"shrink", "grow"}
+    # the victim's grow resume is pure peer fetch (its disk was wiped)
+    victim_grow = [s for s in splits if s[0] == victim and s[1] == "grow"]
+    assert victim_grow and victim_grow[0][2] == 0 and victim_grow[0][3] > 0
+
+
 def test_launcher_restart_chain_under_chaos(tmp_path):
     """The real launcher + FT monitors: worker fails round 0, chaos hits the
     store and ipc channels (≥1 reset + ≥1 truncation each, per the events

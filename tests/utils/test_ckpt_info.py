@@ -126,3 +126,74 @@ def test_scan_survives_session_dir_unlinked_mid_audit(tmp_path, monkeypatch):
 
 def test_scan_survives_root_unlinked(tmp_path):
     assert ckpt_info.scan(str(tmp_path / "gone")) == []
+
+
+def _save_layout_root(tmp_path):
+    """A 2-rank layout-bearing root written by real managers (no comm: each
+    rank's own shard only — plus hand-mirrored copies for the plan split)."""
+    from tpu_resiliency.checkpoint import reshard as R
+
+    root = str(tmp_path)
+    G = np.arange(12 * 2, dtype=np.float32).reshape(12, 2)
+    layout = R.TreeLayout(
+        [("dp", 2)], [0, 1], [R.LeafSpec((12, 2), "float32", ("dp",))]
+    )
+    for rank in (0, 1):
+        mgr = LocalCheckpointManager(root, rank=rank)
+        mgr.save(
+            1,
+            PyTreeStateDict({"w": R.slice_local([G], layout, rank)[0]}),
+            is_async=False,
+            layout=layout,
+        )
+        mgr.close()
+    return root
+
+
+def test_plan_renders_split_and_exits_zero(tmp_path):
+    root = _save_layout_root(tmp_path)
+    out = io.StringIO()
+    rc = ckpt_info.render_plan(ckpt_info.scan(root)[0], {0}, out=out)
+    text = out.getvalue()
+    assert rc == 0, text
+    assert "reshard plan 2 -> 1 ranks (shrink)" in text
+    assert "via local" in text and "via peer-fetch" in text
+    assert "coverage: OK for world [0]" in text
+
+
+def test_plan_uncovered_exits_one_naming_ranks(tmp_path):
+    import shutil
+
+    root = _save_layout_root(tmp_path)
+    shutil.rmtree(os.path.join(root, "s0", "r1"))
+    out = io.StringIO()
+    rc = ckpt_info.render_plan(ckpt_info.scan(root)[0], {0}, out=out)
+    text = out.getvalue()
+    assert rc == 1, text
+    assert "UNCOVERED: no surviving copy of source rank(s) [1]" in text
+
+
+def test_plan_cli_main(tmp_path, capsys):
+    root = _save_layout_root(tmp_path)
+    assert ckpt_info.main([root, "--world", "0", "--plan"]) == 0
+    assert "reshard plan" in capsys.readouterr().out
+    # --plan without --world is a usage error
+    assert ckpt_info.main([root, "--plan"]) == 2
+    # explicit axes spec parses and plans
+    assert (
+        ckpt_info.main(
+            [root, "--world", "0,1", "--plan", "--axes", "dp=1,tp=2"]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "target axes {'dp': 1, 'tp': 2}" in out
+
+
+def test_plan_without_layout_meta_exits_one(tmp_path, capsys):
+    root = str(tmp_path)
+    mgr = LocalCheckpointManager(root, rank=0)
+    _save(mgr, 1, 0.0)
+    mgr.close()
+    assert ckpt_info.main([root, "--world", "0", "--plan"]) == 1
+    assert "no containers carry reshard layout" in capsys.readouterr().out

@@ -13,6 +13,8 @@ TPU-native re-design of the reference's ``checkpointing/`` package (SURVEY §2.6
 - :mod:`~tpu_resiliency.checkpoint.replication` — clique replication + exchange plans.
 - :mod:`~tpu_resiliency.checkpoint.local_manager` — per-rank local checkpoint manager
   with coverage-based ``find_latest``.
+- :mod:`~tpu_resiliency.checkpoint.reshard` — elastic resharding: repartition
+  plans mapping any saved world's shards onto any target world/topology.
 """
 
 from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer
@@ -31,6 +33,12 @@ from tpu_resiliency.checkpoint.replication import (
     LazyCliqueReplicationStrategy,
     group_sequence_for,
     parse_group_sequence,
+)
+from tpu_resiliency.checkpoint.reshard import (
+    LeafSpec,
+    ReshardPlan,
+    TreeLayout,
+    build_plan,
 )
 from tpu_resiliency.checkpoint.staging import HostStagingPool, StagingLease
 from tpu_resiliency.checkpoint.state_dict import (
@@ -60,4 +68,8 @@ __all__ = [
     "StagingLease",
     "PyTreeStateDict",
     "TensorPlaceholder",
+    "TreeLayout",
+    "LeafSpec",
+    "ReshardPlan",
+    "build_plan",
 ]

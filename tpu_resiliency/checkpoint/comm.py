@@ -18,12 +18,15 @@ accelerator interconnect is reserved for the training program; checkpoint coordi
 from __future__ import annotations
 
 import hmac
+import itertools
 import os
+import pickle
 import secrets
 import socket
+import struct
 import threading
 import time
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from tpu_resiliency.exceptions import CheckpointError, StoreTimeoutError
 from tpu_resiliency.platform import chaos, framing
@@ -35,6 +38,9 @@ log = get_logger(__name__)
 
 # Checkpoint shards can be large; allow 16 GB frames on p2p links.
 P2P_MAX_FRAME = 16 * 1024**3
+
+#: length prefix framing inside a ranged-read reply payload (header pickle).
+_RR_LEN = struct.Struct("<Q")
 
 
 def _transfer_event(direction: str, nbytes: int, dt: float, **extra) -> None:
@@ -249,6 +255,12 @@ class PeerExchange:
         self._shutdown = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._addr_cache: dict[int, tuple[str, int]] = {}
+        #: ranged-read server: ``handler(request) -> (extra_header, parts)``
+        #: registered by :meth:`serve_ranges` (the local checkpoint manager
+        #: wires its shard files in); requests arrive as ``op: range_read``
+        #: frames and are answered by dialing the requester back.
+        self._range_handler: Optional[Callable] = None
+        self._rr_counter = itertools.count()
 
     def start(self, host: Optional[str] = None, advertise_host: Optional[str] = None) -> None:
         """Bind the listener and publish its address.
@@ -344,6 +356,11 @@ class PeerExchange:
             )
             if kind == "bulk":
                 src, tag = msg["src"], msg["tag"]
+            elif isinstance(msg, dict) and msg.get("op") == "range_read":
+                # Request/response op, not inbox traffic: serve it on this
+                # connection's thread (the reply dials the requester back).
+                self._handle_range_read(msg)
+                return
             else:
                 src, tag, payload = msg["src"], msg["tag"], msg["blob"]
             nbytes = memoryview(payload).cast("B").nbytes if payload is not None else 0
@@ -620,6 +637,124 @@ class PeerExchange:
             )
         view[:n] = gv
         return n
+
+    # -- ranged reads (the elastic-reshard wire op) ------------------------
+
+    def serve_ranges(self, handler: Optional[Callable]) -> None:
+        """Register (or clear, with ``None``) the ranged-read server.
+
+        ``handler(request: dict) -> (extra_header: dict, parts: list)`` runs
+        on a p2p connection thread for every incoming ``range_read`` frame:
+        it resolves the request (for checkpoints: an ``(owner, iteration)``
+        container plus leaf-relative byte ranges — see
+        ``LocalCheckpointManager``) and returns the byte parts to ship back.
+        Exceptions become structured error replies, never dropped requests.
+        """
+        self._range_handler = handler
+
+    def fetch_ranges(
+        self, dst: int, request: dict, timeout: Optional[float] = None
+    ) -> tuple[dict, list[memoryview]]:
+        """Read byte ranges from a peer: one small request frame out, one bulk
+        reply back, each part CRC-verified (the PR-5 checksummer) before it is
+        returned. The reshard load path fetches ONLY the ranges a rank newly
+        owns this way, instead of retrieving whole mirror containers.
+
+        Returns ``(reply_header, parts)`` — parts are zero-copy views over the
+        reply's receive buffer, ordered like ``request["ranges"]``. Raises
+        :class:`CheckpointError` on a structured error reply, a checksum
+        mismatch, or transport failure (after the per-peer retry policy).
+        """
+        tag = f"rread/{self.rank}/{next(self._rr_counter)}"
+        frame = {"op": "range_read", "src": self.rank, "reply_tag": tag,
+                 "req": request}
+
+        def attempt():
+            conn, _ = self._dial(dst)
+            with conn:
+                framing.send_obj(conn, frame)
+
+        self._retry_send(dst, f"range_read({tag!r})", attempt)
+        payload = self.recv(dst, tag, timeout)
+        return self._parse_range_reply(payload, dst)
+
+    def _parse_range_reply(
+        self, payload, src: int
+    ) -> tuple[dict, list[memoryview]]:
+        from tpu_resiliency.checkpoint import format as ckpt_format
+
+        mv = memoryview(payload).cast("B")
+        try:
+            (hlen,) = _RR_LEN.unpack(mv[: _RR_LEN.size])
+            header = pickle.loads(mv[_RR_LEN.size : _RR_LEN.size + hlen])
+        except Exception as e:
+            raise CheckpointError(
+                f"p2p: malformed range_read reply from rank {src} ({e!r})"
+            ) from e
+        if not header.get("ok"):
+            raise CheckpointError(
+                f"p2p: range_read against rank {src} failed: "
+                f"{header.get('error', 'unknown error')}"
+            )
+        parts: list[memoryview] = []
+        off = _RR_LEN.size + hlen
+        lengths = header.get("lengths") or []
+        crcs = header.get("crc32c") or []
+        verify = header.get("crc_algo") == ckpt_format.CRC_ALGO and len(
+            crcs
+        ) == len(lengths)
+        for i, n in enumerate(lengths):
+            n = int(n)
+            if off + n > mv.nbytes:
+                raise CheckpointError(
+                    f"p2p: truncated range_read reply from rank {src} "
+                    f"(part {i} wants {n} bytes past the frame)"
+                )
+            window = mv[off : off + n]
+            # Per-range verification: each range is checksummed by the sender
+            # and re-checked here before the caller ever sees the bytes.
+            if verify and ckpt_format.crc32c(window) != crcs[i]:
+                raise CheckpointError(
+                    f"p2p: range_read part {i} from rank {src} failed its "
+                    f"checksum (range corrupted in flight)"
+                )
+            parts.append(window)
+            off += n
+        return header, parts
+
+    def _handle_range_read(self, msg: dict) -> None:
+        from tpu_resiliency.checkpoint import format as ckpt_format
+
+        try:
+            src, tag = int(msg["src"]), str(msg["reply_tag"])
+        except (KeyError, TypeError, ValueError):
+            log.warning("p2p: dropped malformed range_read request")
+            return
+        handler = self._range_handler
+        views: list[memoryview] = []
+        try:
+            if handler is None:
+                raise CheckpointError(
+                    f"rank {self.rank} serves no ranged reads (no local "
+                    f"checkpoint manager registered)"
+                )
+            extra, parts = handler(msg.get("req") or {})
+            views = [memoryview(p).cast("B") for p in parts]
+            header = {
+                "ok": True,
+                "lengths": [v.nbytes for v in views],
+                "crc32c": [ckpt_format.crc32c(v) for v in views],
+                "crc_algo": ckpt_format.CRC_ALGO,
+                **(extra or {}),
+            }
+        except Exception as e:
+            header, views = {"ok": False, "error": str(e)}, []
+        blob = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
+        try:
+            self.send_parts(src, tag, [_RR_LEN.pack(len(blob)), blob, *views])
+        except CheckpointError as e:
+            # The requester timed out / died; it owns its own recovery.
+            log.warning(f"p2p: range_read reply to rank {src} failed: {e}")
 
     def purge(self, tag_prefix: str) -> int:
         """Drop queued frames (and pending ``recv_into`` registrations) whose tag

@@ -45,6 +45,7 @@ import time
 from typing import Any, Optional
 
 from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint import reshard as reshard_mod
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
 from tpu_resiliency.checkpoint.comm import StoreComm
 from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
@@ -164,6 +165,14 @@ class LocalCheckpointManager:
         self._dir = os.path.join(root, f"s{session}", f"r{rank}")
         os.makedirs(self._dir, exist_ok=True)
         self._cleanup_dirty()
+        #: (path, mtime, size) → parsed container geometry + verify verdict,
+        #: shared by the reshard read path and the ranged-read server so each
+        #: container pays its header parse + integrity pass once.
+        self._reshard_cache: dict[str, tuple] = {}
+        if self.replication is not None:
+            # Serve ranged reads off this rank's shard files: the wire op the
+            # elastic reshard load path fetches newly-owned byte ranges over.
+            self.replication.exchange.serve_ranges(self._serve_ranges)
 
     # -- local inventory ---------------------------------------------------
 
@@ -248,8 +257,15 @@ class LocalCheckpointManager:
         state_dict: PyTreeStateDict,
         is_async: bool = True,
         meta: Optional[dict] = None,
+        layout: Optional["reshard_mod.TreeLayout"] = None,
     ) -> Optional[AsyncRequest]:
         """Replicate + persist this rank's shard for ``iteration``.
+
+        ``layout`` (a :class:`~tpu_resiliency.checkpoint.reshard.TreeLayout`)
+        embeds the saving world's partition map in the container header meta,
+        which is what makes the checkpoint resumable on a DIFFERENT world via
+        :meth:`load_resharded` — any single surviving container then describes
+        every rank's blocks.
 
         Pipelined (default, async + thread caller): synchronous on the caller
         is only enqueue-D2H + skeleton pickle + replication-round bookkeeping;
@@ -261,9 +277,37 @@ class LocalCheckpointManager:
         coverage verification + pruning of older iterations
         (``base_manager.py:236-318``).
         """
+        if layout is not None:
+            meta = {**(meta or {}), reshard_mod.LAYOUT_META_KEY: layout.to_meta()}
         if self.pipelined and is_async:
             return self._save_pipelined(iteration, state_dict, meta)
         return self._save_materialized(iteration, state_dict, is_async, meta)
+
+    def _check_layout(self, meta: Optional[dict], specs: list) -> None:
+        """Fail a layout-bearing save LOUDLY when the declared layout does not
+        match the tensors actually being written (the classic mistake: layout
+        leaves listed in tree-insertion order while pytrees flatten in
+        sorted-key order). Catching it here turns a later unexplainable
+        "no live holder" reshard failure into a save-time geometry error."""
+        layout = reshard_mod.extract_layout(meta or {})
+        if layout is None:
+            return
+        if len(layout.leaves) != len(specs):
+            raise CheckpointError(
+                f"save(layout=): layout describes {len(layout.leaves)} leaves "
+                f"but the state dict has {len(specs)} tensor leaves (pytree "
+                f"leaves flatten in sorted-key order)"
+            )
+        for i, spec in enumerate(specs):
+            box = layout.box(i, self.rank)
+            want_dtype = layout.leaves[i].dtype
+            if tuple(spec["shape"]) != box.shape or str(spec["dtype"]) != want_dtype:
+                raise CheckpointError(
+                    f"save(layout=): leaf {i} is {tuple(spec['shape'])}/"
+                    f"{spec['dtype']} but the layout puts rank {self.rank}'s "
+                    f"block at {box.shape}/{want_dtype} — layout leaves must "
+                    f"follow the pytree flatten (sorted-key) order"
+                )
 
     def _save_pipelined(
         self, iteration: int, state_dict: PyTreeStateDict, meta: Optional[dict]
@@ -273,6 +317,7 @@ class LocalCheckpointManager:
             if not state_dict.is_hollow:
                 state_dict.pop_tensors()
             snapshot = state_dict.copy_tensors_to_host_async(pool=self.staging)
+            self._check_layout(meta, snapshot.specs)
             hollow_bytes = pickle.dumps(
                 state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
             )
@@ -396,6 +441,10 @@ class LocalCheckpointManager:
             if not state_dict.is_hollow:
                 state_dict.pop_tensors()
             state_dict.copy_tensors_to_host()
+        if meta and reshard_mod.LAYOUT_META_KEY in meta:
+            from tpu_resiliency.checkpoint.state_dict import leaf_specs
+
+            self._check_layout(meta, leaf_specs(state_dict.tensors()))
         with debug_time("ckpt.save.serialize", source="checkpoint"):
             hollow_bytes = pickle.dumps(
                 state_dict.hollow_tree, protocol=pickle.HIGHEST_PROTOCOL
@@ -695,6 +744,29 @@ class LocalCheckpointManager:
         sd = PyTreeStateDict.from_hollow(hollow, tensors, shardings=shardings, device=device)
         return sd.tree, meta
 
+    def load_resharded_tree(
+        self,
+        target: Optional["reshard_mod.TreeLayout"] = None,
+        iteration: Optional[int] = None,
+        axes=None,
+        shardings=None,
+        device=None,
+    ) -> tuple[Any, dict]:
+        """``load_resharded`` + rebuild: the mesh-aware restore in one call.
+        ``shardings`` belong to the NEW mesh (e.g.
+        ``mesh.tree_shardings(new_mesh, specs)``); placeholder shapes are
+        already synced to the target world, so shape-driven spec functions
+        see the resharded truth."""
+        from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+
+        hollow, tensors, meta = self.load_resharded(
+            target=target, iteration=iteration, axes=axes
+        )
+        sd = PyTreeStateDict.from_hollow(
+            hollow, tensors, shardings=shardings, device=device
+        )
+        return sd.tree, meta
+
     def load_shard(
         self, owner: int, iteration: Optional[int] = None
     ) -> tuple[Any, list, dict]:
@@ -758,12 +830,457 @@ class LocalCheckpointManager:
         except OSError as e:
             raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
 
+    # -- elastic reshard ---------------------------------------------------
+
+    def _container_geometry(self, iteration: int, owner: int) -> dict:
+        """Parse (once per file version) a held container's geometry: header
+        prefix length, per-leaf payload offsets/specs, hollow bytes and meta —
+        plus a full streaming integrity pass (the PR-5 checksummer), so every
+        byte a reshard serves or slices locally comes from a verified file.
+        A corrupt container is quarantined and surfaces as CheckpointError."""
+        path = self._path(CkptID(iteration, owner, self.session))
+        try:
+            st = os.stat(path)
+        except OSError as e:
+            raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
+        key = (st.st_mtime_ns, st.st_size)
+        cached = self._reshard_cache.get(path)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        status, detail = ckpt_format.verify_file(path)
+        if status == "corrupt":
+            self._quarantine(
+                path, stage="reshard-verify", iteration=iteration, owner=owner,
+                error=detail,
+            )
+            self._reshard_cache.pop(path, None)
+            raise CheckpointError(f"{path}: corrupt container ({detail})")
+        try:
+            with open(path, "rb") as f:
+                _, header, prefix = ckpt_format._read_prefix(f, path)
+        except OSError as e:
+            raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
+        offs, pos = [], len(prefix)
+        for spec in header["leaves"]:
+            offs.append(pos)
+            pos += int(spec["nbytes"])
+        geom = {
+            "path": path,
+            "leaf_offsets": offs,
+            "leaf_specs": header["leaves"],
+            "hollow": header["hollow"],
+            "meta": header.get("meta", {}),
+            "verified": status == "ok",
+        }
+        self._reshard_cache[path] = (key, geom)
+        return geom
+
+    def _read_ranges(
+        self, iteration: int, owner: int, ranges: list
+    ) -> list[bytes]:
+        """pread leaf-relative byte ranges out of a locally-held (verified)
+        container; ``ranges`` items are ``(leaf, src_off, nbytes)``."""
+        geom = self._container_geometry(iteration, owner)
+        out: list[bytes] = []
+        with open(geom["path"], "rb") as f:
+            fd = f.fileno()
+            for leaf, off, nbytes in ranges:
+                leaf, off, nbytes = int(leaf), int(off), int(nbytes)
+                if not 0 <= leaf < len(geom["leaf_offsets"]):
+                    raise CheckpointError(
+                        f"{geom['path']}: range names leaf {leaf} of "
+                        f"{len(geom['leaf_offsets'])}"
+                    )
+                limit = int(geom["leaf_specs"][leaf]["nbytes"])
+                if off < 0 or nbytes < 0 or off + nbytes > limit:
+                    raise CheckpointError(
+                        f"{geom['path']}: range [{off}, {off + nbytes}) outside "
+                        f"leaf {leaf} payload of {limit} bytes"
+                    )
+                buf = os.pread(fd, nbytes, geom["leaf_offsets"][leaf] + off)
+                if len(buf) != nbytes:
+                    raise CheckpointError(
+                        f"{geom['path']}: short read in leaf {leaf} "
+                        f"({len(buf)} of {nbytes} bytes)"
+                    )
+                out.append(buf)
+        return out
+
+    def _serve_ranges(self, request: dict) -> tuple[dict, list]:
+        """``PeerExchange.serve_ranges`` handler: answer a peer's ranged read
+        against a container this rank holds (own shard or clique mirror).
+        Runs on a p2p connection thread; every reply range comes from a
+        container that passed (or is re-verified through) the streaming
+        integrity check, and the exchange stamps per-range CRCs on the way
+        out."""
+        try:
+            session = int(request.get("session", self.session))
+            iteration = int(request["iteration"])
+            owner = int(request["owner"])
+            ranges = list(request.get("ranges") or [])
+        except (KeyError, TypeError, ValueError) as e:
+            raise CheckpointError(f"malformed range request ({e!r})") from e
+        if session != self.session:
+            raise CheckpointError(
+                f"rank {self.rank} serves session {self.session}, "
+                f"not {session}"
+            )
+        parts = self._read_ranges(iteration, owner, ranges)
+        extra = {"owner": owner, "iteration": iteration}
+        if request.get("want_header"):
+            geom = self._container_geometry(iteration, owner)
+            extra["hollow"] = geom["hollow"]
+            extra["meta"] = geom["meta"]
+        return extra, parts
+
+    def load_resharded(
+        self,
+        target: Optional["reshard_mod.TreeLayout"] = None,
+        iteration: Optional[int] = None,
+        axes=None,
+    ) -> tuple[Any, list, dict]:
+        """Load on a world that need NOT match the saving world's sharding.
+
+        Collective over ``self.comm`` (the NEW world's group — after a shrink
+        or grow, construct it over the surviving/joining ranks and
+        ``rebuild_group`` first). The newest iteration whose containers carry
+        a layout (saved with ``save(..., layout=...)``) and whose surviving
+        copies cover the target world is chosen — older iterations are tried
+        when a newer one's coverage is impossible; an explicitly requested
+        ``iteration`` fails hard instead of falling back.
+
+        ``target`` defaults to the SOURCE layout retargeted onto this comm's
+        ranks (``axes`` overrides the dp-rescale rule — pass a dict like
+        ``{"dp": 2, "tp": 2}`` for a changed model split). Bytes this rank
+        already holds (its own shard, clique mirrors) are sliced locally;
+        everything else is ranged-fetched from peers — strictly the byte
+        ranges newly owned, never whole mirror containers.
+
+        Returns ``(hollow_tree, host_tensors, meta)`` like :meth:`load`; the
+        returned ``meta["layout"]`` describes the TARGET world, ready to pass
+        back into the next ``save(..., layout=...)``.
+        """
+        with debug_time("ckpt.reshard_load", source="checkpoint"):
+            return self._load_resharded(target, iteration, axes)
+
+    def _load_resharded(self, target, iteration, axes) -> tuple[Any, list, dict]:
+        t0 = time.perf_counter()
+        held = sorted((i.iteration, i.owner) for i in self.local_ids())
+        if self.comm is None:
+            gathered = [(self.rank, held)]
+            world = [self.rank]
+        else:
+            gathered = self.comm.all_gather((self.rank, held), tag="reshard-meta")
+            world = list(self.comm.ranks)
+        holders: dict[tuple[int, int], list[int]] = {}
+        for r, pairs in gathered:
+            for it, owner in pairs:
+                holders.setdefault((int(it), int(owner)), []).append(int(r))
+        candidates = sorted({it for it, _ in holders}, reverse=True)
+        if iteration is not None:
+            candidates = [it for it in candidates if it == iteration]
+            if not candidates:
+                raise CheckpointError(
+                    f"reshard: no rank holds any container for iteration "
+                    f"{iteration}"
+                )
+        errors: list[str] = []
+        for it in candidates:
+            picked = self._reshard_candidate(
+                it, holders, world, target, axes, errors
+            )
+            if picked is None:
+                if iteration is not None:
+                    raise CheckpointError(
+                        f"reshard: iteration {iteration} not resumable on "
+                        f"world {world}: {'; '.join(errors)}"
+                    )
+                continue
+            plan, tgt, hollow_b, meta = picked
+            with span(
+                "checkpoint", "reshard.plan",
+                iteration=it, direction=plan.direction,
+                source_world=plan.source.world_size,
+                target_world=plan.target.world_size,
+            ):
+                summary = plan.summary(
+                    rank=self.rank,
+                    local_owners={
+                        self.rank: {o for i2, o in held if i2 == it}
+                    },
+                )
+            record_event(
+                "checkpoint", "reshard_plan", iteration=it, rank=self.rank,
+                direction=plan.direction,
+                source_world=plan.source.world_size,
+                target_world=plan.target.world_size,
+                local_bytes=summary["local_bytes"],
+                peer_bytes=summary["peer_bytes"],
+                ranges=summary["ranges"],
+            )
+            tensors = self._execute_reshard(plan, it, holders)
+            if self.comm is not None:
+                # Exit barrier: a rank whose assembly was all-local must keep
+                # serving ranged reads until every peer has fetched its share.
+                self.comm.barrier(tag="reshard-done")
+            meta = {
+                **meta,
+                "iteration": meta.get("iteration", it),
+                reshard_mod.LAYOUT_META_KEY: tgt.to_meta(),
+            }
+            record_event(
+                "checkpoint", "timing", name="ckpt.reshard_load",
+                duration_s=time.perf_counter() - t0, ok=True,
+                bytes=summary["total_bytes"],
+            )
+            hollow = self._loads_hollow(hollow_b, f"reshard(iter={it})")
+            try:
+                from tpu_resiliency.checkpoint.state_dict import (
+                    sync_placeholder_shapes,
+                )
+
+                # Placeholders still carry the SAVING world's local shapes;
+                # shape-driven restores (make_restore_shardings spec fns)
+                # must see the target world's.
+                sync_placeholder_shapes(hollow, tensors)
+            except ImportError:  # pragma: no cover - jax-less tooling host
+                pass
+            return hollow, tensors, meta
+        raise CheckpointError(
+            "reshard: no resharded-resumable iteration found"
+            + (f" ({'; '.join(errors)})" if errors else " (no layout-bearing "
+               "containers on any rank — save with save(..., layout=...))")
+        )
+
+    def _reshard_candidate(self, it, holders, world, target, axes, errors):
+        """One collective attempt at iteration ``it``: the lowest holder rank
+        reads+broadcasts a container's layout/hollow/meta; every rank builds
+        the same plan and the same coverage verdict. Returns ``(plan, target,
+        hollow, meta)`` or None (verdict recorded in ``errors``)."""
+        holder_ranks = sorted(
+            {r for (i2, _), rs in holders.items() if i2 == it for r in rs}
+        )
+        designated = holder_ranks[0]
+        payload: dict = {}
+        if self.rank == designated:
+            owned = sorted(
+                o for (i2, o) in holders
+                if i2 == it and self.rank in holders[(i2, o)]
+            )
+            last_err = "no held container"
+            for owner in owned:
+                # Any intact container describes the whole world; a corrupt
+                # one was just quarantined — try the next held copy.
+                try:
+                    geom = self._container_geometry(it, owner)
+                except CheckpointError as e:
+                    last_err = str(e)
+                    continue
+                raw = geom["meta"].get(reshard_mod.LAYOUT_META_KEY)
+                if raw is None:
+                    last_err = (
+                        f"iteration {it}: containers carry no layout meta"
+                    )
+                    continue
+                mismatch = self._layout_header_mismatch(raw, geom, owner)
+                if mismatch:
+                    last_err = f"iteration {it}: {mismatch}"
+                    continue
+                payload = {
+                    "layout": raw, "hollow": geom["hollow"],
+                    "meta": geom["meta"],
+                }
+                break
+            else:
+                payload = {"error": last_err}
+        if self.comm is not None:
+            payload = self.comm.broadcast(
+                payload, src=designated, tag="reshard-hdr"
+            )
+        if payload.get("error"):
+            errors.append(f"iter {it}: {payload['error']}")
+            return None
+        try:
+            source = reshard_mod.TreeLayout.from_meta(payload["layout"])
+            tgt = (
+                target
+                if target is not None
+                else source.retarget(world, axes=axes)
+            )
+            plan = reshard_mod.build_plan(source, tgt)
+            available = {o for (i2, o) in holders if i2 == it}
+            plan.require_available(available)
+        except CheckpointError as e:
+            errors.append(f"iter {it}: {e}")
+            return None
+        return plan, tgt, payload["hollow"], dict(payload.get("meta") or {})
+
+    @staticmethod
+    def _layout_header_mismatch(raw_layout, geom: dict, owner: int):
+        """Cross-check an embedded layout against the container's OWN header
+        leaf specs (save-time validation exists too, but metas written by
+        older code — or hand-edited — must not send the executor chasing
+        ranges outside real payloads). Returns a description or None."""
+        try:
+            layout = reshard_mod.TreeLayout.from_meta(raw_layout)
+        except CheckpointError as e:
+            return str(e)
+        specs = geom["leaf_specs"]
+        if len(layout.leaves) != len(specs):
+            return (
+                f"layout describes {len(layout.leaves)} leaves, container "
+                f"has {len(specs)}"
+            )
+        for i, spec in enumerate(specs):
+            box = layout.box(i, owner)
+            if tuple(spec["shape"]) != box.shape or (
+                str(spec["dtype"]) != layout.leaves[i].dtype
+            ):
+                return (
+                    f"layout leaf {i} puts owner {owner}'s block at "
+                    f"{box.shape}/{layout.leaves[i].dtype} but the container "
+                    f"holds {tuple(spec['shape'])}/{spec['dtype']}"
+                )
+        return None
+
+    def _execute_reshard(
+        self, plan: "reshard_mod.ReshardPlan", it: int, holders: dict
+    ) -> list:
+        """Assemble this rank's target-local leaves: local pread for ranges a
+        held container covers, ranged peer fetch for the rest. Holder choice
+        is deterministic and load-balanced; a failed/corrupt holder is
+        dropped (degraded) and the next replica holder tried."""
+        import numpy as np
+
+        rp = plan.for_rank(self.rank)
+        buffers = [
+            np.empty(shape, dtype=ckpt_format.resolve_dtype(spec.dtype))
+            for shape, spec in zip(rp.local_shapes, plan.target.leaves)
+        ]
+        flats = [b.reshape(-1).view(np.uint8) for b in buffers]
+        my_owners = {
+            o for (i2, o), rs in holders.items() if i2 == it and self.rank in rs
+        }
+        local_bytes = 0
+        # (holder, owner) -> [segments]
+        remote: dict[tuple[int, int], list] = {}
+        load: dict[int, int] = {}
+        dead: set[int] = set()
+        avoid = set(
+            self.replication.last_degraded if self.replication is not None else ()
+        )
+
+        def place(seg) -> None:
+            nonlocal local_bytes
+            for owner in sorted(set(seg.owners) & my_owners):
+                try:
+                    got = self._read_ranges(
+                        it, owner,
+                        [(seg.leaf, r.src_off, r.nbytes) for r in seg.ranges],
+                    )
+                except CheckpointError as e:
+                    # Local copy corrupt/unreadable (already quarantined by
+                    # the geometry pass): stop trusting it and fall through
+                    # to the peer path for this and every later segment.
+                    log.warning(
+                        f"rank {self.rank}: local reshard read of owner "
+                        f"{owner} @ iter {it} failed: {e}"
+                    )
+                    my_owners.discard(owner)
+                    continue
+                for r, buf in zip(seg.ranges, got):
+                    flats[seg.leaf][r.dst_off : r.dst_off + r.nbytes] = (
+                        np.frombuffer(buf, dtype=np.uint8)
+                    )
+                    local_bytes += r.nbytes
+                return
+            pairs = sorted(
+                (h, o)
+                for o in seg.owners
+                for h in holders.get((it, o), [])
+                if h != self.rank and h not in dead
+            )
+            if not pairs:
+                raise CheckpointError(
+                    f"reshard: no live holder left for leaf {seg.leaf} cell "
+                    f"owned by {list(seg.owners)} @ iteration {it}"
+                )
+            if self.replication is None:
+                raise CheckpointError(
+                    f"reshard: leaf {seg.leaf} cell owned by "
+                    f"{list(seg.owners)} is only on peer ranks and this "
+                    f"manager has no replication exchange to fetch over"
+                )
+            h, o = min(
+                pairs, key=lambda p: (p[0] in avoid, load.get(p[0], 0), p)
+            )
+            load[h] = load.get(h, 0) + len(seg.ranges)
+            remote.setdefault((h, o), []).append(seg)
+
+        for seg in rp.segments:
+            place(seg)
+        if local_bytes:
+            record_event(
+                "checkpoint", "reshard_fetch", via="local", rank=self.rank,
+                iteration=it, bytes=local_bytes,
+            )
+        while remote:
+            (holder, owner), segs = next(iter(sorted(remote.items())))
+            del remote[(holder, owner)]
+            ranges = [
+                (seg.leaf, r.src_off, r.nbytes) for seg in segs for r in seg.ranges
+            ]
+            try:
+                _, parts = self.replication.fetch_ranges(
+                    holder,
+                    {"session": self.session, "iteration": it, "owner": owner,
+                     "ranges": ranges},
+                )
+            except CheckpointError as e:
+                log.warning(
+                    f"rank {self.rank}: reshard fetch from holder {holder} "
+                    f"(owner {owner}) failed: {e}; trying another holder"
+                )
+                record_event(
+                    "checkpoint", "ckpt_integrity_failure",
+                    stage="reshard-fetch", iteration=it, owner=owner,
+                    rank=self.rank, error=repr(e),
+                )
+                dead.add(holder)
+                for seg in segs:
+                    place(seg)
+                continue
+            i = 0
+            nbytes = 0
+            for seg in segs:
+                for r in seg.ranges:
+                    buf = memoryview(parts[i]).cast("B")
+                    i += 1
+                    if buf.nbytes != r.nbytes:
+                        raise CheckpointError(
+                            f"reshard: holder {holder} returned {buf.nbytes} "
+                            f"bytes for a {r.nbytes}-byte range"
+                        )
+                    flats[seg.leaf][r.dst_off : r.dst_off + r.nbytes] = (
+                        np.frombuffer(buf, dtype=np.uint8)
+                    )
+                    nbytes += r.nbytes
+            record_event(
+                "checkpoint", "reshard_fetch", via="peer", rank=self.rank,
+                iteration=it, holder=holder, owner=owner, bytes=nbytes,
+            )
+        return buffers
+
     # -- lifecycle ---------------------------------------------------------
 
     def maybe_finalize(self, blocking: bool = False) -> list[int]:
         return self.queue.maybe_finalize_async_calls(blocking=blocking)
 
     def close(self) -> None:
+        # NOTE: the ranged-read registration outlives close() on purpose —
+        # serving only needs the shard files, and a peer mid-reshard must not
+        # lose its source because this rank assembled (and closed) first. The
+        # registration dies with the exchange.
         self.queue.close()
 
     def wipe(self) -> None:

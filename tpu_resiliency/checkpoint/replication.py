@@ -537,6 +537,28 @@ class CliqueReplicationStrategy:
                 self.last_degraded.add(src)
         return blob
 
+    def fetch_ranges(
+        self, holder: int, request: dict, timeout: Optional[float] = None
+    ) -> tuple[dict, list]:
+        """Ranged read against one peer's locally-held container — the elastic
+        reshard fetch: move only the byte ranges this rank newly owns, not the
+        whole mirror. Point-to-point (no collective participation; the holder
+        serves off its accept thread), per-range checksum-verified by the
+        exchange. A failed holder is marked degraded (deprioritized for
+        future plans) before the error propagates — the caller retries
+        against the next replica holder."""
+        self._ensure_groups()
+        with span(
+            "checkpoint", "reshard.fetch",
+            holder=holder, owner=request.get("owner"),
+            ranges=len(request.get("ranges") or []),
+        ):
+            try:
+                return self.exchange.fetch_ranges(holder, request, timeout=timeout)
+            except CheckpointError:
+                self.last_degraded.add(holder)
+                raise
+
 
 class ReplicationStream:
     """One in-flight leaf-streaming replication round (see

@@ -420,6 +420,29 @@ def tree_size_bytes(tensors: Sequence[Any]) -> int:
     return total
 
 
+def sync_placeholder_shapes(hollow_tree: Any, tensors: Sequence[Any]) -> Any:
+    """Update a hollow skeleton's placeholders to the ACTUAL payload geometry.
+
+    After an elastic reshard (``local_manager.load_resharded``) the loaded
+    skeleton's placeholders still describe the SAVING world's local blocks;
+    the reassembled tensors are the TARGET world's. Shape-driven consumers —
+    ``make_restore_shardings`` spec functions, shape assertions in user
+    restore code — must see the target truth, so the reshard load path runs
+    this before handing the skeleton out. In-place on the placeholders;
+    returns ``hollow_tree`` for chaining."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten(
+        hollow_tree, is_leaf=lambda x: isinstance(x, TensorPlaceholder)
+    )[0]
+    for leaf in leaves:
+        if isinstance(leaf, TensorPlaceholder) and 0 <= leaf.index < len(tensors):
+            t = tensors[leaf.index]
+            leaf.shape = tuple(t.shape)
+            leaf.dtype = np.dtype(getattr(t.dtype, "name", t.dtype)).name
+    return hollow_tree
+
+
 def make_restore_shardings(
     hollow: Any, spec_fn: Callable[[TensorPlaceholder], Any]
 ) -> list:

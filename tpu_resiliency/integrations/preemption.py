@@ -19,6 +19,7 @@ the same step and exit cleanly.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Optional
 
 from tpu_resiliency.integrations.loop import Callback, LoopContext
@@ -36,6 +37,16 @@ class PreemptionCheckpointCallback(Callback):
     ``stop_on_preemption=False`` keeps training (save-and-continue — useful when
     the scheduler sometimes cancels the reclamation).
 
+    ``ckpt_manager`` (anything with ``maybe_finalize(blocking=True)`` — a
+    :class:`~tpu_resiliency.checkpoint.local_manager.LocalCheckpointManager`,
+    an :class:`~tpu_resiliency.checkpoint.async_ckpt.AsyncCheckpointer`, or a
+    bare callable) defers acting on a notice that lands DURING an in-flight
+    async save: the callback first drains the pending save to its
+    commit/rename (and collective finalization), THEN runs ``on_preemption``.
+    Without the drain, the grace-window save races the background writer — at
+    shrink time the "latest" iteration can be a torn mix of the two, which is
+    exactly the checkpoint the resharded resume would pick.
+
     After the loop stops, tear jax.distributed down coordinator-last before
     process exit — :func:`platform.distributed.shutdown_ordered` (store-backed,
     deterministic) or :func:`shutdown_graceful` (store-free) — or a peer's
@@ -47,11 +58,42 @@ class PreemptionCheckpointCallback(Callback):
         self,
         on_preemption: Callable[[Any, int], None],
         stop_on_preemption: bool = True,
+        ckpt_manager: Any = None,
     ):
         self.on_preemption = on_preemption
         self.stop_on_preemption = stop_on_preemption
+        self.ckpt_manager = ckpt_manager
         self.preempted_at: Optional[int] = None  # last fired sync step
         self._armed = True
+
+    def _drain_inflight_saves(self, step: int) -> None:
+        """Block until any in-flight async save has committed (rename done,
+        coverage finalized) before the preemption save runs. Failures are
+        logged, not raised — a broken background save must not eat the grace
+        window the final save needs."""
+        mgr = self.ckpt_manager
+        if mgr is None:
+            return
+        t0 = time.monotonic()
+        try:
+            if callable(getattr(mgr, "maybe_finalize", None)):
+                mgr.maybe_finalize(blocking=True)
+            elif callable(mgr):
+                mgr()
+        except Exception:
+            log.exception(
+                "draining in-flight async save before the preemption save "
+                "failed; saving anyway"
+            )
+            record_event(
+                "preemption", "preemption_drain", step=step, ok=False,
+                duration_s=time.monotonic() - t0,
+            )
+            return
+        record_event(
+            "preemption", "preemption_drain", step=step, ok=True,
+            duration_s=time.monotonic() - t0,
+        )
 
     @staticmethod
     def _reached(step: int) -> bool:
@@ -84,6 +126,10 @@ class PreemptionCheckpointCallback(Callback):
         record_event(
             "preemption", "preemption_sync_point", step=ctx.step, rank=ctx.rank
         )
+        # A notice landing mid-async-save must wait for the commit/rename:
+        # otherwise the final save and the background writer interleave and
+        # the "latest" iteration at shrink time can be torn.
+        self._drain_inflight_saves(ctx.step)
         self.on_preemption(ctx.state, ctx.step)
         if self.stop_on_preemption:
             ctx.should_stop = True

@@ -140,6 +140,11 @@ class ElasticAgent:
         self._launcher_socket = os.path.join(self.cfg.run_dir, "launcher.sock")
         self._restarts_used = 0
         self._last_exitcodes: dict[int, int] = {}
+        #: last placed round's world size — a delta means the job elastically
+        #: shrank (partial-slice preemption, exclusion) or re-expanded (spares
+        #: returned); the resharded resume inside the workers is what makes
+        #: the new world trainable, the launcher records the transition.
+        self._last_world_size: Optional[int] = None
         self._spare_pool = None
         #: set by restart watchers so spare/completion waits wake on a peer's
         #: restart request instead of sleeping out their poll tick
@@ -563,6 +568,21 @@ class ElasticAgent:
             node_id=cfg.node_id, node_rank=node_rank, world_size=world_size,
             active=list(outcome.active), spares=list(outcome.spares),
         )
+        if (
+            self._last_world_size is not None
+            and world_size != self._last_world_size
+        ):
+            # The elastic transition itself: the workers' resharded resume
+            # makes the new world trainable; this record ties the shrink /
+            # re-expand to the round that performed it.
+            record_event(
+                "launcher", "world_resized", round=outcome.round,
+                node_id=cfg.node_id,
+                direction="shrink" if world_size < self._last_world_size
+                else "grow",
+                from_world=self._last_world_size, to_world=world_size,
+            )
+        self._last_world_size = world_size
         base_env = {
             "NODE_RANK": str(node_rank),
             "GROUP_RANK": str(node_rank),

@@ -156,3 +156,30 @@ def tree_shardings(mesh, specs):
         specs,
         is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
     )
+
+
+def axis_sizes(mesh) -> dict[str, int]:
+    """``{axis name: size}`` of a Mesh — the form the elastic reshard layout
+    (``checkpoint/reshard.py``) consumes."""
+    return {str(n): int(s) for n, s in zip(mesh.axis_names, mesh.devices.shape)}
+
+
+def checkpoint_layout(mesh, tree, spec_tree, ranks: Optional[Sequence[int]] = None):
+    """A :class:`~tpu_resiliency.checkpoint.reshard.TreeLayout` for saving
+    ``tree`` (this rank's LOCAL pytree) sharded per ``spec_tree`` on ``mesh``.
+
+    This is the save-side half of elastic resharding: pass the result to
+    ``LocalCheckpointManager.save(..., layout=...)`` and any later world —
+    shrunk, grown, or re-split — can resume via ``load_resharded``. ``ranks``
+    defaults to one rank per mesh device position (``range(n)``); pass the
+    job's actual global rank order when it differs."""
+    from tpu_resiliency.checkpoint.reshard import TreeLayout
+
+    sizes = axis_sizes(mesh)
+    if ranks is None:
+        import numpy as _np
+
+        ranks = range(int(_np.prod(mesh.devices.shape, dtype=_np.int64)))
+    # Mesh axis order is authoritative (row-major rank grid follows it).
+    axes = [(n, sizes[n]) for n in map(str, mesh.axis_names)]
+    return TreeLayout.for_local_tree(tree, spec_tree, axes, list(ranks))

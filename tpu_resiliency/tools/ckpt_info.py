@@ -13,11 +13,21 @@ restarted world actually resume from, and what is replication costing me?"
 per-file verdict, and exits 1 on any mismatch — an operator preflight before
 trusting a root for restart, and a CI gate after fault-injection runs.
 
+``--world <ranks> --plan`` renders the elastic reshard plan the given target
+world would execute (``checkpoint/reshard.py``): per target rank, each leaf's
+source cells with owner ranks, byte ranges, and the local-slice vs peer-fetch
+split implied by what's on disk — without loading a single tensor. Exits 1
+when any needed range has no surviving source container ("coverage
+impossible", naming the missing ranks).
+
 Usage::
 
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --session 1
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --verify
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --world 0,1,2 --plan
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --world 0,1,2,3 \
+        --plan --axes dp=2,tp=2
 """
 
 from __future__ import annotations
@@ -201,6 +211,116 @@ def verify(sessions: list[SessionInfo], out=None) -> int:
     return counts["corrupt"]
 
 
+def render_plan(
+    info: SessionInfo,
+    world: set,
+    axes: Optional[dict] = None,
+    iteration: Optional[int] = None,
+    out=None,
+) -> int:
+    """Compute and render the reshard plan for ``world`` against the newest
+    layout-bearing iteration (or ``iteration``); returns the exit code (1 on
+    uncovered ranges or no plannable iteration). Header reads only — no
+    tensor bytes are touched."""
+    from tpu_resiliency.checkpoint import format as ckpt_format
+    from tpu_resiliency.checkpoint import reshard
+    from tpu_resiliency.exceptions import CheckpointError
+
+    out = sys.stdout if out is None else out
+    target_ranks = sorted(world)
+    candidates = sorted(info.holdings, reverse=True)
+    if iteration is not None:
+        candidates = [it for it in candidates if it == iteration]
+    for it in candidates:
+        # Any container of the iteration carries the full layout; take the first
+        # readable one.
+        source = None
+        for path, holder, fit, owner in sorted(info.files):
+            if fit != it:
+                continue
+            try:
+                meta = ckpt_format.read_header(path).get("meta", {})
+                source = reshard.extract_layout(meta)
+            except CheckpointError:
+                continue
+            if source is not None:
+                break
+        if source is None:
+            print(f"iter {it}: no readable layout-bearing container", file=out)
+            continue
+        try:
+            target = source.retarget(target_ranks, axes=axes)
+            plan = reshard.build_plan(source, target)
+        except CheckpointError as e:
+            print(f"iter {it}: cannot plan — {e}", file=out)
+            return 1
+        available = set(info.holdings[it])
+        local_owners = {
+            r: {
+                o
+                for o, holders in info.holdings[it].items()
+                if r in holders
+            }
+            for r in target_ranks
+        }
+        print(
+            f"session {info.session} iter {it}: reshard plan "
+            f"{plan.source.world_size} -> {plan.target.world_size} ranks "
+            f"({plan.direction}), source axes {dict(plan.source.axes)} -> "
+            f"target axes {dict(plan.target.axes)}",
+            file=out,
+        )
+        for r in target_ranks:
+            rp = plan.for_rank(r)
+            held = local_owners.get(r, set())
+            print(
+                f"  target rank {r}: {len(rp.segments)} cell(s), "
+                f"{rp.nbytes} bytes",
+                file=out,
+            )
+            for seg in rp.segments:
+                via = (
+                    "local" if set(seg.owners) & held
+                    else ("peer-fetch" if set(seg.owners) & available
+                          else "UNCOVERED")
+                )
+                spans = ", ".join(
+                    f"[{rg.src_off}+{rg.nbytes})->[{rg.dst_off})"
+                    for rg in seg.ranges[:4]
+                )
+                if len(seg.ranges) > 4:
+                    spans += f", ... {len(seg.ranges) - 4} more"
+                print(
+                    f"    leaf {seg.leaf}: owners {list(seg.owners)} "
+                    f"{seg.nbytes} B via {via}  {spans}",
+                    file=out,
+                )
+        summary = plan.summary(local_owners=local_owners)
+        print(
+            f"  split: {summary['local_bytes']} B local, "
+            f"{summary['peer_bytes']} B peer-fetched, "
+            f"{summary['ranges']} range(s)",
+            file=out,
+        )
+        missing = plan.missing_sources(available)
+        if missing:
+            names = sorted({r for rs in missing.values() for r in rs})
+            print(
+                f"  UNCOVERED: no surviving copy of source rank(s) {names} "
+                f"(leaves {sorted(missing)})",
+                file=out,
+            )
+            return 1
+        print(f"  coverage: OK for world {target_ranks}", file=out)
+        return 0
+    print(
+        "no plannable iteration (no containers carry reshard layout meta — "
+        "save with save(..., layout=...))",
+        file=out,
+    )
+    return 1
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Audit a tpu-resiliency local-checkpoint root offline"
@@ -230,8 +350,46 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="stream-verify every container's checksums (per-leaf CRCs + "
         "trailer digest); print per-file verdicts; exit 1 on any mismatch",
     )
+
+    def axes_spec(text: str) -> dict:
+        out = {}
+        try:
+            for part in text.split(","):
+                if not part.strip():
+                    continue
+                name, size = part.split("=")
+                out[name.strip()] = int(size)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"want name=size[,name=size...], got {text!r}"
+            )
+        if not out:
+            raise argparse.ArgumentTypeError("empty axes spec")
+        return out
+
+    ap.add_argument(
+        "--plan",
+        action="store_true",
+        help="render the elastic reshard plan for the --world target ranks "
+        "(per-target-rank source cells, byte ranges, local vs peer-fetch "
+        "split) without loading tensors; exit 1 if any range is uncovered",
+    )
+    ap.add_argument(
+        "--axes",
+        type=axes_spec,
+        default=None,
+        help="target mesh split for --plan, e.g. dp=2,tp=2 (default: the "
+        "source layout with dp rescaled to the --world size)",
+    )
+    ap.add_argument(
+        "--iteration", type=int, default=None,
+        help="plan against this iteration (default: newest layout-bearing)",
+    )
     args = ap.parse_args(argv)
     world = args.world
+    if args.plan and world is None:
+        print("--plan requires --world (the target rank set)", file=sys.stderr)
+        return 2
     if not os.path.isdir(args.root):
         print(f"not a checkpoint root: {args.root}", file=sys.stderr)
         return 1
@@ -239,6 +397,21 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not sessions:
         print("no sessions found", file=sys.stderr)
         return 1
+    if args.plan:
+        rc = [0]
+
+        def emit_plan():
+            # One session per plan render (pass --session to disambiguate).
+            rc[0] = max(
+                render_plan(
+                    info, world, axes=args.axes, iteration=args.iteration
+                )
+                for info in sessions
+            )
+
+        if pipe_safe(emit_plan):
+            return SIGPIPE_EXIT
+        return rc[0]
     if args.verify:
         corrupt = [0]
 

@@ -691,6 +691,30 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "tpu_ckpt_fallback_total",
             "recovery-ladder fallbacks to an older checkpoint iteration",
         ).inc()
+    elif kind == "world_resized":
+        reg.counter(
+            "tpu_world_resized_total",
+            "elastic world-size transitions across rendezvous rounds, by "
+            "direction",
+            direction=str(rec.get("direction", "?")),
+        ).inc()
+    elif kind == "reshard_plan":
+        # One event per participating rank per resharded resume, so the
+        # counter reads as ranks-through-reshard by direction.
+        reg.counter(
+            "tpu_reshard_ranks_total",
+            "ranks that completed a resharded checkpoint resume, by "
+            "direction (shrink / grow / resplit)",
+            direction=str(rec.get("direction", "?")),
+        ).inc()
+    elif kind == "reshard_fetch":
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.counter(
+                "tpu_reshard_bytes_total",
+                "bytes assembled into resharded local shards, by source "
+                "(local container slice vs peer ranged fetch)",
+                source=str(rec.get("via", "?")),
+            ).inc(rec["bytes"])
     elif kind == "ckpt_foreground_blocked":
         if isinstance(rec.get("duration_s"), (int, float)):
             reg.histogram(
