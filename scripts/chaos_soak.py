@@ -1,6 +1,6 @@
 """Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Seven scenarios, each asserting the job converges to a CORRECT final state
+Eight scenarios, each asserting the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -44,6 +44,16 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   ``stack_dump``, the ``hang_census`` implicates it, and the job restarts to
   a successful round — with an identical forensics schedule across the two
   per-seed runs.
+- **autoscale**: the detect→decide→act acceptance — fluctuating capacity
+  (a preemption notice that rescinds, then one that doesn't) + a seeded
+  straggler + a disk bitflip, run through the goodput-optimal
+  ``AutoscaleController`` (act mode) and through a no-controller baseline
+  with today's hard-coded reactions. Convergence = the controlled arm's
+  measured goodput ratio STRICTLY beats the baseline of the same seed, the
+  (decision, action, victim) schedule reproduces across two controlled
+  runs, every ``autoscale_decision`` pairs with an ``autoscale_outcome``
+  carrying predicted AND realized deltas, and the ``tpu_autoscale_*``
+  metrics aggregate.
 
 Every in-process scenario runs TWICE with the same seed and asserts the two
 injection schedules are identical — the reproducibility contract: a failure
@@ -916,6 +926,361 @@ def scenario_hang(seed: int, workdir: str, timeout: float = 180.0):
     return (victim, ladder, recovered)
 
 
+# -- scenario: goodput-optimal autoscale under fluctuating capacity -----------
+
+#: The disk fault both arms pay identically: a seeded bitflip on the newest
+#: proactive-checkpoint container, forcing the quarantine→fallback ladder.
+AUTOSCALE_DISK_SPEC = "{seed}:disk.write.bitflip@peer=r0/iter_0000002_0_local.ckpt"
+
+
+class _AutoscaleSim:
+    """A miniature 4-rank job on real wall clock: iteration_start markers at a
+    step cadence that the injected conditions (straggler slowdown, restarts,
+    resharding stalls) modulate, so the goodput ledger measures the campaign
+    exactly as it measures a real run. Record shape = the events JSONL line."""
+
+    STEP_S = 0.02
+    WARM_RESTART_S = 0.06
+    COLD_RESTART_S = 0.5
+    RESHARD_S = 0.12
+    PREEMPT_BLOCK_S = 0.4
+
+    def __init__(self, recs: list, ctl=None, world: int = 4):
+        self.recs = recs
+        self.ctl = ctl
+        self.world = world
+        self.full_world = world
+        self.it = 0
+
+    def emit(self, source, kind, rank=None, pid=0, **payload):
+        rec = {"ts": time.time(), "source": source, "kind": kind,
+               "pid": pid, "rank": rank, **payload}
+        self.recs.append(rec)
+        if self.ctl is not None:
+            self.ctl.observe(rec)
+        return rec
+
+    def steps(self, n: int, slow: float = 1.0):
+        """n training steps; a shrunken world steps proportionally slower,
+        a straggler inflates every step (synchronous training gates on it)."""
+        for _ in range(n):
+            time.sleep(self.STEP_S * slow * (self.full_world / self.world))
+            self.it += 1
+            self.emit("inprocess", "iteration_start", pid=1000,
+                      iteration=self.it)
+
+    def downtime(self, seconds: float, kind: str, **payload):
+        """Fault evidence, then a dead window; the next step's
+        iteration_start closes the ledger's restart interval."""
+        self.emit("launcher", kind, **payload)
+        time.sleep(seconds)
+
+    # -- controlled-arm actuators (wired into the controller) ---------------
+
+    def swap(self, reason: str):
+        self.downtime(self.WARM_RESTART_S, "restart_requested", reason=reason)
+        self.emit("launcher", "worker_promoted", outcome="promoted",
+                  round=1, park_depth=2)
+
+    def shrink(self, victims, reason: str):
+        self.downtime(self.RESHARD_S, "restart_requested", reason=reason)
+        self.emit("launcher", "world_resized", direction="shrink",
+                  from_world=self.world, to_world=self.world - len(victims))
+        self.world -= len(victims)
+
+    def expand(self, reason: str):
+        self.downtime(self.RESHARD_S, "restart_requested", reason=reason)
+        self.emit("launcher", "world_resized", direction="grow",
+                  from_world=self.world, to_world=self.full_world)
+        self.world = self.full_world
+
+
+def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
+    """One arm of the campaign: fluctuating capacity (a preemption notice
+    that rescinds, then one that doesn't) + an injected straggler + a seeded
+    disk fault. ``controlled`` runs the AutoscaleController in act mode;
+    the baseline runs the identical fault script with today's hard-coded
+    reactions (straggle until death, drain-and-stop on every notice, die at
+    the deadline). Returns ``(records, decision_schedule, disk_schedule)``."""
+    import shutil
+    import numpy as np
+
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.launcher.autoscale import AutoscaleController, CostModel
+    from tpu_resiliency.telemetry.policy import HealthVectorPolicy
+    from tpu_resiliency.telemetry.remediation import RemediationEngine
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils.events import RESERVED_KEYS
+
+    world = 4
+    v_straggler = seed % world
+    v_rescind = (seed // 4) % world
+    v_preempt = (seed // 16) % world
+    recs: list = []
+
+    def flatten(e):
+        recs.append({
+            "ts": e.ts, "source": e.source, "kind": e.kind,
+            "pid": e.pid, "rank": e.rank,
+            **{f"p_{k}" if k in RESERVED_KEYS else k: v
+               for k, v in e.payload.items()},
+        })
+        if ctl is not None:
+            ctl.observe(recs[-1])
+
+    ckpt_root = os.path.join(
+        workdir, f"ckpt_{'ctl' if controlled else 'base'}"
+    )
+    shutil.rmtree(ckpt_root, ignore_errors=True)
+    spares = [1]
+
+    ctl = None
+    sim = _AutoscaleSim(recs, ctl=None, world=world)
+    proactive_mgr = [None]
+
+    def proactive_ckpt():
+        # A REAL checkpoint save: its events (and the disk fault below, which
+        # corrupts its successor) ride the same stream the ledger reads.
+        if proactive_mgr[0] is None:
+            proactive_mgr[0] = LocalCheckpointManager(
+                ckpt_root, rank=0, keep=2
+            )
+        proactive_mgr[0].save(
+            1, PyTreeStateDict({"w": np.arange(2048, dtype=np.float32), "step": 1}),
+            is_async=False,
+        )
+
+    if controlled:
+        def swap_restart(reason):
+            spares[0] -= 1
+            sim.swap(reason)
+
+        engine = RemediationEngine(
+            checkpoint_fn=proactive_ckpt,
+            spare_capacity_fn=lambda: spares[0],
+            publish_degraded_fn=lambda d: None,
+            request_restart_fn=swap_restart,
+            cooldown=0.0,
+        )
+        ctl = AutoscaleController(
+            mode="act",
+            cost_model=CostModel(
+                horizon_s=2.0,
+                warm_restart_s=_AutoscaleSim.WARM_RESTART_S,
+                cold_restart_s=_AutoscaleSim.COLD_RESTART_S,
+                reshard_s=_AutoscaleSim.RESHARD_S,
+                ckpt_s=0.02,
+                preempt_block_s=_AutoscaleSim.PREEMPT_BLOCK_S,
+            ),
+            remediation=engine,
+            spare_capacity_fn=lambda: spares[0],
+            shrink_fn=sim.shrink,
+            expand_fn=sim.expand,
+            target_world=world,
+            rescind_grace_s=0.6,
+            shrink_lead_s=0.1,
+            hysteresis_s=0.05,
+            dwell_s=0.3,
+            decision_cooldown_s=10.0,
+            outcome_window_s=0.5,
+        )
+        sim.ctl = ctl
+    policy = HealthVectorPolicy(
+        patience=2, recovery=1,
+        sinks=[ctl.note_health] if ctl is not None else [],
+    )
+    tpu_events.add_sink(flatten)
+    try:
+        sim.emit("launcher", "rendezvous_round", round=0, world_size=world,
+                 active=list(range(world)))
+        if controlled:
+            sim.emit("launcher", "warm_spare_pool", size=1, parked=1, warm=1)
+        # -- phase 0: healthy -------------------------------------------------
+        sim.steps(10)
+        # -- phase 1: straggler ----------------------------------------------
+        scores_bad = {r: (0.3 if r == v_straggler else 1.0)
+                      for r in range(world)}
+        for _ in range(2):  # patience rounds: the straggler gates the job
+            sim.steps(1, slow=3.0)
+            policy.observe(_synthetic_report(scores_bad))
+        if controlled:
+            d = ctl.tick()
+            assert d is not None and d.action == "swap", d
+            assert d.victims == [v_straggler], (d.victims, v_straggler)
+            sim.emit("telemetry", "degraded_set", degraded=[], newly=[],
+                     recovered=[v_straggler], scores={})
+        else:
+            # No controller: the straggler gates the job until it dies, then
+            # the round cold-restarts — today's reality.
+            sim.steps(18, slow=3.0)
+            sim.downtime(
+                _AutoscaleSim.COLD_RESTART_S, "worker_failed",
+                global_rank=v_straggler, exitcode=1,
+                detail="straggler died",
+            )
+        sim.steps(10)
+        # -- phase 2: preemption notice that RESCINDS ------------------------
+        sim.emit("preemption", "preemption_sync_point", rank=v_rescind,
+                 step=sim.it)
+        if controlled:
+            d = ctl.tick()  # fresh notice: bank progress, don't panic
+            assert d is not None and d.action == "checkpoint", d
+            sim.steps(5)
+            sim.emit("preemption", "preemption_rescinded", rank=v_rescind,
+                     step=sim.it, noticed_step=sim.it - 5)
+            assert ctl.tick() is None  # notice gone: nothing to do
+            sim.steps(5)
+        else:
+            # Today's path: the notice forces drain-and-stop; the rescind
+            # arrives after the job already paid the restart.
+            proactive_ckpt()
+            sim.downtime(
+                _AutoscaleSim.COLD_RESTART_S, "restart_requested",
+                reason=f"preemption notice on rank {v_rescind}: drain and stop",
+            )
+            sim.emit("preemption", "preemption_rescinded", rank=v_rescind,
+                     step=sim.it, noticed_step=sim.it)
+            sim.steps(10)
+        # -- phase 3: real preemption (deadline hits) ------------------------
+        if controlled:
+            ctl.note_preemption(
+                f"r{v_preempt}", rank=v_preempt, deadline=time.time()
+            )
+            sim.emit("preemption", "preemption_sync_point", rank=v_preempt,
+                     step=sim.it)
+            d = ctl.tick()
+            assert d is not None and d.action == "shrink", d
+            sim.steps(15)  # training continues at 3/4 capacity
+            spares[0] = 1  # the reclaimed capacity returns
+            sim.emit("launcher", "warm_spare_pool", size=1, parked=1, warm=1)
+            d = ctl.tick()
+            assert d is not None and d.action == "expand", d
+            sim.steps(10)
+        else:
+            sim.emit("preemption", "preemption_sync_point", rank=v_preempt,
+                     step=sim.it)
+            sim.steps(2)  # the grace window ticks away, nothing prepares
+            sim.downtime(
+                _AutoscaleSim.COLD_RESTART_S + _AutoscaleSim.PREEMPT_BLOCK_S,
+                "worker_failed", global_rank=v_preempt, exitcode=137,
+                detail="preempted at deadline; blocked for capacity",
+            )
+            sim.steps(25)
+        # -- phase 4: the disk fault (identical in both arms) ----------------
+        proactive_ckpt()  # ensures iteration 1 exists under this arm's root
+        plan = chaos.ChaosPlan.parse(AUTOSCALE_DISK_SPEC.format(seed=seed))
+        chaos.install_plan(plan)
+        try:
+            mgr = proactive_mgr[0]
+            import numpy as _np
+
+            mgr.save(
+                2,
+                PyTreeStateDict({"w": _np.arange(2048, dtype=_np.float32),
+                                 "step": 2}),
+                is_async=False,
+            )
+            hollow, tensors, meta = mgr.load()
+            assert meta["iteration"] == 1, (
+                f"disk-fault ladder resumed iteration {meta['iteration']}, "
+                f"wanted the fallback to 1 (bitflipped 2)"
+            )
+        finally:
+            chaos.clear_plan()
+        sim.steps(5)
+        if ctl is not None:
+            ctl.finalize()
+        schedule = (
+            tuple(
+                (d.decision_id, d.action, tuple(d.victims))
+                for d in ctl.decisions
+            )
+            if ctl is not None else ()
+        )
+        return recs, schedule, tuple(plan.schedule())
+    finally:
+        tpu_events.remove_sink(flatten)
+        if proactive_mgr[0] is not None:
+            proactive_mgr[0].close()
+
+
+def scenario_autoscale(seed: int, workdir: str):
+    """The detect→decide→act acceptance: the controlled arm's measured
+    goodput ratio must STRICTLY beat the no-controller baseline of the same
+    seed, the controlled run's (decision, action, victim) schedule must
+    reproduce across two runs, and every decision event must pair with an
+    outcome event carrying both predicted and realized goodput deltas.
+    Leaves ``controlled.jsonl`` / ``baseline.jsonl`` in ``workdir`` for the
+    smoke leg's offline ``tpu-metrics-dump --goodput --baseline`` check."""
+    from tpu_resiliency.utils.goodput import GoodputLedger, compare
+    from tpu_resiliency.utils.metrics import aggregate
+
+    os.makedirs(workdir, exist_ok=True)
+    c1_recs, c1_sched, c1_disk = _autoscale_campaign(seed, workdir, True)
+    c2_recs, c2_sched, c2_disk = _autoscale_campaign(seed, workdir, True)
+    assert (c1_sched, c1_disk) == (c2_sched, c2_disk), (
+        f"autoscale decision schedule not reproducible:\n{c1_sched}\n{c2_sched}"
+    )
+    assert [a for _, a, _ in c1_sched] == [
+        "swap", "checkpoint", "shrink", "expand",
+    ], c1_sched
+    b_recs, _, b_disk = _autoscale_campaign(seed, workdir, False)
+    assert b_disk == c1_disk, "disk fault schedule diverged between arms"
+
+    # Every decision carries predicted AND realized goodput delta (the
+    # outcome event pairs them; finalize settled any stragglers).
+    decisions = [r for r in c1_recs if r.get("kind") == "autoscale_decision"]
+    outcomes = {
+        r.get("decision_id"): r
+        for r in c1_recs if r.get("kind") == "autoscale_outcome"
+    }
+    assert len(decisions) == len(c1_sched), decisions
+    for d in decisions:
+        assert isinstance(d.get("predicted_delta_s"), (int, float)), d
+        o = outcomes.get(d.get("decision_id"))
+        assert o is not None, f"decision {d.get('decision_id')} never settled"
+        assert isinstance(o.get("predicted_delta_s"), (int, float)), o
+        assert isinstance(o.get("realized_delta_s"), (int, float)), o
+
+    # The acceptance inequality, via the same compare() helper the CLI uses.
+    controlled, baseline = GoodputLedger(), GoodputLedger()
+    controlled.observe_many(c1_recs)
+    baseline.observe_many(b_recs)
+    cmp_doc = compare(controlled, baseline)
+    assert cmp_doc["ratio_delta"] > 0, (
+        f"controller did NOT beat the no-controller baseline: {cmp_doc}"
+    )
+
+    # Both arms climbed the identical disk-fault ladder.
+    for name, arm in (("controlled", c1_recs), ("baseline", b_recs)):
+        assert any(r.get("kind") == "ckpt_quarantined" for r in arm), (
+            f"{name}: bitflipped container never quarantined"
+        )
+        assert any(r.get("kind") == "ckpt_fallback" for r in arm), (
+            f"{name}: ladder never recorded the fallback"
+        )
+
+    # The metrics surface: the same aggregation metrics_dump runs.
+    prom = aggregate(c1_recs).to_prometheus()
+    for want in (
+        "tpu_autoscale_decisions_total", 'action="swap"', 'action="shrink"',
+        "tpu_autoscale_predicted_vs_realized", "tpu_preemption_rescinded_total",
+    ):
+        assert want in prom, f"{want} missing:\n{prom[:2000]}"
+
+    for name, arm in (("controlled", c1_recs), ("baseline", b_recs)):
+        with open(os.path.join(workdir, f"{name}.jsonl"), "w") as f:
+            for rec in arm:
+                f.write(json.dumps(rec) + "\n")
+    return (
+        [list(s) for s in c1_sched],
+        (seed % 4, (seed // 4) % 4, (seed // 16) % 4),
+        [list(i) for i in c1_disk],
+        cmp_doc["goodput_ratio"],
+    )
+
+
 # -- driver ------------------------------------------------------------------
 
 
@@ -979,6 +1344,16 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert h1 == h2, f"hang schedule not reproducible:\n{h1}\n{h2}"
     out["hang_schedule"] = [h1[0], list(h1[1]), h1[2]]
     out["hang_workdir"] = hang_dir
+    # Autoscale campaign: scenario_autoscale internally runs the controlled
+    # arm twice (identical decision schedules) plus the baseline arm and
+    # asserts the goodput-beats-baseline invariant.
+    autoscale_dir = os.path.join(workdir, f"autoscale_{seed}")
+    a_sched, a_victims, a_disk, a_ratios = scenario_autoscale(seed, autoscale_dir)
+    out["autoscale_schedule"] = a_sched
+    out["autoscale_victims"] = list(a_victims)
+    out["autoscale_goodput"] = {"controlled": a_ratios[0],
+                                "baseline": a_ratios[1]}
+    out["autoscale_workdir"] = autoscale_dir
     if with_launcher:
         counts = scenario_launcher(seed, os.path.join(workdir, f"launcher_{seed}"))
         out["launcher_injections"] = {f"{c}.{k}": n for (c, k), n in counts.items()}
@@ -1017,6 +1392,7 @@ def main(argv=None) -> int:
             print(f"seed {seed}: store={len(res['store_injections'])} "
                   f"repl={len(res['replication_injections'])} "
                   f"mixed={len(res['mixed_injections'])} "
+                  f"autoscale={res.get('autoscale_goodput')} "
                   f"launcher={res.get('launcher_injections')} "
                   f"({res['elapsed_s']}s)")
         base = int.from_bytes(os.urandom(4), "big")

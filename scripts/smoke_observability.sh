@@ -252,4 +252,87 @@ python -m tpu_resiliency.tools.events_summary "$HANG_DIR/events.jsonl" \
 python -m tpu_resiliency.tools.store_info --help | grep -q -- "--barriers" \
     || { echo "FAIL: store_info lost --barriers"; exit 1; }
 
+echo "== smoke: autoscale act mode (controlled goodput strictly beats the no-controller baseline)"
+AS_DIR="$WORKDIR/chaos/autoscale_1234"
+# The chaos leg already ran scenario_autoscale (twice-per-seed controlled arm
+# + baseline); the offline CLI must agree that the controller won.
+python -m tpu_resiliency.tools.metrics_dump "$AS_DIR/controlled.jsonl" \
+    --goodput --baseline "$AS_DIR/baseline.jsonl" | sed 's/^/    /'
+python -m tpu_resiliency.tools.metrics_dump "$AS_DIR/controlled.jsonl" \
+    --goodput --baseline "$AS_DIR/baseline.jsonl" --format json | \
+    python -c "import json,sys; d=json.load(sys.stdin); assert d['ratio_delta']>0, d" \
+    || { echo "FAIL: controlled run did not beat the baseline"; exit 1; }
+for fam in tpu_autoscale_decisions_total tpu_autoscale_predicted_vs_realized tpu_preemption_rescinded_total; do
+    python -m tpu_resiliency.tools.metrics_dump "$AS_DIR/controlled.jsonl" --format prom | \
+        grep -q "$fam" || { echo "FAIL: $fam missing from metrics dump"; exit 1; }
+done
+python -m tpu_resiliency.tools.events_summary "$AS_DIR/controlled.jsonl" \
+    --kind autoscale_decision,autoscale_outcome,preemption_rescinded | sed 's/^/    /'
+
+echo "== smoke: autoscale advise mode (live decisions audited on /autoscale without acting)"
+AD="$WORKDIR/advise"
+mkdir -p "$AD"
+cat > "$AD/worker.py" <<'PY'
+import os, sys, time
+from tpu_resiliency.utils.events import record
+
+stop = sys.argv[1]
+rank = int(os.environ.get("RANK", "0"))
+i = 0
+deadline = time.time() + 90
+while not os.path.exists(stop) and time.time() < deadline:
+    if rank == 0:
+        record("inprocess", "iteration_start", iteration=i)
+        if i == 20:
+            # An injected straggler signal: the advise-mode controller must
+            # turn it into an audited decision without acting on it.
+            record("telemetry", "degraded_set", degraded=[1], newly=[1],
+                   recovered=[], scores={"0": 1.0, "1": 0.2})
+    i += 1
+    time.sleep(0.05)
+PY
+python -m tpu_resiliency.launcher.launch \
+    --standalone --nproc-per-node 2 --max-restarts 1 --no-ft-monitors \
+    --rdzv-last-call 0.2 --monitor-interval 0.1 --telemetry-port 0 \
+    --autoscale advise --warm-spares 1 --warm-spare-preload os \
+    --events-file "$AD/events.jsonl" --run-dir "$AD/run" \
+    "$AD/worker.py" "$AD/stop" &
+AD_PID=$!
+python - "$AD" <<'PY'
+import json, os, sys, time, urllib.request
+
+ad = sys.argv[1]
+port_file = os.path.join(ad, "run", "telemetry.port")
+deadline = time.time() + 60
+while not os.path.exists(port_file):
+    assert time.time() < deadline, "telemetry.port never appeared"
+    time.sleep(0.2)
+port = int(open(port_file).read().strip())
+doc = None
+while time.time() < deadline:
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/autoscale", timeout=5).read())
+    except OSError:
+        time.sleep(0.3)
+        continue
+    if doc.get("decisions_total", 0) >= 1:
+        break
+    time.sleep(0.3)
+assert doc is not None and doc["schema"] == "tpu-autoscale-1", doc
+assert doc["mode"] == "advise", doc
+assert doc["decisions_total"] >= 1, f"/autoscale never showed a decision: {doc}"
+d = doc["decisions"][0]
+assert d["outcome"] == "advised", d  # advise mode must not act
+assert d["predicted_delta_s"] is not None, d
+print(f"autoscale advise OK: {doc['decisions_total']} decision(s), "
+      f"first={d['action']}{d['victims']} predicted={d['predicted_delta_s']}s")
+PY
+touch "$AD/stop"
+wait "$AD_PID"
+grep -q '"kind": *"autoscale_decision"' "$AD/events.jsonl" \
+    || { echo "FAIL: advise run left no autoscale_decision events"; exit 1; }
+grep -q '"kind": *"autoscale_outcome"' "$AD/events.jsonl" \
+    || { echo "FAIL: advise run never settled a realized outcome"; exit 1; }
+
 echo "smoke_observability: PASS ($WORKDIR)"

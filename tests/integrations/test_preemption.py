@@ -222,6 +222,105 @@ def test_notice_defers_until_inflight_save_commits(tmp_path, monkeypatch):
     assert ctx.should_stop and cb.preempted_at == 6
 
 
+class TestRescind:
+    """Notice rescind (autoscale PR): a notice withdrawn inside the grace
+    window emits ``preemption_rescinded``, cancels the pending deferred
+    drain, and re-arms the callback — it must NOT force the drain path."""
+
+    @staticmethod
+    def _cb(reached_flags, **kw):
+        import unittest.mock as mock
+
+        from tpu_resiliency.integrations import PreemptionCheckpointCallback
+
+        it = iter(reached_flags)
+        patcher = mock.patch.object(
+            PreemptionCheckpointCallback, "_reached",
+            staticmethod(lambda step: next(it)),
+        )
+        return patcher, kw
+
+    def test_rescind_cancels_deferred_drain_and_rearms(self):
+        import unittest.mock as mock
+
+        from tpu_resiliency.integrations import PreemptionCheckpointCallback
+        from tpu_resiliency.integrations.loop import LoopContext
+        from tpu_resiliency.utils import events
+
+        seen = []
+        events.add_sink(seen.append)
+        drains, saves = [], []
+
+        class Mgr:
+            def maybe_finalize(self, blocking=False):
+                drains.append(blocking)
+
+        # Asserted at steps 1-2, cleared at step 3 (rescind), asserted again
+        # 5-8 (a later REAL notice, sustained through the grace).
+        flags = iter([True, True, False, False, True, True, True, True])
+        try:
+            with mock.patch.object(
+                PreemptionCheckpointCallback, "_reached",
+                staticmethod(lambda step: next(flags)),
+            ):
+                cb = PreemptionCheckpointCallback(
+                    on_preemption=lambda s, i: saves.append(i),
+                    ckpt_manager=Mgr(), grace_steps=3,
+                )
+                for step in range(1, 5):
+                    ctx = LoopContext(step=step)
+                    cb.on_step_end(ctx)
+                    assert not ctx.should_stop
+                # The rescind: no drain, no save, one event, re-armed.
+                assert drains == [] and saves == []
+                assert cb.rescinded == 1 and cb.preempted_at is None
+                rescinds = [e for e in seen if e.kind == "preemption_rescinded"]
+                assert len(rescinds) == 1
+                assert rescinds[0].payload["noticed_step"] == 1
+                assert rescinds[0].payload["step"] == 3
+                # The later sustained notice fires normally after its grace.
+                stopped_at = None
+                for step in range(5, 9):
+                    ctx = LoopContext(step=step)
+                    cb.on_step_end(ctx)
+                    if ctx.should_stop:
+                        stopped_at = step
+                        break
+                assert stopped_at == 8  # noticed at 5, grace 3 → fires at 8
+                assert drains == [True] and saves == [8]
+                assert cb.preempted_at == 8
+        finally:
+            events.remove_sink(seen.append)
+
+    def test_grace_zero_keeps_act_immediately_semantics(self):
+        import unittest.mock as mock
+
+        from tpu_resiliency.integrations import PreemptionCheckpointCallback
+        from tpu_resiliency.integrations.loop import LoopContext
+
+        saves = []
+        with mock.patch.object(
+            PreemptionCheckpointCallback, "_reached",
+            staticmethod(lambda step: True),
+        ):
+            cb = PreemptionCheckpointCallback(
+                on_preemption=lambda s, i: saves.append(i)
+            )
+            ctx = LoopContext(step=7)
+            cb.on_step_end(ctx)
+        assert saves == [7] and ctx.should_stop and cb.preempted_at == 7
+
+    def test_negative_grace_rejected(self):
+        from tpu_resiliency.integrations import PreemptionCheckpointCallback
+
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            PreemptionCheckpointCallback(
+                on_preemption=lambda s, i: None, grace_steps=-1
+            )
+
+
 def test_drain_failure_does_not_eat_the_grace_window():
     """A broken background save must not block the final preemption save."""
     from tpu_resiliency.integrations import PreemptionCheckpointCallback

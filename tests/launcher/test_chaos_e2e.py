@@ -85,6 +85,29 @@ def test_mixed_scenario_converges_and_reproduces(tmp_path):
     )
 
 
+def test_autoscale_scenario_beats_baseline_and_reproduces(tmp_path):
+    """ACCEPTANCE (autoscale PR): fluctuating capacity (notice + rescind +
+    real preemption) + straggler + disk fault. scenario_autoscale internally
+    runs the controlled arm twice asserting identical (decision, action,
+    victim) schedules, runs the no-controller baseline, and asserts the
+    controlled goodput ratio STRICTLY beats it; here we additionally pin the
+    decision sequence and check the smoke-leg file contract."""
+    wd = str(tmp_path / "autoscale")
+    schedule, victims, disk, ratios = chaos_soak.scenario_autoscale(
+        seed=77, workdir=wd
+    )
+    assert [a for _, a, _ in schedule] == [
+        "swap", "checkpoint", "shrink", "expand",
+    ], schedule
+    assert victims == (77 % 4, (77 // 4) % 4, (77 // 16) % 4)
+    assert ratios[0] > ratios[1]
+    assert disk, "the disk-fault leg never injected"
+    # The smoke-leg contract: both arms' event streams persist for the
+    # offline tpu-metrics-dump --goodput --baseline comparison.
+    for name in ("controlled.jsonl", "baseline.jsonl"):
+        assert os.path.getsize(os.path.join(wd, name)) > 0
+
+
 @pytest.mark.slow
 def test_randomized_soak():
     """Long randomized soak: several random seeds through every scenario (the

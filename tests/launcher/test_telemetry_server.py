@@ -119,6 +119,7 @@ def test_goodput_publish_lands_in_metrics_view(server):
 
 def test_healthz_contract(server):
     srv, _ = server
+    srv.health_ttl = 0.0  # cache off: this test swaps health_fn per scrape
     status, body, _ = _get(srv.port, "/healthz")
     assert status == 200 and json.loads(body) == {"healthy": True}
     srv.health_fn = lambda: {"healthy": False, "restarts_used": 9}
@@ -139,7 +140,84 @@ def test_unknown_path_is_404_with_directory(server):
         _get(srv.port, "/nope")
     assert ei.value.code == 404
     doc = json.loads(ei.value.read())
-    assert set(doc["endpoints"]) == {"/metrics", "/goodput", "/healthz", "/hangz"}
+    assert set(doc["endpoints"]) == {
+        "/metrics", "/goodput", "/healthz", "/hangz", "/autoscale",
+    }
+
+
+def test_healthz_ttl_caches_and_serializes_scrapes(server):
+    """REGRESSION (autoscale PR): /healthz used to recompute the health
+    decision per scrape with no guard — a scrape storm stacked concurrent
+    health_fn runs. Two concurrent scrapes against a slow health_fn must cost
+    ONE evaluation; the cache expires after the TTL."""
+    import threading
+
+    srv, _ = server
+    srv.health_ttl = 0.4
+    calls = []
+
+    def slow_health():
+        calls.append(time.monotonic())
+        time.sleep(0.3)
+        return {"healthy": True, "n": len(calls)}
+
+    srv.health_fn = slow_health
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(_get(srv.port, "/healthz"))
+        )
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1, "concurrent scrapes stacked health_fn runs"
+    assert len(results) == 2
+    assert all(json.loads(body)["n"] == 1 for _, body, _ in results)
+    # TTL expiry: the next scrape recomputes.
+    time.sleep(0.45)
+    _get(srv.port, "/healthz")
+    assert len(calls) == 2
+
+
+def test_healthz_ttl_caches_the_failure_doc_too(server):
+    srv, _ = server
+    srv.health_ttl = 30.0
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise RuntimeError("boom")
+
+    srv.health_fn = boom
+    for _ in range(3):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/healthz")
+        assert ei.value.code == 503
+    assert len(calls) == 1
+
+
+def test_autoscale_endpoint(server):
+    srv, _ = server
+    # Without a controller: a degraded-but-valid document, never an error.
+    status, body, ctype = _get(srv.port, "/autoscale")
+    doc = json.loads(body)
+    assert status == 200 and "json" in ctype
+    assert doc["schema"] == "tpu-autoscale-1" and doc["mode"] == "off"
+    # With one wired: the controller's status document verbatim.
+    srv.autoscale_fn = lambda: {
+        "schema": "tpu-autoscale-1", "mode": "advise", "decisions_total": 2,
+        "decisions": [{"action": "swap", "predicted_delta_s": 1.2,
+                       "realized_delta_s": 0.9}],
+    }
+    doc = json.loads(_get(srv.port, "/autoscale")[1])
+    assert doc["mode"] == "advise" and doc["decisions_total"] == 2
+    # A crashing controller degrades the document, never the endpoint.
+    srv.autoscale_fn = lambda: (_ for _ in ()).throw(RuntimeError("dead"))
+    status, body, _ = _get(srv.port, "/autoscale")
+    assert status == 200 and "dead" in json.loads(body)["error"]
 
 
 def test_hangz_serves_census(server):

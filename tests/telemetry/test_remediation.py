@@ -220,3 +220,53 @@ class TestPolicyIntegration:
         pol.observe(self._report({0: 1.0, 1: 0.99}))
         assert (ACTION_REINSTATE, OUTCOME_OK) in eng.history
         assert history_at_demote[-1] == set()
+
+
+class TestExecuteAction:
+    """The external-drive path (autoscale PR): the controller routes its
+    swap/exclude/checkpoint decisions through the engine's actuators with the
+    same cooldown/dry-run audit semantics as a policy-driven plan."""
+
+    def test_swap_drives_the_actuators(self, seen):
+        restarts, published = [], []
+        eng = RemediationEngine(
+            spare_capacity_fn=lambda: 1,
+            publish_degraded_fn=published.append,
+            request_restart_fn=restarts.append,
+        )
+        action, outcome = eng.execute_action(
+            ACTION_SPARE_SWAP, [2], scores={2: 0.3}, reason="autoscale swap"
+        )
+        assert (action, outcome) == (ACTION_SPARE_SWAP, OUTCOME_OK)
+        assert restarts and published == [frozenset({2})]
+        assert eng.history[-1] == (ACTION_SPARE_SWAP, OUTCOME_OK)
+        ev = [e for e in seen if e.kind == "remediation_action"][-1]
+        assert ev.payload["reason"] == "autoscale swap"
+
+    def test_cooldown_and_dry_run_audit_skip(self, seen):
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: None, cooldown=60.0,
+        )
+        assert eng.execute_action(ACTION_CHECKPOINT, []) == (
+            ACTION_CHECKPOINT, OUTCOME_OK,
+        )
+        # Second call lands inside the cooldown: audited as skipped.
+        assert eng.execute_action(ACTION_CHECKPOINT, []) == (
+            ACTION_CHECKPOINT, OUTCOME_SKIPPED,
+        )
+        dry = RemediationEngine(checkpoint_fn=lambda: None, dry_run=True)
+        assert dry.execute_action(ACTION_CHECKPOINT, []) == (
+            ACTION_CHECKPOINT, OUTCOME_SKIPPED,
+        )
+
+    def test_failure_contained(self):
+        eng = RemediationEngine(
+            checkpoint_fn=lambda: (_ for _ in ()).throw(RuntimeError("no")),
+        )
+        assert eng.execute_action(ACTION_CHECKPOINT, []) == (
+            ACTION_CHECKPOINT, OUTCOME_FAILED,
+        )
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError):
+            RemediationEngine().execute_action("teleport", [1])

@@ -254,3 +254,86 @@ def test_metrics_dump_goodput_flag(tmp_path, capsys):
     assert doc["phases"]["restart"] == pytest.approx(0.2)
     assert doc["phases"]["train"] == pytest.approx(0.8)
     assert sum(doc["phases"].values()) == pytest.approx(doc["wall_clock_s"])
+
+
+# -- compare (autoscale PR) ---------------------------------------------------
+
+
+def _summary_for(records):
+    led = GoodputLedger()
+    led.observe_many(records)
+    return led.summary()
+
+
+def test_compare_summaries_and_ledgers():
+    from tpu_resiliency.utils.goodput import compare
+
+    # Run A: 4 clean steps. Run B: same steps plus a 2 s restart window.
+    a_recs = [_step(i, T0 + i) for i in range(5)]
+    b_recs = [_step(0, T0), _step(1, T0 + 1),
+              {"kind": "worker_failed", "ts": T0 + 1.5, "pid": 10},
+              _step(2, T0 + 3.5), _step(3, T0 + 4.5)]
+    led_a, led_b = GoodputLedger(), GoodputLedger()
+    led_a.observe_many(a_recs)
+    led_b.observe_many(b_recs)
+    cmp_doc = compare(led_a, led_b)  # ledger inputs
+    assert cmp_doc["schema"] == "tpu-goodput-compare-1"
+    assert cmp_doc["ratio_delta"] > 0  # A trained a larger share of its wall
+    assert cmp_doc["phases"]["restart"] == pytest.approx(-2.0)
+    # Summary-document inputs answer identically.
+    assert compare(led_a.summary(), led_b.summary()) == cmp_doc
+    assert cmp_doc["steps_delta"] == 1
+
+
+def test_compare_normalizes_wall_clock():
+    """A controlled run that finishes sooner must not look worse for being
+    shorter: the fractional deltas are per-wall-clock shares."""
+    from tpu_resiliency.utils.goodput import compare
+
+    short = _summary_for([_step(i, T0 + i * 0.5) for i in range(5)])  # 2 s
+    long = _summary_for([_step(i, T0 + i) for i in range(5)])         # 4 s
+    cmp_doc = compare(short, long)
+    assert cmp_doc["phases"]["train"] == pytest.approx(-2.0)  # absolute
+    assert cmp_doc["phase_frac"]["train"] == pytest.approx(0.0)  # share
+    assert cmp_doc["ratio_delta"] == pytest.approx(0.0)
+
+
+def test_render_compare(capsys):
+    from tpu_resiliency.utils.goodput import compare, render_compare
+
+    a = _summary_for([_step(i, T0 + i) for i in range(4)])
+    b = _summary_for([_step(0, T0),
+                      {"kind": "worker_failed", "ts": T0 + 1.2, "pid": 10},
+                      _step(1, T0 + 3)])
+    render_compare(compare(a, b), labels=("controlled", "baseline"))
+    out = capsys.readouterr().out
+    assert "controlled" in out and "baseline" in out
+    assert "per-phase delta" in out and "train" in out and "restart" in out
+
+
+def test_metrics_dump_goodput_baseline_flag(tmp_path, capsys):
+    from tpu_resiliency.tools import metrics_dump
+
+    run = tmp_path / "run.jsonl"
+    base = tmp_path / "base.jsonl"
+    with open(run, "w") as f:
+        for rec in [_step(i, T0 + i) for i in range(4)]:
+            f.write(json.dumps(rec) + "\n")
+    with open(base, "w") as f:
+        for rec in [_step(0, T0),
+                    {"kind": "worker_failed", "ts": T0 + 1.0, "pid": 10},
+                    _step(1, T0 + 3)]:
+            f.write(json.dumps(rec) + "\n")
+    assert metrics_dump.main(
+        [str(run), "--goodput", "--baseline", str(base)]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "vs" in out and "delta" in out
+    assert metrics_dump.main(
+        [str(run), "--goodput", "--baseline", str(base), "--format", "json"]
+    ) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "tpu-goodput-compare-1"
+    assert doc["ratio_delta"] > 0
+    # --baseline without --goodput is a usage error.
+    assert metrics_dump.main([str(run), "--baseline", str(base)]) == 2

@@ -37,6 +37,16 @@ class PreemptionCheckpointCallback(Callback):
     ``stop_on_preemption=False`` keeps training (save-and-continue — useful when
     the scheduler sometimes cancels the reclamation).
 
+    ``grace_steps=N`` defers the drain/save/stop by N steps after the sync
+    point first asserts — the **rescind window**. Cloud schedulers withdraw
+    maintenance notices routinely; before this knob a rescinded notice still
+    forced the full drain path (blocking ``maybe_finalize``, grace-window
+    save, loop stop) for a reclamation that never happened. With a grace
+    window, a notice that clears before it elapses emits one
+    ``preemption_rescinded`` event, cancels the pending deferred drain (it
+    simply never runs), and re-arms the callback for a later real notice.
+    The default (0) keeps today's act-immediately behavior.
+
     ``ckpt_manager`` (anything with ``maybe_finalize(blocking=True)`` — a
     :class:`~tpu_resiliency.checkpoint.local_manager.LocalCheckpointManager`,
     an :class:`~tpu_resiliency.checkpoint.async_ckpt.AsyncCheckpointer`, or a
@@ -59,12 +69,21 @@ class PreemptionCheckpointCallback(Callback):
         on_preemption: Callable[[Any, int], None],
         stop_on_preemption: bool = True,
         ckpt_manager: Any = None,
+        grace_steps: int = 0,
     ):
+        if grace_steps < 0:
+            raise ValueError("grace_steps must be >= 0")
         self.on_preemption = on_preemption
         self.stop_on_preemption = stop_on_preemption
         self.ckpt_manager = ckpt_manager
+        self.grace_steps = grace_steps
         self.preempted_at: Optional[int] = None  # last fired sync step
+        self.rescinded: int = 0  # notices withdrawn before the grace elapsed
         self._armed = True
+        #: step at which the current (armed) notice was first observed; the
+        #: drain/save is deferred until ``grace_steps`` later — the window a
+        #: rescind can cancel it in
+        self._pending_since: Optional[int] = None
 
     def _drain_inflight_saves(self, step: int) -> None:
         """Block until any in-flight async save has committed (rename done,
@@ -113,18 +132,39 @@ class PreemptionCheckpointCallback(Callback):
         # the point asserted and no re-fire happens).
         reached = self._reached(ctx.step)
         if not reached:
+            if self._pending_since is not None:
+                # The notice cleared inside the grace window: the scheduler
+                # withdrew the reclamation. Cancel the pending deferred
+                # drain/save (it never runs) and re-arm for a real one.
+                self.rescinded += 1
+                log.warning(
+                    f"preemption notice from step {self._pending_since} "
+                    f"rescinded at step {ctx.step}: cancelling the deferred "
+                    f"drain/save"
+                )
+                record_event(
+                    "preemption", "preemption_rescinded", step=ctx.step,
+                    noticed_step=self._pending_since, rank=ctx.rank,
+                )
+                self._pending_since = None
             self._armed = True
             return
         if not self._armed:
             return
+        if self._pending_since is None:
+            self._pending_since = ctx.step
+            record_event(
+                "preemption", "preemption_sync_point", step=ctx.step,
+                rank=ctx.rank,
+            )
+        if ctx.step - self._pending_since < self.grace_steps:
+            return  # rescind window still open: the drain/save stays deferred
         self._armed = False
+        self._pending_since = None
         self.preempted_at = ctx.step
         log.warning(
             f"preemption sync point at step {ctx.step}: saving before the grace "
             f"window closes"
-        )
-        record_event(
-            "preemption", "preemption_sync_point", step=ctx.step, rank=ctx.rank
         )
         # A notice landing mid-async-save must wait for the commit/rename:
         # otherwise the final save and the background writer interleave and

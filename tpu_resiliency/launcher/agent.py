@@ -97,6 +97,11 @@ class AgentConfig:
     #: by --rdzv-id at the CLI so jobs sharing a store endpoint never merge
     #: each other's metrics)
     metrics_push_prefix: str = "jobmetrics/default/"
+    #: goodput-optimal autoscale controller (``launcher/autoscale.py``):
+    #: "off" disables it; "advise" computes and audits every decision but
+    #: actuates nothing (the safe mode to trust the model first); "act"
+    #: routes decisions through the remediation actuators and restart rounds.
+    autoscale: str = "off"
 
     def __post_init__(self):
         if not self.node_id:
@@ -107,6 +112,11 @@ class AgentConfig:
             )
         if self.restart_policy not in ("any-failed", "min-healthy"):
             raise ValueError(f"unknown restart policy {self.restart_policy!r}")
+        if self.autoscale not in ("off", "advise", "act"):
+            raise ValueError(
+                f"unknown autoscale mode {self.autoscale!r}: "
+                f"want off | advise | act"
+            )
 
 
 class WorkersFailed(RuntimeError):
@@ -154,6 +164,7 @@ class ElasticAgent:
         #: replacement round's workers spawn
         self._healthy = True
         self.telemetry = None
+        self.autoscale = None
         self._metrics_store = None
         self.incidents: Optional["IncidentEngine"] = None
         if cfg.incidents_dir:
@@ -198,8 +209,61 @@ class ElasticAgent:
             fetch_snapshots=fetch_snapshots,
             health_fn=self.health,
             census_fn=self.hang_census,
+            autoscale_fn=(
+                self.autoscale.status if self.autoscale is not None else None
+            ),
         )
         self.telemetry.start()
+
+    # -- autoscale ---------------------------------------------------------
+
+    def _spare_capacity(self) -> int:
+        if self._spare_pool is None:
+            return 0
+        try:
+            return int(self._spare_pool.stats().get("warm", 0))
+        except Exception:
+            return 0
+
+    def _start_autoscale(self) -> None:
+        """Wire the goodput-optimal controller (``launcher/autoscale.py``):
+        signals from the shared events stream, actuators through a
+        remediation engine (swap/exclude audit semantics) and restart-round
+        requests (shrink/re-expand — the workers' ``load_resharded`` resume
+        makes the resized world trainable)."""
+        from tpu_resiliency.launcher.autoscale import (
+            AutoscaleController,
+            CostModel,
+        )
+        from tpu_resiliency.telemetry.remediation import RemediationEngine
+        from tpu_resiliency.utils.events import EVENTS_FILE_ENV
+
+        engine = RemediationEngine(
+            spare_capacity_fn=self._spare_capacity,
+            request_restart_fn=lambda reason: self.rdzv.request_restart(
+                f"autoscale: {reason}"
+            ),
+            publish_degraded_fn=lambda degraded: None,
+            cooldown=10.0,
+        )
+        self.autoscale = AutoscaleController(
+            mode=self.cfg.autoscale,
+            cost_model=CostModel.from_bench(os.getcwd()),
+            remediation=engine,
+            spare_capacity_fn=self._spare_capacity,
+            shrink_fn=lambda victims, reason: self.rdzv.request_restart(
+                f"autoscale shrink {victims}: {reason}"
+            ),
+            expand_fn=lambda reason: self.rdzv.request_restart(
+                f"autoscale re-expand: {reason}"
+            ),
+            target_world=self.cfg.max_nodes * self.cfg.nproc_per_node,
+            events_file=os.environ.get(EVENTS_FILE_ENV) or None,
+            interval=max(0.25, self.cfg.monitor_interval),
+        )
+        self.autoscale.start()
+        if self.telemetry is not None:
+            self.telemetry.autoscale_fn = self.autoscale.status
 
     # -- hang forensics ----------------------------------------------------
 
@@ -373,6 +437,8 @@ class ElasticAgent:
         self._ipc.start()
         if self.cfg.telemetry_port is not None:
             self._start_telemetry()
+        if self.cfg.autoscale != "off":
+            self._start_autoscale()
         self.restarter.initialize()
         prev_round = -1
         try:
@@ -474,6 +540,14 @@ class ElasticAgent:
                 self._ipc.stop()
             if self._spare_pool is not None:
                 self._spare_pool.close()
+            if self.autoscale is not None:
+                try:
+                    # stop() finalizes pending outcomes so every decision the
+                    # run audited carries a realized delta in the stream.
+                    self.autoscale.stop()
+                except Exception:
+                    pass
+                self.autoscale = None
             if self.telemetry is not None:
                 try:
                     self.telemetry.stop()

@@ -176,6 +176,18 @@ def build_parser() -> argparse.ArgumentParser:
         "0 binds an ephemeral port; the bound port is written to "
         "<run-dir>/telemetry.port (omit the flag to disable)",
     )
+    p.add_argument(
+        "--autoscale",
+        choices=("off", "advise", "act"),
+        default="off",
+        help="goodput-optimal autoscale controller (launcher/autoscale.py): "
+        "consumes the goodput ledger, straggler scores, warm-spare depth, "
+        "and preemption notices (incl. rescinds) and picks the goodput-"
+        "maximizing action from an explicit cost model. 'advise' (the safe "
+        "mode to start with) audits every decision as autoscale_decision "
+        "events + the /autoscale endpoint without acting; 'act' routes "
+        "decisions through the remediation actuators and restart rounds",
+    )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
     p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
@@ -452,6 +464,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             os.path.abspath(args.incidents_dir) if args.incidents_dir else ""
         ),
         telemetry_port=args.telemetry_port,
+        autoscale=args.autoscale,
         # rdzv-id namespacing keeps two jobs on one store endpoint from
         # merging each other's metrics snapshots into their /metrics views.
         metrics_push_prefix=f"jobmetrics/{args.rdzv_id}/",

@@ -25,6 +25,14 @@ dir), serving three endpoints:
   missing / absent ranks and waiter ages (the store's ``barrier_census``
   op), and ranked hang suspects — "who is stuck where, and who never
   arrived", while the job is still wedged.
+- ``GET /autoscale`` — the autoscale controller's status document
+  (``schema: tpu-autoscale-1``, ``launcher/autoscale.py``): mode, pending
+  preemption notices, the recent decision audit with predicted AND realized
+  goodput deltas, forecast accuracy, and the live cost-model constants.
+
+``/healthz`` results are TTL-cached (``health_ttl``, default 1 s) behind a
+lock, so a scrape storm from fleet pollers costs one ``health_fn``
+evaluation per TTL instead of stacking concurrent runs.
 
 Each ``/metrics`` or ``/goodput`` request also refreshes the ledger and
 publishes attribution deltas back through the event stream
@@ -38,6 +46,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -72,6 +81,8 @@ class TelemetryServer:
         fetch_snapshots: Optional[Callable[[], list]] = None,
         health_fn: Optional[Callable[[], dict]] = None,
         census_fn: Optional[Callable[[], dict]] = None,
+        autoscale_fn: Optional[Callable[[], dict]] = None,
+        health_ttl: float = 1.0,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.ledger = GoodputLedger()
@@ -82,6 +93,13 @@ class TelemetryServer:
         self.fetch_snapshots = fetch_snapshots
         self.health_fn = health_fn
         self.census_fn = census_fn
+        self.autoscale_fn = autoscale_fn
+        #: /healthz result cache lifetime: a scrape storm (fleet pollers all
+        #: hitting one launcher) must not stack concurrent health_fn runs.
+        #: 0 disables caching (computation still serializes under the lock).
+        self.health_ttl = health_ttl
+        self._health_lock = threading.Lock()
+        self._health_cache: Optional[tuple[float, dict]] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         #: byte offset of the last complete line consumed from events_file
@@ -134,7 +152,7 @@ class TelemetryServer:
                 f.write(f"{port}\n")
             os.replace(tmp, self.port_file)
         log.info(f"telemetry endpoint on http://{self._host}:{port} "
-                 f"(/metrics /goodput /healthz /hangz)")
+                 f"(/metrics /goodput /healthz /hangz /autoscale)")
         return port
 
     def stop(self) -> None:
@@ -166,14 +184,22 @@ class TelemetryServer:
             summary = self.refresh()
             self._respond(req, 200, _json_body(summary), "application/json")
         elif path == "/healthz":
-            doc = {"healthy": True}
-            if self.health_fn is not None:
-                try:
-                    doc = dict(self.health_fn())
-                except Exception as e:
-                    doc = {"healthy": False, "error": repr(e)}
+            doc = self._health_doc()
             status = 200 if doc.get("healthy") else 503
             self._respond(req, status, _json_body(doc), "application/json")
+        elif path == "/autoscale":
+            if self.autoscale_fn is None:
+                doc = {"schema": "tpu-autoscale-1", "mode": "off",
+                       "error": "no autoscale controller wired"}
+            else:
+                try:
+                    doc = dict(self.autoscale_fn())
+                except Exception as e:
+                    # A broken controller degrades the document, never the
+                    # endpoint — same contract as /hangz.
+                    doc = {"schema": "tpu-autoscale-1", "error": repr(e)}
+            doc.setdefault("schema", "tpu-autoscale-1")
+            self._respond(req, 200, _json_body(doc), "application/json")
         elif path == "/hangz":
             if self.census_fn is None:
                 doc = {"schema": "tpu-hangz-1", "error": "no census source wired"}
@@ -191,9 +217,30 @@ class TelemetryServer:
                 req, 404,
                 _json_body({"error": f"unknown path {path!r}",
                             "endpoints": ["/metrics", "/goodput", "/healthz",
-                                          "/hangz"]}),
+                                          "/hangz", "/autoscale"]}),
                 "application/json",
             )
+
+    def _health_doc(self) -> dict:
+        """The /healthz body, TTL-cached. Computation happens INSIDE the lock
+        on purpose: two concurrent scrapes during a slow health_fn serialize,
+        and the second returns the first's fresh result instead of running
+        health_fn again — a scrape storm costs one evaluation per TTL."""
+        with self._health_lock:
+            now = time.monotonic()
+            if (
+                self._health_cache is not None
+                and now - self._health_cache[0] < self.health_ttl
+            ):
+                return self._health_cache[1]
+            doc = {"healthy": True}
+            if self.health_fn is not None:
+                try:
+                    doc = dict(self.health_fn())
+                except Exception as e:
+                    doc = {"healthy": False, "error": repr(e)}
+            self._health_cache = (time.monotonic(), doc)
+            return doc
 
     @staticmethod
     def _respond(

@@ -79,6 +79,10 @@ class HealthVectorPolicy:
         self._flag_streak: dict[int, int] = {}
         self._clean_streak: dict[int, int] = {}
         self._degraded: set[int] = set()
+        #: the most recent decision (changed or not) — embedders that poll
+        #: instead of sinking (the autoscale controller's view assembly, the
+        #: /autoscale document) read the current verdict here
+        self.last_decision: Optional[HealthDecision] = None
 
     @property
     def degraded(self) -> frozenset[int]:
@@ -113,6 +117,7 @@ class HealthVectorPolicy:
             flagged=frozenset(flagged),
             scores={r: float(s) for r, s in (report.perf_scores or {}).items()},
         )
+        self.last_decision = decision
         if decision.changed:
             record_event(
                 "telemetry", "degraded_set",

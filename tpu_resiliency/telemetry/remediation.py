@@ -181,14 +181,16 @@ class RemediationEngine:
         runner: Callable,
         decision: HealthDecision,
         in_cooldown: bool = False,
+        reason: str = "",
     ) -> tuple[str, str]:
         ranks = sorted(decision.newly_degraded)
+        why = {"reason": reason} if reason else {}
         if self.dry_run or in_cooldown:
             outcome = OUTCOME_SKIPPED
             detail = "dry_run" if self.dry_run else "cooldown"
             record_event(
                 "remediation", "remediation_action", action=action,
-                outcome=outcome, ranks=ranks, detail=detail,
+                outcome=outcome, ranks=ranks, detail=detail, **why,
             )
             return action, outcome
         with span(
@@ -208,9 +210,48 @@ class RemediationEngine:
             record_event(
                 "remediation", "remediation_action", action=action,
                 outcome=outcome, ranks=ranks,
-                **({"detail": detail} if detail else {}),
+                **({"detail": detail} if detail else {}), **why,
             )
         return action, outcome
+
+    # -- external drive (the autoscale controller's path) --------------------
+
+    def execute_action(
+        self,
+        action: str,
+        ranks,
+        scores: Optional[dict] = None,
+        reason: str = "",
+    ) -> tuple[str, str]:
+        """Run ONE actuator outside a policy-driven plan, with the same
+        cooldown/dry-run audit semantics (``launcher/autoscale.py`` routes
+        its swap/exclude/checkpoint decisions through here so policy-driven
+        and controller-driven remediations share one audit trail). Returns
+        the ``(action, outcome)`` pair, also appended to ``history``."""
+        runners = {
+            ACTION_CHECKPOINT: self._do_checkpoint,
+            ACTION_SPARE_SWAP: self._do_spare_swap,
+            ACTION_EXCLUDE: self._do_exclude,
+        }
+        if action not in runners:
+            raise ValueError(f"unknown remediation action {action!r}")
+        ranks = frozenset(int(r) for r in ranks)
+        decision = HealthDecision(
+            degraded=ranks, newly_degraded=ranks, recovered=frozenset(),
+            flagged=ranks,
+            scores={int(r): float(s) for r, s in (scores or {}).items()},
+        )
+        in_cooldown = (
+            time.monotonic() - self._last_action_ts
+        ) < self.cooldown
+        result = self._execute(
+            action, runners[action], decision, in_cooldown=in_cooldown,
+            reason=reason,
+        )
+        if result[1] == OUTCOME_OK:
+            self._last_action_ts = time.monotonic()
+        self.history.append(result)
+        return result
 
     # -- actuators ----------------------------------------------------------
 

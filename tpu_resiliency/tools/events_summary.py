@@ -159,12 +159,45 @@ def _fmt_restart_signalled(p: dict) -> str:
     )
 
 
+def _fmt_delta(v) -> str:
+    return f"{v:+.3f}s" if isinstance(v, (int, float)) else "?"
+
+
+def _fmt_autoscale_decision(p: dict) -> str:
+    victims = p.get("victims") or []
+    target = f" ranks {victims}" if victims else ""
+    return (
+        f"#{p.get('decision_id')} {p.get('action')}{target}: predicted "
+        f"{_fmt_delta(p.get('predicted_delta_s'))} "
+        f"[{p.get('mode')}/{p.get('outcome')}] — {p.get('reason', '')}"
+    )
+
+
+def _fmt_autoscale_outcome(p: dict) -> str:
+    return (
+        f"#{p.get('decision_id')} {p.get('action')}: predicted "
+        f"{_fmt_delta(p.get('predicted_delta_s'))} realized "
+        f"{_fmt_delta(p.get('realized_delta_s'))} (error "
+        f"{_fmt_delta(p.get('forecast_error_s'))})"
+    )
+
+
+def _fmt_preemption_rescinded(p: dict) -> str:
+    return (
+        f"notice from step {p.get('noticed_step')} withdrawn at step "
+        f"{p.get('step')}; deferred drain/save cancelled"
+    )
+
+
 _FORMATTERS = {
     "rendezvous_round": _fmt_rendezvous_round,
     "worker_failed": _fmt_worker_failed,
     "worker_promoted": _fmt_worker_promoted,
     "straggler_report": _fmt_straggler_report,
     "restart_signalled": _fmt_restart_signalled,
+    "autoscale_decision": _fmt_autoscale_decision,
+    "autoscale_outcome": _fmt_autoscale_outcome,
+    "preemption_rescinded": _fmt_preemption_rescinded,
 }
 
 #: Kinds counted in the footer under friendlier names.
@@ -179,6 +212,8 @@ _SUMMARY_LINES = (
     ("straggler_report", "straggler reports"),
     ("degraded_set", "degraded-set updates"),
     ("preemption_sync_point", "preemption sync points"),
+    ("preemption_rescinded", "preemption notices rescinded"),
+    ("autoscale_decision", "autoscale decisions"),
     ("timeouts_calculated", "FT timeout calibrations"),
     ("training_finished", "training finished"),
     ("budget_exhausted", "restart budget exhausted"),

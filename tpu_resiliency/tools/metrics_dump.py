@@ -100,6 +100,32 @@ def render_report(reg: MetricsRegistry, out=None) -> None:
             )
             print(f"    {label}: {detail}", file=out)
 
+    # Autoscale controller: decisions by action/outcome + forecast accuracy.
+    decisions = snap.get("tpu_autoscale_decisions_total", [])
+    if decisions:
+        rows = sorted(
+            (dict(e["labels"]).get("action", "?"),
+             dict(e["labels"]).get("outcome", "?"), e["value"])
+            for e in decisions
+        )
+        detail = " ".join(f"{a}/{o}={int(v)}" for a, o, v in rows)
+        print(f"autoscale decisions: {detail}", file=out)
+        for labels, h in sorted(
+            reg.histograms("tpu_autoscale_predicted_vs_realized").items()
+        ):
+            if not h.count:
+                continue
+            action = dict(labels).get("action", "?")
+            print(
+                f"    forecast error ({action}): n={h.count} "
+                f"mean={h.sum / h.count:+.3f}s "
+                f"p95={h.quantile(0.95):+.3f}s",
+                file=out,
+            )
+    rescinds = _counter_total(reg, "tpu_preemption_rescinded_total")
+    if rescinds:
+        print(f"    preemption notices rescinded: {int(rescinds)}", file=out)
+
     span_lines = _latency_lines(reg, "tpu_span_seconds", "span")
     if span_lines:
         print("span durations (p50/p95):", file=out)
@@ -167,7 +193,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         "report; --format json emits the same attribution document the "
         "launcher's live /goodput endpoint serves",
     )
+    ap.add_argument(
+        "--baseline", default=None,
+        help="with --goodput: a second events JSONL to compare against — "
+        "renders per-phase deltas and the goodput-ratio delta (this run "
+        "minus the baseline), the arithmetic the autoscale chaos scenario "
+        "gates on",
+    )
     args = ap.parse_args(argv)
+    if args.baseline and not args.goodput:
+        print("--baseline requires --goodput", file=sys.stderr)
+        return 2
     try:
         with open(args.events_file):
             pass
@@ -179,16 +215,39 @@ def main(argv: Optional[list[str]] = None) -> int:
         print("no events to aggregate", file=sys.stderr)
         return 1
     if args.goodput:
-        from tpu_resiliency.utils.goodput import GoodputLedger, render_table
+        from tpu_resiliency.utils.goodput import (
+            GoodputLedger,
+            compare,
+            render_compare,
+            render_table,
+        )
 
         ledger = GoodputLedger()
         ledger.observe_many(records)
         summary = ledger.summary()
+        comparison = None
+        if args.baseline:
+            try:
+                base_records = read_events(args.baseline)
+            except OSError as e:
+                print(f"cannot read baseline events file: {e}", file=sys.stderr)
+                return 1
+            if not base_records:
+                print("no baseline events to aggregate", file=sys.stderr)
+                return 1
+            base = GoodputLedger()
+            base.observe_many(base_records)
+            comparison = compare(summary, base.summary())
 
         def emit_goodput() -> None:
             if args.format == "json":
-                json.dump(summary, sys.stdout, indent=2)
+                json.dump(
+                    comparison if comparison is not None else summary,
+                    sys.stdout, indent=2,
+                )
                 sys.stdout.write("\n")
+            elif comparison is not None:
+                render_compare(comparison)
             else:
                 render_table(summary)
 

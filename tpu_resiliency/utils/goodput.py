@@ -366,6 +366,76 @@ class GoodputLedger:
         return summary
 
 
+COMPARE_SCHEMA = "tpu-goodput-compare-1"
+
+
+def compare(a, b) -> dict:
+    """Per-phase attribution deltas + ratio delta between two runs (``a``
+    minus ``b``). Accepts :class:`GoodputLedger` instances or their
+    :meth:`~GoodputLedger.summary` documents.
+
+    This is the autoscale scenario's acceptance arithmetic — "did the
+    controlled run beat the no-controller baseline of the same seed?" — and
+    a standalone operator tool (``tpu-metrics-dump --goodput --baseline``):
+    a positive ``ratio_delta`` means run ``a`` spent a larger fraction of
+    its wall clock training."""
+    sa = a.summary() if hasattr(a, "summary") else dict(a)
+    sb = b.summary() if hasattr(b, "summary") else dict(b)
+    pa, pb = sa.get("phases") or {}, sb.get("phases") or {}
+    wa, wb = sa.get("wall_clock_s") or 0.0, sb.get("wall_clock_s") or 0.0
+    phases = {
+        p: round(pa.get(p, 0.0) - pb.get(p, 0.0), 6)
+        for p in sorted(set(pa) | set(pb))
+    }
+    # Fractional deltas normalize away different wall clocks (a controlled
+    # run that finishes sooner must not look worse for being shorter).
+    phase_frac = {
+        p: round(
+            (pa.get(p, 0.0) / wa if wa > 0 else 0.0)
+            - (pb.get(p, 0.0) / wb if wb > 0 else 0.0),
+            6,
+        )
+        for p in phases
+    }
+    ra = sa.get("goodput_ratio") or 0.0
+    rb = sb.get("goodput_ratio") or 0.0
+    return {
+        "schema": COMPARE_SCHEMA,
+        "wall_clock_s": [round(wa, 6), round(wb, 6)],
+        "goodput_ratio": [ra, rb],
+        "ratio_delta": round(ra - rb, 6),
+        "phases": phases,
+        "phase_frac": phase_frac,
+        "steps_delta": int((sa.get("steps") or 0) - (sb.get("steps") or 0)),
+    }
+
+
+def render_compare(cmp: dict, out=None, labels=("run", "baseline")) -> None:
+    """Operator view of one :func:`compare` document."""
+    import sys
+
+    out = sys.stdout if out is None else out
+    ra, rb = cmp.get("goodput_ratio") or [0.0, 0.0]
+    wa, wb = cmp.get("wall_clock_s") or [0.0, 0.0]
+    print(
+        f"goodput {labels[0]} {ra:.3f} vs {labels[1]} {rb:.3f} "
+        f"(delta {cmp.get('ratio_delta', 0.0):+.3f}; wall {wa:.1f}s vs "
+        f"{wb:.1f}s)",
+        file=out,
+    )
+    print("per-phase delta (seconds / share of wall):", file=out)
+    fr = cmp.get("phase_frac") or {}
+    for phase in ("train", "ckpt_stall", "restart", "incident", "unattributed"):
+        if phase not in (cmp.get("phases") or {}):
+            continue
+        d = cmp["phases"][phase]
+        print(
+            f"    {phase:<13} {d:>+9.2f} s  {100.0 * fr.get(phase, 0.0):+6.1f}%",
+            file=out,
+        )
+    print(f"steps delta: {cmp.get('steps_delta', 0):+d}", file=out)
+
+
 def render_table(summary: dict, out=None) -> None:
     """The operator view of one attribution document (offline twin of the
     launcher's ``/goodput`` endpoint — same numbers, table form)."""

@@ -68,6 +68,14 @@ STEP_BUCKETS_S = (
     10.0, 30.0, 60.0, 120.0, 300.0,
 )
 
+#: Symmetric bucket bounds (seconds) for the autoscale forecast error
+#: (``tpu_autoscale_predicted_vs_realized`` observes realized − predicted):
+#: a well-calibrated controller clusters around zero; the signed tails show
+#: which direction the cost model misses in.
+FORECAST_ERROR_BUCKETS_S = (
+    -300.0, -60.0, -10.0, -1.0, -0.1, 0.0, 0.1, 1.0, 10.0, 60.0, 300.0,
+)
+
 #: An ``iteration_start`` delta larger than this is not a step — it's a gap
 #: (hang, restart, operator pause) and must not pollute the step histogram or
 #: the goodput ledger's ``train`` attribution (``utils/goodput.py`` shares it).
@@ -820,6 +828,32 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "tpu_incident_steps_lost_total",
                 "training steps lost across incidents (resume gap)",
             ).inc(max(0.0, rec["steps_lost"]))
+    elif kind == "autoscale_decision":
+        reg.counter(
+            "tpu_autoscale_decisions_total",
+            "autoscale controller decisions by action and actuation outcome "
+            "(advised = advise mode, never acted)",
+            action=str(rec.get("action", "?")),
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "autoscale_outcome":
+        # One per settled decision: the controller's forecast accuracy as a
+        # first-class metric (realized minus predicted goodput delta).
+        p, r = rec.get("predicted_delta_s"), rec.get("realized_delta_s")
+        if isinstance(p, (int, float)) and isinstance(r, (int, float)):
+            reg.histogram(
+                "tpu_autoscale_predicted_vs_realized",
+                "autoscale forecast error per settled decision "
+                "(realized minus predicted goodput delta, seconds)",
+                FORECAST_ERROR_BUCKETS_S,
+                action=str(rec.get("action", "?")),
+            ).observe(r - p)
+    elif kind == "preemption_rescinded":
+        reg.counter(
+            "tpu_preemption_rescinded_total",
+            "preemption notices withdrawn before their grace window elapsed "
+            "(the deferred drain/save was cancelled)",
+        ).inc()
     elif kind == "remediation_action":
         reg.counter(
             "tpu_remediation_actions_total",
