@@ -335,4 +335,107 @@ grep -q '"kind": *"autoscale_decision"' "$AD/events.jsonl" \
 grep -q '"kind": *"autoscale_outcome"' "$AD/events.jsonl" \
     || { echo "FAIL: advise run never settled a realized outcome"; exit 1; }
 
+echo "== smoke: fleet federation (2 concurrent jobs -> fleetd scoreboard; SIGKILL one, fleet endpoints stay up)"
+FL="$WORKDIR/fleet"
+mkdir -p "$FL"
+cat > "$FL/worker.py" <<'PY'
+import os, sys, time
+from tpu_resiliency.utils.events import record
+
+stop = sys.argv[1]
+i = 0
+deadline = time.time() + 120
+while not os.path.exists(stop) and time.time() < deadline:
+    record("inprocess", "iteration_start", iteration=i)
+    i += 1
+    time.sleep(0.1)
+PY
+FLEET_PIDS=()
+for J in alpha beta; do
+    setsid python -m tpu_resiliency.launcher.launch \
+        --standalone --nproc-per-node 2 --max-restarts 1 --no-ft-monitors \
+        --rdzv-last-call 0.2 --monitor-interval 0.1 \
+        --rdzv-id "job-$J" --fleet-dir "$FL/dir" \
+        --events-file "$FL/events-$J.jsonl" --run-dir "$FL/run-$J" \
+        "$FL/worker.py" "$FL/stop" > "$FL/launcher-$J.log" 2>&1 &
+    FLEET_PIDS+=($!)
+done
+python -m tpu_resiliency.tools.fleetd --fleet-dir "$FL/dir" --port 0 \
+    --scrape-interval 1 --snapshot "$FL/fleet.json" > "$FL/fleetd.log" 2>&1 &
+FLEETD_PID=$!
+python - "$FL" <<'PY'
+import json, os, sys, time, urllib.request
+
+fl = sys.argv[1]
+port_file = os.path.join(fl, "dir", "fleetd.port")
+deadline = time.time() + 60
+while not os.path.exists(port_file):
+    assert time.time() < deadline, "fleetd.port handshake never appeared"
+    time.sleep(0.2)
+port = int(open(port_file).read().strip())
+doc, rows = None, {}
+while time.time() < deadline:
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet/goodput", timeout=5).read())
+    except OSError:
+        time.sleep(0.3)
+        continue
+    rows = {r["job"]: r["status"] for r in doc.get("jobs", [])}
+    if rows.get("job-alpha") == "ok" and rows.get("job-beta") == "ok":
+        break
+    time.sleep(0.3)
+assert rows.get("job-alpha") == "ok" and rows.get("job-beta") == "ok", doc
+print(f"fleet scoreboard OK: {rows}")
+with open(os.path.join(fl, "fleetd.port.resolved"), "w") as f:
+    f.write(str(port))
+PY
+FLEETD_PORT=$(cat "$FL/fleetd.port.resolved")
+# SIGKILL one whole job (launcher + workers): the fleet view must keep
+# serving with the dead job marked unreachable, never a non-200.
+kill -9 -- "-${FLEET_PIDS[0]}" 2>/dev/null || kill -9 "${FLEET_PIDS[0]}"
+python - "$FLEETD_PORT" <<'PY'
+import json, sys, time, urllib.request
+
+port = int(sys.argv[1])
+deadline = time.time() + 30
+rows = {}
+while time.time() < deadline:
+    slo = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleet/slo", timeout=10).read())
+    rows = {r["job"]: r["status"] for r in slo.get("jobs", [])}
+    if rows.get("job-alpha") == "unreachable":
+        break
+    time.sleep(0.3)
+assert rows.get("job-alpha") == "unreachable", rows
+assert rows.get("job-beta") == "ok", rows
+for ep in ("/fleet/metrics", "/fleet/goodput", "/fleet/slo",
+           "/fleet/incidents", "/fleet/hangz", "/fleet/snapshot"):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}", timeout=10) as r:
+        assert r.status == 200, (ep, r.status)
+prom = urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/fleet/metrics", timeout=10).read().decode()
+assert 'job="job-beta"' in prom, prom[:2000]
+assert "tpu_fleet_jobs" in prom and "tpu_fleet_scrape_seconds" in prom, prom[:2000]
+assert 'tpu_fleet_scrape_errors_total{job="job-alpha"}' in prom, prom[:2000]
+print("fleet kill leg OK: job-alpha unreachable, all /fleet/* endpoints 200")
+PY
+touch "$FL/stop"
+# The persisted snapshot renders offline, and --job slices the dead job's
+# stamped stream back out of its events file.
+python -m tpu_resiliency.tools.fleet_cli scoreboard --snapshot "$FL/fleet.json" | sed 's/^/    /'
+python -m tpu_resiliency.tools.fleet_cli slo --snapshot "$FL/fleet.json" | sed 's/^/    /'
+python -m tpu_resiliency.tools.events_summary "$FL/events-beta.jsonl" \
+    --job job-beta --no-timeline | sed 's/^/    /'
+python -m tpu_resiliency.tools.metrics_dump "$FL/events-beta.jsonl" \
+    --job job-beta --format prom | grep -q "tpu_events_total" \
+    || { echo "FAIL: --job slice lost the job's own events"; exit 1; }
+kill "$FLEETD_PID" 2>/dev/null || true
+kill -- "-${FLEET_PIDS[1]}" 2>/dev/null || kill "${FLEET_PIDS[1]}" 2>/dev/null || true
+wait "${FLEET_PIDS[1]}" 2>/dev/null || true
+wait "$FLEETD_PID" 2>/dev/null || true
+
+echo "== smoke: fleet scrape scaling (bench --smoke: sub-linear + SIGKILL containment)"
+python scripts/bench_fleet.py --smoke
+
 echo "smoke_observability: PASS ($WORKDIR)"

@@ -1,0 +1,258 @@
+"""Fleet aggregation over real (loopback) job telemetry endpoints: merged
+metrics with job labels + fleet totals, scoreboard/SLO/incident/hangz folds,
+per-job failure containment, churn semantics, bucket quantiles."""
+
+import json
+import os
+import time
+
+import pytest
+
+from tpu_resiliency.fleet.aggregator import (
+    FLEET_TOTAL_PREFIX,
+    FleetAggregator,
+    bucket_quantile,
+)
+from tpu_resiliency.fleet.registry import live_leases, read_leases
+from tpu_resiliency.launcher.telemetry import TelemetryServer
+from tpu_resiliency.utils import events
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    old = os.environ.pop(events.EVENTS_FILE_ENV, None)
+    yield
+    events.clear_sinks()
+    if old is not None:
+        os.environ[events.EVENTS_FILE_ENV] = old
+
+
+def start_job(tmp_path, job, *, restarts=0, steps=0):
+    """One registered job: a real TelemetryServer with a fleet lease and some
+    registry state to federate."""
+    srv = TelemetryServer(
+        port=0,
+        fleet_dir=str(tmp_path / "fleet"),
+        job=job,
+        node_id=f"node-{job}",
+        events_file=str(tmp_path / f"{job}.jsonl"),
+        lease_interval=0.2,
+    )
+    srv.start()
+    if restarts:
+        srv.registry.counter(
+            "tpu_restarts_total", "restarts", layer="injob"
+        ).inc(restarts)
+    if steps:
+        t0 = time.time() - steps
+        with open(tmp_path / f"{job}.jsonl", "w") as f:
+            for i in range(steps + 1):
+                f.write(json.dumps({
+                    "kind": "iteration_start", "iteration": i, "ts": t0 + i,
+                    "pid": 1, "rank": 0,
+                }) + "\n")
+    return srv
+
+
+def test_scrape_folds_jobs_with_labels_and_totals(tmp_path):
+    a = start_job(tmp_path, "job-a", restarts=2, steps=4)
+    b = start_job(tmp_path, "job-b", restarts=3)
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        view = agg.scrape()
+        prom = view.to_prometheus()
+        # The regression the satellite names: same-named series stay separate
+        # per job AND sum in the explicit fleet-total family.
+        assert 'tpu_restarts_total{job="job-a",layer="injob"} 2' in prom
+        assert 'tpu_restarts_total{job="job-b",layer="injob"} 3' in prom
+        assert f'{FLEET_TOTAL_PREFIX}tpu_restarts_total{{layer="injob"}} 5' in prom
+        # fleetd's own operational metrics ride the same registry.
+        assert "tpu_fleet_jobs 2" in prom
+        assert "tpu_fleet_scrape_seconds_count 1" in prom
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_goodput_scoreboard_ranks_by_ratio(tmp_path):
+    a = start_job(tmp_path, "job-a", steps=5)  # trained: ratio 1.0
+    b = start_job(tmp_path, "job-b")           # idle: ratio 0.0
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        doc = agg.scrape().goodput_doc()
+        assert doc["schema"] == "tpu-fleet-goodput-1"
+        assert [r["job"] for r in doc["jobs"]] == ["job-a", "job-b"]
+        assert doc["jobs"][0]["goodput_ratio"] == pytest.approx(1.0)
+        assert all(r["status"] == "ok" for r in doc["jobs"])
+        assert doc["fleet"]["jobs"] == 2 and doc["fleet"]["reachable"] == 2
+        assert doc["fleet"]["goodput_ratio"] > 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_dead_job_is_unreachable_never_fatal(tmp_path):
+    """One crashed job (lease present, endpoint gone) = one unreachable row
+    + a fleet_job_unreachable audit; the fold itself never fails."""
+    a = start_job(tmp_path, "job-a", steps=3)
+    dead = start_job(tmp_path, "job-dead")
+    # Simulate SIGKILL: the HTTP endpoint dies, the lease file stays behind
+    # (a killed process removes nothing).
+    dead._lease_stop.set()
+    dead._lease_thread.join(timeout=5)
+    dead._httpd.shutdown()
+    dead._httpd.server_close()
+    agg = FleetAggregator(str(tmp_path / "fleet"), timeout=1.0)
+    try:
+        view = agg.scrape()
+        by_job = {s["job"]: s for s in view.states}
+        assert by_job["job-a"]["reachable"] is True
+        assert by_job["job-dead"]["reachable"] is False
+        assert by_job["job-dead"]["error"]
+        gp = view.goodput_doc()
+        # Unreachable rows sort last and say why.
+        assert gp["jobs"][-1]["job"] == "job-dead"
+        assert gp["jobs"][-1]["status"] == "unreachable"
+        # The SLO page leads with the unreachable job (it IS the incident).
+        assert view.slo_doc()["jobs"][0]["job"] == "job-dead"
+        assert "tpu_fleet_scrape_errors_total" in view.to_prometheus()
+        assert agg.registry.counter(
+            "tpu_fleet_scrape_errors_total", "", job="job-dead"
+        ).value == 1
+    finally:
+        a.stop()
+
+
+def test_churn_no_duplicate_rows_and_no_double_count(tmp_path):
+    """The churn satellite: a job that dies, expires, and re-registers under
+    the same rdzv id mid-scrape-loop yields exactly one scoreboard row per
+    scrape and never double-counts its counters."""
+    fleet = str(tmp_path / "fleet")
+    agg = FleetAggregator(fleet, lease_ttl=60.0, timeout=1.0)
+    first = start_job(tmp_path, "job-x", restarts=1)
+    assert len(agg.scrape().goodput_doc()["jobs"]) == 1
+    # Crash (no lease removal), then a new incarnation re-registers the SAME
+    # job id from a new pid/port before the old lease expired.
+    first._lease_stop.set()
+    first._lease_thread.join(timeout=5)
+    first._httpd.shutdown()
+    first._httpd.server_close()
+    # The dead incarnation's leftover lease, under its own (pid-distinct in
+    # production; both incarnations share this test process's pid) filename,
+    # heartbeat slightly behind the replacement's.
+    doc = json.loads(open(first._lease.path).read())
+    doc["pid"], doc["heartbeat_ts"] = 99999, time.time() - 1.0
+    old_lease_path = os.path.join(fleet, "job-job-x-99999.json")
+    with open(old_lease_path, "w") as f:
+        json.dump(doc, f)
+    second = start_job(tmp_path, "job-x", restarts=4)
+    try:
+        assert len(read_leases(fleet)) == 2  # two files on disk...
+        assert len(live_leases(fleet, ttl=60.0)) == 1  # ...one live identity
+        view = agg.scrape()
+        rows = view.goodput_doc()["jobs"]
+        assert [r["job"] for r in rows] == ["job-x"]  # no duplicate row
+        assert rows[0]["status"] == "ok"
+        # Only the live incarnation's counters are in the fold — the dead
+        # lease is not scraped, so nothing double-counts.
+        assert view.registry.counter(
+            "tpu_restarts_total", "", layer="injob", job="job-x"
+        ).value == 4
+        # Expiry: once the dead lease goes stale, the scrape loop unlinks it.
+        doc["heartbeat_ts"] = time.time() - 100.0
+        with open(old_lease_path, "w") as f:
+            json.dump(doc, f)
+        agg.lease_ttl = 15.0
+        agg.scrape()
+        assert not os.path.exists(old_lease_path)
+        assert len(read_leases(fleet)) == 1
+    finally:
+        second.stop()
+
+
+def test_incidents_and_hangz_fold(tmp_path):
+    inc_dir = tmp_path / "incidents"
+    inc_dir.mkdir()
+    art = {
+        "schema": "tpu-incident-1", "id": "incident-1-1", "trigger": "hang",
+        "detail": "", "outcome": "recovered", "ranks": [1],
+        "opened_ts": 100.0, "closed_ts": 101.0, "fault_ts": 99.5,
+        "slo": {"time_to_detect_s": 0.5, "time_to_recover_s": 1.5},
+        "chain": [{}], "events": [{}, {}], "flight": {},
+    }
+    (inc_dir / "incident-1-1.json").write_text(json.dumps(art))
+    srv = TelemetryServer(
+        port=0, fleet_dir=str(tmp_path / "fleet"), job="job-a",
+        incidents_dir=str(inc_dir),
+    )
+    srv.census_fn = lambda: {
+        "schema": "tpu-hangz-1",
+        "suspects": [{"rank": 1, "score": 2.0, "reasons": ["missing"]}],
+        "ranks": [], "barriers": [],
+    }
+    srv.start()
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        view = agg.scrape()
+        inc = view.incidents_doc()
+        assert inc["schema"] == "tpu-fleet-incidents-1"
+        assert len(inc["incidents"]) == 1
+        row = inc["incidents"][0]
+        assert row["job"] == "job-a" and row["trigger"] == "hang"
+        assert row["events"] == 2  # heavy fields trimmed to counts
+        assert inc["jobs"] == {"job-a": 1}
+        hz = view.hangz_doc()
+        assert hz["schema"] == "tpu-fleet-hangz-1"
+        assert hz["suspects"] == [
+            {"job": "job-a", "rank": 1, "score": 2.0, "reasons": ["missing"]}
+        ]
+    finally:
+        srv.stop()
+
+
+def test_slo_percentiles_from_merged_buckets(tmp_path):
+    srv = start_job(tmp_path, "job-a", steps=3)
+    for v in (0.2, 0.4, 8.0):
+        srv.registry.histogram(
+            "tpu_incident_time_to_detect_seconds", "ttd"
+        ).observe(v)
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    try:
+        row = agg.scrape().slo_doc()["jobs"][0]
+        ttd = row["time_to_detect_s"]
+        assert ttd["count"] == 3
+        assert 0.1 <= ttd["p50"] <= 0.5
+        assert 5.0 <= ttd["p95"] <= 10.0
+        assert row["restart_share"] is not None
+    finally:
+        srv.stop()
+
+
+def test_empty_fleet_is_a_valid_answer(tmp_path):
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    view = agg.scrape()
+    assert view.states == []
+    assert view.goodput_doc()["fleet"]["jobs"] == 0
+    assert view.slo_doc()["jobs"] == []
+    assert "tpu_fleet_jobs 0" in view.to_prometheus()
+
+
+# -- bucket_quantile ---------------------------------------------------------
+
+
+def test_bucket_quantile_interpolates():
+    bounds = (1.0, 2.0, 4.0)
+    counts = [0, 4, 0, 0]  # all four samples in (1, 2]
+    assert bucket_quantile(bounds, counts, 0.5) == pytest.approx(1.5)
+    assert bucket_quantile(bounds, counts, 1.0) == pytest.approx(2.0)
+
+
+def test_bucket_quantile_edges():
+    assert bucket_quantile((), [], 0.5) is None
+    assert bucket_quantile((1.0,), [0, 0], 0.5) is None  # empty histogram
+    # +Inf tail clamps to the highest finite bound.
+    assert bucket_quantile((1.0, 2.0), [0, 0, 3], 0.5) == 2.0
+    # first bucket interpolates from 0 (or the bound itself when negative)
+    assert 0.0 < bucket_quantile((1.0, 2.0), [2, 0, 0], 0.5) <= 1.0
+    assert bucket_quantile((-1.0, 1.0), [2, 0, 0], 0.99) <= -0.0

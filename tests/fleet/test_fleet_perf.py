@@ -1,0 +1,40 @@
+"""Slow-marked fleet acceptance gate: drives scripts/bench_fleet.py --smoke —
+N real concurrent 2-rank chaos jobs on loopback, scrape cost sub-linear in
+job count, SIGKILLed job contained as `unreachable` with every /fleet/*
+endpoint still 200. A regression fails CI here, not in a JSON diff."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_fleet_bench_smoke(tmp_path):
+    out = tmp_path / "BENCH_fleet.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "bench_fleet.py"),
+            "--smoke", "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=580,
+    )
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    res = json.loads(out.read_text())
+    # Sub-linear scrape cost: parallel fan-out + keep-alive + job-side
+    # snapshot cache must beat the linear extrapolation by the bar.
+    assert res["sublinear"]["ok"], res["sublinear"]
+    # Crash containment: the SIGKILLed job never degraded a fleet endpoint.
+    assert res["kill"]["all_200"], res["kill"]
+    assert res["kill"]["victim_status"] == "unreachable", res["kill"]
+    assert res["kill"]["survivors_ok"], res["kill"]
+    # Every measured size actually saw its full fleet.
+    sizes = res["config"]["sizes"]
+    assert [r["jobs"] for r in res["scrape_cost"]] == sizes

@@ -1,0 +1,252 @@
+"""FleetServer endpoint contract + the acceptance story: every /fleet/*
+endpoint keeps answering 200 while a job dies mid-scrape-loop, the dead job
+reported `unreachable` instead of degrading the fleet view."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_resiliency.fleet.aggregator import FleetAggregator
+from tpu_resiliency.fleet.registry import JobLease, write_lease
+from tpu_resiliency.fleet.server import PORT_FILE_NAME, FleetServer
+from tpu_resiliency.launcher.telemetry import TelemetryServer
+from tpu_resiliency.tools import fleet_cli
+from tpu_resiliency.utils import events
+
+FLEET_ENDPOINTS = (
+    "/fleet/metrics", "/fleet/goodput", "/fleet/slo", "/fleet/incidents",
+    "/fleet/hangz", "/fleet/snapshot",
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    old = os.environ.pop(events.EVENTS_FILE_ENV, None)
+    yield
+    events.clear_sinks()
+    if old is not None:
+        os.environ[events.EVENTS_FILE_ENV] = old
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode(), r.headers.get("Content-Type", "")
+
+
+def _start_job(tmp_path, job):
+    srv = TelemetryServer(
+        port=0, fleet_dir=str(tmp_path / "fleet"), job=job,
+        node_id=f"node-{job}", lease_interval=0.2,
+    )
+    srv.start()
+    srv.registry.counter("tpu_ckpt_saves_total", "saves").inc(1)
+    return srv
+
+
+def _kill_job(srv, agg=None):
+    """Simulate SIGKILL: endpoint vanishes, heartbeat stops, lease remains.
+    An in-process shutdown leaves keep-alive handler threads running (a real
+    process death would not — the kernel resets its sockets; the bench's
+    real-SIGKILL leg covers that), so the scraper's kept-alive connections
+    are dropped here the way the kernel would drop them."""
+    srv._lease_stop.set()
+    srv._lease_thread.join(timeout=5)
+    srv._httpd.shutdown()
+    srv._httpd.server_close()
+    if agg is not None:
+        agg.close()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    jobs = [_start_job(tmp_path, j) for j in ("job-a", "job-b")]
+    agg = FleetAggregator(str(tmp_path / "fleet"), timeout=1.0)
+    srv = FleetServer(
+        agg, port=0, scrape_ttl=0.0,
+        port_file=str(tmp_path / "fleet" / PORT_FILE_NAME),
+    )
+    srv.start()
+    yield srv, jobs, tmp_path
+    srv.stop()
+    for j in jobs:
+        try:
+            j.stop()
+        except Exception:
+            pass
+
+
+def test_port_file_handshake(fleet):
+    srv, _, tmp_path = fleet
+    pf = tmp_path / "fleet" / PORT_FILE_NAME
+    assert int(pf.read_text().strip()) == srv.port
+    srv.stop()
+    assert not pf.exists()
+
+
+def test_all_endpoints_answer_and_carry_both_jobs(fleet):
+    srv, _, _ = fleet
+    status, prom, ctype = _get(srv.port, "/fleet/metrics")
+    assert status == 200 and "version=0.0.4" in ctype
+    assert 'tpu_ckpt_saves_total{job="job-a"} 1' in prom
+    assert 'tpu_ckpt_saves_total{job="job-b"} 1' in prom
+    assert "fleet:tpu_ckpt_saves_total 2" in prom
+    doc = json.loads(_get(srv.port, "/fleet/goodput")[1])
+    assert doc["schema"] == "tpu-fleet-goodput-1"
+    assert [r["job"] for r in doc["jobs"]] == ["job-a", "job-b"]
+    slo = json.loads(_get(srv.port, "/fleet/slo")[1])
+    assert slo["schema"] == "tpu-fleet-slo-1" and len(slo["jobs"]) == 2
+    inc = json.loads(_get(srv.port, "/fleet/incidents")[1])
+    assert inc["schema"] == "tpu-fleet-incidents-1"
+    hz = json.loads(_get(srv.port, "/fleet/hangz")[1])
+    assert hz["schema"] == "tpu-fleet-hangz-1" and len(hz["jobs"]) == 2
+    snap = json.loads(_get(srv.port, "/fleet/snapshot")[1])
+    assert snap["schema"] == "tpu-fleet-snapshot-1"
+    hzdoc = json.loads(_get(srv.port, "/healthz")[1])
+    assert hzdoc["healthy"] is True and hzdoc["jobs"] == 2
+
+
+def test_killed_job_marks_unreachable_never_non_200(fleet):
+    """The acceptance criterion: kill one job mid-scrape-loop — every fleet
+    endpoint still answers 200, the dead job is an `unreachable` row."""
+    srv, jobs, _ = fleet
+    assert json.loads(_get(srv.port, "/fleet/goodput")[1])["fleet"]["reachable"] == 2
+    _kill_job(jobs[0], srv.aggregator)
+    for path in FLEET_ENDPOINTS:
+        status, body, _ = _get(srv.port, path)
+        assert status == 200, f"{path} degraded to {status} after a job death"
+    doc = json.loads(_get(srv.port, "/fleet/goodput")[1])
+    by_job = {r["job"]: r for r in doc["jobs"]}
+    assert by_job["job-a"]["status"] == "unreachable"
+    assert by_job["job-a"]["error"]
+    assert by_job["job-b"]["status"] == "ok"
+    slo = json.loads(_get(srv.port, "/fleet/slo")[1])
+    assert slo["jobs"][0]["job"] == "job-a"  # the dead job leads the SLO page
+    assert slo["jobs"][0]["status"] == "unreachable"
+    prom = _get(srv.port, "/fleet/metrics")[0:2]
+    assert prom[0] == 200 and 'tpu_fleet_scrape_errors_total{job="job-a"}' in prom[1]
+
+
+def test_scrape_ttl_collapses_endpoint_storm(tmp_path):
+    lease = JobLease(job="gone", url="http://127.0.0.1:1", pid=1)
+    write_lease(str(tmp_path), lease)
+    agg = FleetAggregator(str(tmp_path), timeout=0.2)
+    calls = []
+    orig = agg.scrape
+    agg.scrape = lambda: (calls.append(1), orig())[1]
+    srv = FleetServer(agg, port=0, scrape_ttl=30.0)
+    srv.start()
+    try:
+        for path in FLEET_ENDPOINTS:
+            assert _get(srv.port, path)[0] == 200
+        assert len(calls) == 1, "endpoint storm did not collapse to one scrape"
+    finally:
+        srv.stop()
+
+
+def test_unknown_path_is_404_with_directory(fleet):
+    srv, _, _ = fleet
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(srv.port, "/nope")
+    assert ei.value.code == 404
+    doc = json.loads(ei.value.read())
+    assert "/fleet/goodput" in doc["endpoints"]
+
+
+def test_snapshot_roundtrips_through_the_cli(fleet, tmp_path, capsys):
+    srv, jobs, _ = fleet
+    _kill_job(jobs[1], srv.aggregator)
+    path = str(tmp_path / "out" / "fleet.json")
+    srv.write_snapshot(path)
+    doc = json.load(open(path))
+    assert doc["schema"] == "tpu-fleet-snapshot-1"
+    # tpu-fleet renders all three views offline from the persisted snapshot.
+    assert fleet_cli.main(["scoreboard", "--snapshot", path]) == 0
+    out = capsys.readouterr().out
+    assert "job-a" in out and "unreachable" in out
+    assert fleet_cli.main(["slo", "--snapshot", path]) == 0
+    assert "job-b" in capsys.readouterr().out
+    assert fleet_cli.main(["incidents", "--snapshot", path, "--job", "job-a"]) == 0
+    capsys.readouterr()
+    # and refuses garbage
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert fleet_cli.main(["scoreboard", "--snapshot", str(bad)]) == 1
+    assert fleet_cli.main(["scoreboard"]) == 2  # neither --snapshot nor --url
+
+
+def test_cli_live_url(fleet, capsys):
+    srv, _, _ = fleet
+    assert fleet_cli.main(
+        ["scoreboard", "--url", f"http://127.0.0.1:{srv.port}"]
+    ) == 0
+    assert "job-a" in capsys.readouterr().out
+
+
+def test_fleetd_once_mode(tmp_path, capsys):
+    from tpu_resiliency.tools import fleetd
+
+    job = _start_job(tmp_path, "job-a")
+    snap = str(tmp_path / "fleet.json")
+    try:
+        rc = fleetd.main([
+            "--fleet-dir", str(tmp_path / "fleet"), "--once",
+            "--snapshot", snap,
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "1 job(s), 1 reachable" in out
+        doc = json.load(open(snap))
+        assert doc["schema"] == "tpu-fleet-snapshot-1"
+        assert doc["goodput"]["jobs"][0]["job"] == "job-a"
+    finally:
+        job.stop()
+
+
+def test_unexpired_scrape_failure_keeps_last_view(tmp_path):
+    """A scrape that raises (fleet dir ripped out) degrades /healthz, keeps
+    the last good view, and never downs a fleet endpoint."""
+    agg = FleetAggregator(str(tmp_path / "fleet"))
+    srv = FleetServer(agg, port=0, scrape_ttl=0.0)
+    srv.start()
+    try:
+        assert _get(srv.port, "/fleet/goodput")[0] == 200
+
+        def boom():
+            raise RuntimeError("fleet dir gone")
+
+        agg.scrape = boom
+        status, body, _ = _get(srv.port, "/fleet/goodput")
+        assert status == 200  # last good view served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.port, "/healthz")
+        assert ei.value.code == 503
+        assert "fleet dir gone" in json.loads(ei.value.read())["error"]
+    finally:
+        srv.stop()
+
+
+def test_lease_heartbeat_keeps_job_live_and_stop_removes(tmp_path):
+    """TelemetryServer registration: heartbeat refreshes survive a short TTL;
+    a clean stop removes the lease immediately."""
+    srv = TelemetryServer(
+        port=0, fleet_dir=str(tmp_path / "fleet"), job="hb", lease_interval=0.1,
+    )
+    srv.start()
+    lease_path = srv._lease.path
+    try:
+        hb0 = json.load(open(lease_path))["heartbeat_ts"]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if json.load(open(lease_path))["heartbeat_ts"] > hb0:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("lease heartbeat never refreshed")
+    finally:
+        srv.stop()
+    assert not os.path.exists(lease_path)

@@ -1,0 +1,114 @@
+"""Fleet discovery leases: atomic writes, torn-read hardening, staleness
+expiry, newest-wins identity under churn."""
+
+import json
+import os
+import time
+
+from tpu_resiliency.fleet.registry import (
+    SCHEMA,
+    JobLease,
+    expire_stale,
+    lease_path,
+    live_leases,
+    read_leases,
+    remove_lease,
+    write_lease,
+)
+
+
+def _lease(job="j1", pid=1234, url="http://127.0.0.1:1"):
+    return JobLease(job=job, url=url, pid=pid, node_id="n0", started_at=10.0)
+
+
+def test_write_read_roundtrip(tmp_path):
+    d = str(tmp_path)
+    path = write_lease(d, _lease())
+    assert os.path.basename(path) == "job-j1-1234.json"
+    leases = read_leases(d)
+    assert len(leases) == 1
+    got = leases[0]
+    assert got.job == "j1" and got.url == "http://127.0.0.1:1"
+    assert got.pid == 1234 and got.node_id == "n0"
+    assert got.heartbeat_ts > 0 and got.path == path
+
+
+def test_write_is_atomic_and_refresh_bumps_heartbeat(tmp_path):
+    d = str(tmp_path)
+    lease = _lease()
+    write_lease(d, lease)
+    hb1 = read_leases(d)[0].heartbeat_ts
+    time.sleep(0.01)
+    write_lease(d, lease)
+    assert read_leases(d)[0].heartbeat_ts > hb1
+    # no tmp droppings after an atomic rename
+    assert [n for n in os.listdir(d) if ".tmp." in n] == []
+
+
+def test_torn_and_foreign_files_are_skipped(tmp_path):
+    d = str(tmp_path)
+    write_lease(d, _lease())
+    # torn JSON under a lease name
+    (tmp_path / "job-torn-1.json").write_text('{"schema": "tpu-fleet-le')
+    # wrong schema
+    (tmp_path / "job-wrong-2.json").write_text(json.dumps({"schema": "nope"}))
+    # missing required fields
+    (tmp_path / "job-empty-3.json").write_text(json.dumps({"schema": SCHEMA}))
+    # foreign files ignored entirely
+    (tmp_path / "README.txt").write_text("not a lease")
+    leases = read_leases(d)
+    assert [lease.job for lease in leases] == ["j1"]
+
+
+def test_live_leases_drops_stale(tmp_path):
+    d = str(tmp_path)
+    write_lease(d, _lease(job="fresh", pid=1))
+    stale = _lease(job="stale", pid=2)
+    write_lease(d, stale)
+    # Backdate the stale job's heartbeat by rewriting its file directly.
+    doc = stale.to_doc()
+    doc["heartbeat_ts"] = time.time() - 100.0
+    (tmp_path / os.path.basename(stale.path)).write_text(json.dumps(doc))
+    live = live_leases(d, ttl=15.0)
+    assert set(live) == {"fresh"}
+
+
+def test_newest_heartbeat_wins_per_job(tmp_path):
+    """Restart churn: two incarnations' lease files for one job yield ONE
+    entry — the freshest heartbeat — never a duplicate scoreboard row."""
+    d = str(tmp_path)
+    old = _lease(job="j1", pid=100, url="http://old")
+    write_lease(d, old)
+    doc = old.to_doc()
+    doc["heartbeat_ts"] = time.time() - 5.0
+    (tmp_path / os.path.basename(old.path)).write_text(json.dumps(doc))
+    write_lease(d, _lease(job="j1", pid=200, url="http://new"))
+    live = live_leases(d, ttl=60.0)
+    assert len(live) == 1
+    assert live["j1"].url == "http://new" and live["j1"].pid == 200
+
+
+def test_expire_stale_unlinks(tmp_path):
+    d = str(tmp_path)
+    write_lease(d, _lease(job="alive", pid=1))
+    dead = _lease(job="dead", pid=2)
+    write_lease(d, dead)
+    doc = dead.to_doc()
+    doc["heartbeat_ts"] = time.time() - 100.0
+    (tmp_path / os.path.basename(dead.path)).write_text(json.dumps(doc))
+    removed = expire_stale(d, ttl=15.0)
+    assert removed == [dead.path]
+    assert not os.path.exists(dead.path)
+    assert [lease.job for lease in read_leases(d)] == ["alive"]
+
+
+def test_remove_lease_and_missing_dir_are_benign(tmp_path):
+    remove_lease(str(tmp_path / "nope.json"))  # no raise
+    assert read_leases(str(tmp_path / "missing")) == []
+    assert live_leases(str(tmp_path / "missing")) == {}
+
+
+def test_lease_path_sanitizes_job_names(tmp_path):
+    p = lease_path(str(tmp_path), "exp/../weird job", 7)
+    assert os.path.dirname(p) == str(tmp_path)
+    assert "/" not in os.path.basename(p).replace(".json", "").replace("job-", "", 1)

@@ -141,8 +141,96 @@ def test_unknown_path_is_404_with_directory(server):
     assert ei.value.code == 404
     doc = json.loads(ei.value.read())
     assert set(doc["endpoints"]) == {
-        "/metrics", "/goodput", "/healthz", "/hangz", "/autoscale",
+        "/metrics", "/metrics.json", "/goodput", "/healthz", "/hangz",
+        "/autoscale", "/incidents", "/snapshot",
     }
+
+
+def test_metrics_json_is_the_mergeable_twin(server):
+    """/metrics.json serves the merged registry as a snapshot document the
+    fleet aggregator can MetricsRegistry.merge without parsing exposition."""
+    srv, _ = server
+    srv.registry.counter("tpu_ckpt_saves_total", "saves").inc(2)
+    status, body, ctype = _get(srv.port, "/metrics.json")
+    assert status == 200 and "json" in ctype
+    doc = json.loads(body)
+    merged = MetricsRegistry()
+    merged.merge(doc, extra_labels={"job": "j"})
+    assert merged.counter("tpu_ckpt_saves_total", "", job="j").value == 2
+
+
+def test_incidents_endpoint_trims_artifacts(server, tmp_path):
+    srv, _ = server
+    # No incidents dir wired: an empty-but-valid feed.
+    doc = json.loads(_get(srv.port, "/incidents")[1])
+    assert doc["schema"] == "tpu-incidents-1" and doc["incidents"] == []
+    inc_dir = tmp_path / "incidents"
+    inc_dir.mkdir()
+    art = {
+        "schema": "tpu-incident-1", "id": "incident-5-1", "trigger": "hang",
+        "outcome": "recovered", "ranks": [2], "opened_ts": 50.0,
+        "closed_ts": 51.0, "fault_ts": 49.0,
+        "slo": {"time_to_detect_s": 1.0},
+        "events": [{}] * 7, "chain": [{}] * 3, "flight": {"r0": []},
+        "census": {"big": "blob"},
+    }
+    (inc_dir / "incident-5-1.json").write_text(json.dumps(art))
+    (inc_dir / "incident-9-torn.json").write_text('{"schema": "tpu-inc')
+    (inc_dir / "flight-0-1.jsonl").write_text("not an artifact\n")
+    srv.incidents_dir = str(inc_dir)
+    doc = json.loads(_get(srv.port, "/incidents")[1])
+    assert len(doc["incidents"]) == 1
+    row = doc["incidents"][0]
+    assert row["id"] == "incident-5-1" and row["trigger"] == "hang"
+    # Heavy forensics trimmed to counts — the fleet feed stays light.
+    assert row["events"] == 7 and row["chain"] == 3 and row["flight_dumps"] == 1
+    assert "census" not in row
+
+
+def test_snapshot_consolidates_one_scrape(server):
+    """/snapshot: metrics + goodput + health (+hangz/autoscale when wired)
+    in one GET — the fleet scrape's one-round-trip contract."""
+    srv, tmp_path = server
+    t0 = time.time()
+    with open(tmp_path / "ev.jsonl", "w") as f:
+        for i in range(3):
+            f.write(json.dumps({
+                "kind": "iteration_start", "iteration": i, "ts": t0 + i,
+                "pid": 9, "rank": 0,
+            }) + "\n")
+    srv.census_fn = lambda: {"suspects": [], "ranks": [], "barriers": []}
+    srv.snapshot_ttl = 0.0  # this test swaps census_fn between scrapes
+    status, body, _ = _get(srv.port, "/snapshot")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["schema"] == "tpu-job-snapshot-1"
+    assert doc["job"] == "default"
+    assert doc["goodput"]["phases"]["train"] == pytest.approx(2.0)
+    assert doc["health"]["healthy"] is True
+    assert doc["hangz"]["schema"] == "tpu-hangz-1"
+    assert isinstance(doc["metrics"]["metrics"], dict)
+    assert doc["incidents"] == []
+    # A crashing census degrades its section, never the snapshot.
+    srv.census_fn = lambda: (_ for _ in ()).throw(RuntimeError("wedged"))
+    doc = json.loads(_get(srv.port, "/snapshot")[1])
+    assert "wedged" in doc["hangz"]["error"]
+    assert doc["goodput"]["phases"]["train"] > 0
+
+
+def test_snapshot_ttl_collapses_scrape_storm(server):
+    """REGRESSION (fleet PR): /snapshot is the fleet-scrape hot path — N
+    fleet pollers hitting one job must cost ONE document build per TTL, not
+    N ledger refreshes + registry merges + serializations."""
+    srv, _ = server
+    srv.snapshot_ttl = 30.0
+    calls = []
+    srv.census_fn = lambda: (calls.append(1), {"suspects": []})[1]
+    b1 = _get(srv.port, "/snapshot")[1]
+    b2 = _get(srv.port, "/snapshot")[1]
+    assert b1 == b2 and len(calls) == 1
+    srv.snapshot_ttl = 0.0  # TTL off: every scrape recomputes
+    _get(srv.port, "/snapshot")
+    assert len(calls) == 2
 
 
 def test_healthz_ttl_caches_and_serializes_scrapes(server):

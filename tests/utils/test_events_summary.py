@@ -192,6 +192,37 @@ class TestSliceFilters:
         assert events_summary.main([path, "--since", "+1000"]) == 0
         assert "no events in the selected slice" in capsys.readouterr().out
 
+    def test_job_filter_slices_a_fleet_stream(self, tmp_path, capsys):
+        """--job: records stamped with the fleet job identity (launcher
+        --fleet-dir) slice back to one job; unstamped records drop out of
+        any job's slice; composes with --kind."""
+        import json as _json
+        import time as _time
+
+        path = str(tmp_path / "ev.jsonl")
+        t0 = _time.time()
+        with open(path, "w") as f:
+            for i, (job, kind) in enumerate((
+                ("a", "worker_failed"), ("b", "worker_failed"),
+                ("a", "rendezvous_round"), (None, "worker_failed"),
+            )):
+                rec = {"ts": t0 + i, "source": "launcher", "kind": kind,
+                       "pid": 1, "global_rank": 0}
+                if job is not None:
+                    rec["job"] = job
+                f.write(_json.dumps(rec) + "\n")
+        assert events_summary.main([path, "--job", "a"]) == 0
+        out = capsys.readouterr().out
+        assert "2 events" in out
+        assert "worker failures: 1" in out
+        assert events_summary.main(
+            [path, "--job", "a", "--kind", "worker_failed"]
+        ) == 0
+        assert "1 events" in capsys.readouterr().out
+        # The job identity is envelope, not payload: never printed as job=.
+        assert events_summary.main([path, "--job", "b"]) == 0
+        assert "job=b" not in capsys.readouterr().out
+
 
 def test_cli_main(tmp_path, capsys):
     path = str(tmp_path / "ev.jsonl")

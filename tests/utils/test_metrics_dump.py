@@ -80,6 +80,55 @@ def test_report_file_write(run_jsonl, tmp_path):
     assert "in-job requested: 1" in open(out).read()
 
 
+def test_job_slices_a_shared_events_stream(tmp_path, capsys):
+    """--job on an events JSONL: only records stamped with that fleet job
+    identity aggregate (launcher --fleet-dir exports $TPU_RESILIENCY_JOB)."""
+    path = str(tmp_path / "shared.jsonl")
+    with open(path, "w") as f:
+        for job, n in (("a", 2), ("b", 5)):
+            for _ in range(n):
+                f.write(json.dumps({
+                    "ts": 1.0, "source": "launcher", "kind": "worker_failed",
+                    "pid": 1, "rank": None, "job": job,
+                }) + "\n")
+        f.write(json.dumps({  # unstamped record: in no job's slice
+            "ts": 1.0, "source": "launcher", "kind": "worker_failed",
+            "pid": 1, "rank": None,
+        }) + "\n")
+    assert metrics_dump.main([path, "--job", "a", "--format", "prom"]) == 0
+    assert "tpu_worker_failures_total 2" in capsys.readouterr().out
+    assert metrics_dump.main([path, "--job", "b", "--format", "prom"]) == 0
+    assert "tpu_worker_failures_total 5" in capsys.readouterr().out
+    assert metrics_dump.main([path, "--job", "nope"]) == 1
+    assert "no events for job" in capsys.readouterr().err
+
+
+def test_job_slices_a_fleet_merged_snapshot(tmp_path, capsys):
+    """--job on a metrics snapshot document: keeps one job's series (label
+    dropped — the slice IS that job's view), drops fleet:* totals."""
+    from tpu_resiliency.utils.metrics import MetricsRegistry
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("tpu_restarts_total", "restarts", layer="injob").inc(2)
+    b.counter("tpu_restarts_total", "restarts", layer="injob").inc(7)
+    fleet = MetricsRegistry()
+    fleet.merge(a.snapshot(), extra_labels={"job": "a"})
+    fleet.merge(b.snapshot(), extra_labels={"job": "b"})
+    fleet.merge({"ts": 0, "metrics": {
+        "fleet:tpu_restarts_total": [
+            {"type": "counter", "labels": {"layer": "injob"}, "value": 9},
+        ],
+    }})
+    snap = tmp_path / "fleet_metrics.json"
+    snap.write_text(json.dumps(fleet.snapshot()))
+    assert metrics_dump.main([str(snap), "--job", "a", "--format", "prom"]) == 0
+    out = capsys.readouterr().out
+    assert 'tpu_restarts_total{layer="injob"} 2' in out
+    assert "job=" not in out and "fleet:" not in out
+    # --goodput needs a stream, not a snapshot: explicit usage error.
+    assert metrics_dump.main([str(snap), "--job", "a", "--goodput"]) == 2
+
+
 def test_fails_visibly_on_missing_or_empty(tmp_path, capsys):
     assert metrics_dump.main([str(tmp_path / "nope.jsonl")]) == 1
     empty = tmp_path / "empty.jsonl"

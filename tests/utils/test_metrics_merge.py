@@ -186,6 +186,47 @@ def test_merge_rejects_garbage():
     assert not reg.histograms("h_seconds")
 
 
+def test_job_label_injection_keeps_jobs_separate():
+    """REGRESSION (fleet federation): merging two jobs' snapshots into one
+    fleet registry must not sum their same-named series — the injected job
+    label keeps tpu_restarts_total{job="a"} and {job="b"} distinct — while an
+    unlabelled merge of the same snapshots (the explicit fleet-total family)
+    still sums them."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("tpu_restarts_total", "restarts", layer="injob").inc(3)
+    b.counter("tpu_restarts_total", "restarts", layer="injob").inc(5)
+    fleet = MetricsRegistry()
+    fleet.merge(a.snapshot(), extra_labels={"job": "a"})
+    fleet.merge(b.snapshot(), extra_labels={"job": "b"})
+    assert fleet.counter("tpu_restarts_total", "", layer="injob", job="a").value == 3
+    assert fleet.counter("tpu_restarts_total", "", layer="injob", job="b").value == 5
+    prom = fleet.to_prometheus()
+    assert 'job="a"' in prom and 'job="b"' in prom
+    totals = merged(a.snapshot(), b.snapshot())
+    assert totals.counter("tpu_restarts_total", "", layer="injob").value == 8
+
+
+def test_job_label_injection_overrides_and_stays_associative():
+    """extra_labels override a same-named snapshot label (a job cannot forge
+    its fleet identity), and a tree of labelled partial merges equals the
+    flat labelled merge."""
+    a = MetricsRegistry()
+    a.counter("c_total", "", job="forged").inc(2)
+    fleet = MetricsRegistry()
+    fleet.merge(a.snapshot(), extra_labels={"job": "real"})
+    assert fleet.counter("c_total", "", job="real").value == 2
+    # tree == flat through a partial labelled merge's snapshot
+    b = MetricsRegistry()
+    b.counter("c_total").inc(7)
+    partial = MetricsRegistry()
+    partial.merge(b.snapshot(), extra_labels={"job": "b"})
+    tree = MetricsRegistry()
+    tree.merge(partial.snapshot())
+    flat = MetricsRegistry()
+    flat.merge(b.snapshot(), extra_labels={"job": "b"})
+    assert _exposition_series(tree) == _exposition_series(flat)
+
+
 def test_default_buckets_roundtrip_through_json():
     """Bounds survive a JSON round-trip (floats stay equal) so merging a
     store-transported snapshot never false-positives the mismatch check."""

@@ -1,0 +1,25 @@
+"""Fleet federation: one control plane over many jobs.
+
+Every launcher serves ``/metrics``, ``/goodput``, ``/healthz``, ``/hangz``,
+``/autoscale`` — for exactly one job. This package is the layer above: jobs
+announce themselves through atomic lease files in a shared ``--fleet-dir``
+(:mod:`tpu_resiliency.fleet.registry`), a standalone aggregator fans out
+bounded-timeout scrapes and tree-merges the per-job documents
+(:mod:`tpu_resiliency.fleet.aggregator`), and a fleet HTTP server renders the
+merged view — scoreboard, incident feed, hang census, SLO ranking
+(:mod:`tpu_resiliency.fleet.server`, daemonized by ``tools/fleetd.py``).
+
+The merge algebra is the one PR 7 proved associative + commutative
+(``MetricsRegistry.merge``: counters sum, gauges LWW, histograms bucket-add) —
+hierarchical federation is just that fold applied one level up, with a
+``job=`` label injected so distinct jobs' same-named series never collide.
+"""
+
+from tpu_resiliency.fleet.registry import (  # noqa: F401
+    JobLease,
+    expire_stale,
+    live_leases,
+    read_leases,
+    remove_lease,
+    write_lease,
+)

@@ -1,0 +1,180 @@
+"""Fleet discovery: job leases in a shared directory.
+
+A launcher with ``--fleet-dir`` set registers its telemetry endpoint by
+writing one small JSON lease file (``schema: tpu-fleet-lease-1``) into the
+shared directory and refreshing its ``heartbeat_ts`` on a short interval
+(``launcher/telemetry.py``). ``fleetd`` discovers jobs by listing the
+directory — no central registration RPC, no fleetd restart when jobs come and
+go, and the directory can be any shared filesystem the fleet already has
+(NFS, GCS fuse, a host path for single-machine fleets).
+
+Failure semantics are lease semantics:
+
+- **atomic**: every write is tmp + ``os.replace`` — a reader never sees a
+  torn document; a partially-written or non-JSON file (a foreign tool's
+  droppings, a crashed writer's tmp file) is skipped, never fatal.
+- **heartbeat-expired**: a job that stops refreshing (crash, SIGKILL, wedged
+  launcher) goes stale after ``ttl`` seconds. :func:`live_leases` drops stale
+  leases from the view; :func:`expire_stale` (called by fleetd's scrape loop)
+  unlinks them so the directory self-cleans without the job's cooperation.
+- **newest-wins identity**: the job key is the lease's ``job`` field (the
+  launcher's ``--rdzv-id``). A restarted launcher re-registers under the same
+  job with a new pid/lease file; :func:`live_leases` keeps only the freshest
+  heartbeat per job, so churn never yields duplicate scoreboard rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+SCHEMA = "tpu-fleet-lease-1"
+
+#: lease filenames: ``job-<job>-<pid>.json`` — pid-suffixed so two launcher
+#: incarnations of one job never clobber each other's writes mid-handoff
+LEASE_PREFIX = "job-"
+LEASE_SUFFIX = ".json"
+
+#: default staleness horizon: a lease whose heartbeat is older than this is a
+#: dead job (the TelemetryServer refreshes every ~5 s, so 3 missed beats)
+DEFAULT_TTL_S = 15.0
+
+
+@dataclasses.dataclass
+class JobLease:
+    """One job's registration: who it is and where its telemetry lives."""
+
+    job: str
+    url: str
+    pid: int = 0
+    node_id: str = ""
+    rdzv_id: str = ""
+    started_at: float = 0.0
+    heartbeat_ts: float = 0.0
+    #: where the lease was read from (empty for a lease built in memory)
+    path: str = ""
+
+    def to_doc(self) -> dict:
+        return {
+            "schema": SCHEMA,
+            "job": self.job,
+            "url": self.url,
+            "pid": self.pid,
+            "node_id": self.node_id,
+            "rdzv_id": self.rdzv_id or self.job,
+            "started_at": self.started_at,
+            "heartbeat_ts": self.heartbeat_ts,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict, path: str = "") -> Optional["JobLease"]:
+        if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+            return None
+        job, url = doc.get("job"), doc.get("url")
+        if not isinstance(job, str) or not job or not isinstance(url, str):
+            return None
+        hb = doc.get("heartbeat_ts")
+        return cls(
+            job=job,
+            url=url.rstrip("/"),
+            pid=doc.get("pid") if isinstance(doc.get("pid"), int) else 0,
+            node_id=str(doc.get("node_id") or ""),
+            rdzv_id=str(doc.get("rdzv_id") or job),
+            started_at=(
+                doc["started_at"]
+                if isinstance(doc.get("started_at"), (int, float)) else 0.0
+            ),
+            heartbeat_ts=hb if isinstance(hb, (int, float)) else 0.0,
+            path=path,
+        )
+
+
+def lease_path(fleet_dir: str, job: str, pid: int) -> str:
+    safe = "".join(c if c.isalnum() or c in "-_." else "_" for c in job)
+    return os.path.join(fleet_dir, f"{LEASE_PREFIX}{safe}-{pid}{LEASE_SUFFIX}")
+
+
+def write_lease(fleet_dir: str, lease: JobLease) -> str:
+    """Atomically write/refresh a lease (stamping ``heartbeat_ts`` now).
+    Returns the lease file path."""
+    os.makedirs(fleet_dir, exist_ok=True)
+    lease.heartbeat_ts = time.time()
+    path = lease.path or lease_path(fleet_dir, lease.job, lease.pid)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(lease.to_doc(), f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    lease.path = path
+    return path
+
+
+def remove_lease(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def read_leases(fleet_dir: str) -> list[JobLease]:
+    """Every parseable lease in the directory (stale included). Torn/partial
+    JSON, foreign files, and in-flight ``.tmp.`` writes are skipped — the
+    write side is atomic, so a bad file is garbage, not a race to retry."""
+    out: list[JobLease] = []
+    try:
+        names = sorted(os.listdir(fleet_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(LEASE_PREFIX) and name.endswith(LEASE_SUFFIX)):
+            continue
+        path = os.path.join(fleet_dir, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        lease = JobLease.from_doc(doc, path=path)
+        if lease is not None:
+            out.append(lease)
+    return out
+
+
+def live_leases(
+    fleet_dir: str, ttl: float = DEFAULT_TTL_S, now: Optional[float] = None
+) -> dict[str, JobLease]:
+    """``job -> freshest live lease``: stale heartbeats dropped, and when one
+    job left several incarnations' files behind (restart churn), only the
+    newest heartbeat represents it — one scoreboard row per job, always."""
+    now = time.time() if now is None else now
+    live: dict[str, JobLease] = {}
+    for lease in read_leases(fleet_dir):
+        if now - lease.heartbeat_ts > ttl:
+            continue
+        prev = live.get(lease.job)
+        if prev is None or lease.heartbeat_ts > prev.heartbeat_ts:
+            live[lease.job] = lease
+    return live
+
+
+def expire_stale(
+    fleet_dir: str, ttl: float = DEFAULT_TTL_S, now: Optional[float] = None
+) -> list[str]:
+    """Unlink leases whose heartbeat is older than ``ttl``; returns the
+    removed paths. fleetd calls this each scrape so dead jobs disappear from
+    the directory without anyone restarting anything."""
+    now = time.time() if now is None else now
+    removed: list[str] = []
+    for lease in read_leases(fleet_dir):
+        if now - lease.heartbeat_ts > ttl:
+            remove_lease(lease.path)
+            removed.append(lease.path)
+            log.info(f"expired stale fleet lease {lease.path} (job {lease.job!r})")
+    return removed

@@ -1,0 +1,271 @@
+"""Fleet HTTP endpoints: the merged view, served.
+
+The fleet twin of the launcher's :class:`~tpu_resiliency.launcher.telemetry.
+TelemetryServer` — same stdlib ``ThreadingHTTPServer`` + port-file handshake
+discipline, one level up:
+
+- ``GET /fleet/metrics`` — merged Prometheus exposition: every job's series
+  under a ``job=`` label, ``fleet:*`` cross-job totals, fleetd's own
+  operational metrics.
+- ``GET /fleet/goodput`` — the per-job scoreboard (``tpu-fleet-goodput-1``).
+- ``GET /fleet/slo`` — jobs ranked worst-first by time-in-restart share with
+  detect/recover percentiles (``tpu-fleet-slo-1``).
+- ``GET /fleet/incidents`` — the cross-job incident feed
+  (``tpu-fleet-incidents-1``).
+- ``GET /fleet/hangz`` — the fleet-wide hang census (``tpu-fleet-hangz-1``).
+- ``GET /fleet/snapshot`` — the whole fold as one document
+  (``tpu-fleet-snapshot-1``; what ``tpu-fleet`` renders offline).
+- ``GET /healthz`` — fleetd's own liveness (job count, last scrape age).
+
+Scrapes are TTL-cached behind a lock (``scrape_ttl``): a dashboard storm
+hitting five endpoints costs ONE fan-out per TTL, not five — the same
+compute-inside-the-lock discipline as the launcher's ``/healthz`` cache. A
+failed scrape degrades the served documents (``error`` field), never the
+endpoints: every ``/fleet/*`` path answers 200 for as long as fleetd lives,
+because the moment something is wrong fleet-wide is exactly when the fleet
+view must stay up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_resiliency.fleet.aggregator import FleetAggregator, FleetView
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+#: default name of the port-file handshake (mirrors telemetry.port)
+PORT_FILE_NAME = "fleetd.port"
+
+
+class FleetServer:
+    """Threaded HTTP endpoint over a :class:`FleetAggregator`."""
+
+    def __init__(
+        self,
+        aggregator: FleetAggregator,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        port_file: Optional[str] = None,
+        scrape_ttl: float = 2.0,
+    ):
+        self.aggregator = aggregator
+        self._host = host
+        self._want_port = port
+        self.port_file = port_file
+        #: scrape-result cache lifetime: endpoint storms collapse to one
+        #: fan-out per TTL. 0 disables caching (scrapes still serialize).
+        self.scrape_ttl = scrape_ttl
+        self._view_lock = threading.Lock()
+        self._view: Optional[tuple[float, FleetView]] = None
+        self._last_error: Optional[str] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = time.time()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # Keep-alive, same as the TelemetryServer: dashboards polling the
+            # fleet view reuse one connection per poller.
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # no stderr chatter
+                log.debug(f"fleetd: {fmt % args}")
+
+            def do_GET(self):
+                try:
+                    server._handle(self)
+                except BrokenPipeError:
+                    pass
+                except Exception:
+                    log.debug("fleetd request failed", exc_info=True)
+                    try:
+                        self.send_error(500)
+                    except Exception:
+                        pass
+
+        self._httpd = ThreadingHTTPServer((self._host, self._want_port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleetd-http", daemon=True
+        )
+        self._thread.start()
+        port = self._httpd.server_address[1]
+        if self.port_file:
+            d = os.path.dirname(self.port_file)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{self.port_file}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(f"{port}\n")
+            os.replace(tmp, self.port_file)
+        log.info(
+            f"fleet endpoint on http://{self._host}:{port} "
+            f"(/fleet/metrics /fleet/goodput /fleet/slo /fleet/incidents "
+            f"/fleet/hangz /fleet/snapshot /healthz)"
+        )
+        return port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if self.port_file:
+            try:
+                os.unlink(self.port_file)
+            except OSError:
+                pass
+
+    # -- view cache ---------------------------------------------------------
+
+    def view(self, max_age: Optional[float] = None) -> Optional[FleetView]:
+        """The current fleet view, re-scraped at most once per TTL.
+        Compute-inside-the-lock on purpose: concurrent requests during a slow
+        fan-out serialize, and the laggards reuse the fresh result. A scrape
+        that raises (fleet dir unlinked, interpreter teardown) keeps the last
+        good view and records the error for /healthz."""
+        ttl = self.scrape_ttl if max_age is None else max_age
+        with self._view_lock:
+            now = time.monotonic()
+            if self._view is not None and now - self._view[0] < ttl:
+                return self._view[1]
+            try:
+                view = self.aggregator.scrape()
+                self._last_error = None
+            except Exception as e:
+                log.warning(f"fleet scrape failed: {e!r}")
+                self._last_error = repr(e)
+                return self._view[1] if self._view is not None else None
+            self._view = (time.monotonic(), view)
+            return view
+
+    # -- request handling ---------------------------------------------------
+
+    def _doc_or_degraded(self, build, schema: str) -> dict:
+        view = self.view()
+        if view is None:
+            return {"schema": schema, "error": self._last_error or "no scrape yet"}
+        try:
+            return build(view)
+        except Exception as e:  # a malformed job doc must not down the endpoint
+            log.debug("fleet document build failed", exc_info=True)
+            return {"schema": schema, "error": repr(e)}
+
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        from tpu_resiliency.fleet import aggregator as agg_mod
+
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/fleet/metrics":
+            view = self.view()
+            body = (view.to_prometheus() if view is not None else "").encode()
+            self._respond(req, 200, body, "text/plain; version=0.0.4")
+        elif path == "/fleet/goodput":
+            doc = self._doc_or_degraded(
+                lambda v: v.goodput_doc(), agg_mod.GOODPUT_SCHEMA
+            )
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/fleet/slo":
+            doc = self._doc_or_degraded(lambda v: v.slo_doc(), agg_mod.SLO_SCHEMA)
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/fleet/incidents":
+            doc = self._doc_or_degraded(
+                lambda v: v.incidents_doc(), agg_mod.INCIDENTS_SCHEMA
+            )
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/fleet/hangz":
+            doc = self._doc_or_degraded(
+                lambda v: v.hangz_doc(), agg_mod.HANGZ_SCHEMA
+            )
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/fleet/snapshot":
+            doc = self._doc_or_degraded(
+                lambda v: v.snapshot_doc(), agg_mod.SNAPSHOT_SCHEMA
+            )
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/healthz":
+            doc = self.health()
+            status = 200 if doc.get("healthy") else 503
+            self._respond(req, status, _json_body(doc), "application/json")
+        else:
+            self._respond(
+                req, 404,
+                _json_body({
+                    "error": f"unknown path {path!r}",
+                    "endpoints": [
+                        "/fleet/metrics", "/fleet/goodput", "/fleet/slo",
+                        "/fleet/incidents", "/fleet/hangz", "/fleet/snapshot",
+                        "/healthz",
+                    ],
+                }),
+                "application/json",
+            )
+
+    def health(self) -> dict:
+        """fleetd's own liveness: healthy as long as the last scrape worked
+        (an empty fleet is a healthy fleet — zero jobs is a valid answer)."""
+        with self._view_lock:
+            cached = self._view
+            err = self._last_error
+        doc = {
+            "healthy": err is None,
+            "fleet_dir": self.aggregator.fleet_dir,
+            "uptime_s": round(time.time() - self._started_at, 3),
+        }
+        if cached is not None:
+            view = cached[1]
+            doc.update(
+                jobs=len(view.states),
+                unreachable=sum(1 for s in view.states if not s["reachable"]),
+                last_scrape_age_s=round(time.monotonic() - cached[0], 3),
+                last_scrape_s=view.scrape_s,
+            )
+        if err is not None:
+            doc["error"] = err
+        return doc
+
+    def write_snapshot(self, path: str) -> None:
+        """Persist the current fold atomically (the ``tpu-fleet`` input)."""
+        view = self.view()
+        if view is None:
+            return
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(view.snapshot_doc(), f, indent=2, default=repr)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _respond(
+        req: BaseHTTPRequestHandler, status: int, body: bytes, ctype: str
+    ) -> None:
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+
+def _json_body(doc: dict) -> bytes:
+    return (json.dumps(doc, indent=2, default=repr) + "\n").encode()

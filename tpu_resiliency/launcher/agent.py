@@ -97,6 +97,14 @@ class AgentConfig:
     #: by --rdzv-id at the CLI so jobs sharing a store endpoint never merge
     #: each other's metrics)
     metrics_push_prefix: str = "jobmetrics/default/"
+    #: fleet-federation discovery directory (``--fleet-dir``): the telemetry
+    #: server registers this job's endpoint as a heartbeat-refreshed lease
+    #: file there so ``tpu-fleetd`` can scrape it (``fleet/registry.py``);
+    #: empty disables registration. Requires telemetry to be enabled.
+    fleet_dir: str = ""
+    #: fleet job identity (the CLI passes --rdzv-id): the lease's job key and
+    #: the ``job=`` label fleetd injects when merging this job's metrics
+    job_id: str = "default"
     #: goodput-optimal autoscale controller (``launcher/autoscale.py``):
     #: "off" disables it; "advise" computes and audits every decision but
     #: actuates nothing (the safe mode to trust the model first); "act"
@@ -212,6 +220,10 @@ class ElasticAgent:
             autoscale_fn=(
                 self.autoscale.status if self.autoscale is not None else None
             ),
+            fleet_dir=self.cfg.fleet_dir or None,
+            job=self.cfg.job_id,
+            node_id=self.cfg.node_id,
+            incidents_dir=self.cfg.incidents_dir or None,
         )
         self.telemetry.start()
 
@@ -435,7 +447,9 @@ class ElasticAgent:
         os.makedirs(self.cfg.run_dir, exist_ok=True)
         self._ipc = ipc.IpcReceiver(self._launcher_socket)
         self._ipc.start()
-        if self.cfg.telemetry_port is not None:
+        # --fleet-dir implies telemetry: a fleet registration without an
+        # endpoint to scrape would be a lease pointing at nothing.
+        if self.cfg.telemetry_port is not None or self.cfg.fleet_dir:
             self._start_telemetry()
         if self.cfg.autoscale != "off":
             self._start_autoscale()

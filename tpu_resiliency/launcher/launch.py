@@ -177,6 +177,17 @@ def build_parser() -> argparse.ArgumentParser:
         "<run-dir>/telemetry.port (omit the flag to disable)",
     )
     p.add_argument(
+        "--fleet-dir",
+        default=None,
+        help="fleet-federation discovery directory shared by every job the "
+        "fleet aggregator (tpu-fleetd) watches: the agent registers its "
+        "telemetry endpoint there as a heartbeat-refreshed lease file "
+        "(removed on clean exit, expired by fleetd on staleness) and stamps "
+        "this job's --rdzv-id onto every event ($TPU_RESILIENCY_JOB) so "
+        "fleet-merged streams slice back per job; implies --telemetry-port 0 "
+        "when telemetry is not otherwise enabled",
+    )
+    p.add_argument(
         "--autoscale",
         choices=("off", "advise", "act"),
         default="off",
@@ -379,6 +390,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         # One exported variable wires the whole tree: the agent records through it
         # and every spawned worker/monitor inherits it (events.py env sink).
         os.environ[EVENTS_FILE_ENV] = os.path.abspath(args.events_file)
+    if args.fleet_dir:
+        from tpu_resiliency.utils.events import JOB_ENV
+
+        # Fleet scope: stamp the job identity onto every event this process
+        # tree records, so streams several jobs share (or fleetd later
+        # merges) slice back to one job with --job.
+        os.environ[JOB_ENV] = args.rdzv_id
     if args.metrics_file:
         os.environ[METRICS_FILE_ENV] = os.path.abspath(args.metrics_file)
     if args.compile_cache_dir:
@@ -464,6 +482,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             os.path.abspath(args.incidents_dir) if args.incidents_dir else ""
         ),
         telemetry_port=args.telemetry_port,
+        fleet_dir=os.path.abspath(args.fleet_dir) if args.fleet_dir else "",
+        job_id=args.rdzv_id,
         autoscale=args.autoscale,
         # rdzv-id namespacing keeps two jobs on one store endpoint from
         # merging each other's metrics snapshots into their /metrics views.

@@ -29,10 +29,29 @@ dir), serving three endpoints:
   (``schema: tpu-autoscale-1``, ``launcher/autoscale.py``): mode, pending
   preemption notices, the recent decision audit with predicted AND realized
   goodput deltas, forecast accuracy, and the live cost-model constants.
+- ``GET /metrics.json`` — the same merged job-level view as ``/metrics``, as
+  a mergeable JSON snapshot (``MetricsRegistry.snapshot`` format): the
+  federation input — fleetd folds these with ``MetricsRegistry.merge``
+  instead of parsing exposition text.
+- ``GET /incidents`` — recent ``tpu-incident-1`` artifact summaries from the
+  incidents dir (``schema: tpu-incidents-1``; heavyweight fields — event
+  window, flight dumps — trimmed to counts).
+- ``GET /snapshot`` — the consolidated per-job document
+  (``schema: tpu-job-snapshot-1``): metrics snapshot + goodput + health +
+  hangz + incidents in ONE round trip, so a fleet scrape costs one GET per
+  job (``tools/fleetd.py``).
 
 ``/healthz`` results are TTL-cached (``health_ttl``, default 1 s) behind a
 lock, so a scrape storm from fleet pollers costs one ``health_fn``
 evaluation per TTL instead of stacking concurrent runs.
+
+**Fleet registration**: with ``fleet_dir`` set (launcher ``--fleet-dir``),
+the server announces the job to the fleet control plane by writing an atomic
+``tpu-fleet-lease-1`` lease file (job id, url, pid, started_at) into the
+shared directory and heartbeat-refreshing it every ``lease_interval``
+seconds; a clean ``stop()`` removes the lease, a crash lets it go stale and
+fleetd expires it (``fleet/registry.py``) — the same announce/teardown
+discipline as the ``telemetry.port`` handshake, shared-directory-wide.
 
 Each ``/metrics`` or ``/goodput`` request also refreshes the ledger and
 publishes attribution deltas back through the event stream
@@ -83,6 +102,12 @@ class TelemetryServer:
         census_fn: Optional[Callable[[], dict]] = None,
         autoscale_fn: Optional[Callable[[], dict]] = None,
         health_ttl: float = 1.0,
+        fleet_dir: Optional[str] = None,
+        job: str = "default",
+        node_id: str = "",
+        incidents_dir: Optional[str] = None,
+        lease_interval: float = 5.0,
+        snapshot_ttl: float = 1.0,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.ledger = GoodputLedger()
@@ -94,6 +119,24 @@ class TelemetryServer:
         self.health_fn = health_fn
         self.census_fn = census_fn
         self.autoscale_fn = autoscale_fn
+        #: fleet discovery (``fleet/registry.py``): directory the job's lease
+        #: lives in; None keeps the server single-job (no registration).
+        self.fleet_dir = fleet_dir
+        self.job = job or "default"
+        self.node_id = node_id
+        self.incidents_dir = incidents_dir
+        self.lease_interval = lease_interval
+        self._lease = None
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        #: /snapshot body cache lifetime: the consolidated document is the
+        #: fleet-scrape hot path, and several fleetds / dashboards polling one
+        #: job must cost one ledger-refresh + registry-merge + serialize per
+        #: TTL, not one per scraper (the /healthz discipline, one level up).
+        #: 0 disables caching (computation still serializes under the lock).
+        self.snapshot_ttl = snapshot_ttl
+        self._snapshot_lock = threading.Lock()
+        self._snapshot_cache: Optional[tuple[float, bytes]] = None
         #: /healthz result cache lifetime: a scrape storm (fleet pollers all
         #: hitting one launcher) must not stack concurrent health_fn runs.
         #: 0 disables caching (computation still serializes under the lock).
@@ -118,6 +161,12 @@ class TelemetryServer:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive: a fleet scraper's per-beat GET rides one persistent
+            # connection (and one server-side handler thread) instead of
+            # paying TCP setup + thread spawn per scrape. Every response
+            # already carries Content-Length, which 1.1 requires.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, fmt, *args):  # no stderr chatter
                 log.debug(f"telemetry: {fmt % args}")
 
@@ -151,11 +200,24 @@ class TelemetryServer:
             with open(tmp, "w") as f:
                 f.write(f"{port}\n")
             os.replace(tmp, self.port_file)
+        if self.fleet_dir:
+            self._register_lease(port)
         log.info(f"telemetry endpoint on http://{self._host}:{port} "
-                 f"(/metrics /goodput /healthz /hangz /autoscale)")
+                 f"(/metrics /goodput /healthz /hangz /autoscale /snapshot)")
         return port
 
     def stop(self) -> None:
+        if self._lease_thread is not None:
+            self._lease_stop.set()
+            self._lease_thread.join(timeout=5.0)
+            self._lease_thread = None
+        if self._lease is not None:
+            # Clean stop: the job disappears from the fleet view immediately
+            # instead of lingering until heartbeat staleness.
+            from tpu_resiliency.fleet.registry import remove_lease
+
+            remove_lease(self._lease.path)
+            self._lease = None
         if self._sink is not None:
             events_mod.remove_sink(self._sink)
             self._sink = None
@@ -172,6 +234,47 @@ class TelemetryServer:
             except OSError:
                 pass
 
+    # -- fleet registration -------------------------------------------------
+
+    def _register_lease(self, port: int) -> None:
+        """Announce this job to the fleet dir and start the heartbeat. A
+        registration failure degrades to single-job serving — discovery is
+        observability, never control flow."""
+        from tpu_resiliency.fleet.registry import JobLease, write_lease
+
+        self._lease = JobLease(
+            job=self.job,
+            url=f"http://{self._host}:{port}",
+            pid=os.getpid(),
+            node_id=self.node_id,
+            started_at=time.time(),
+        )
+        try:
+            write_lease(self.fleet_dir, self._lease)
+        except OSError as e:
+            log.warning(f"cannot register fleet lease in {self.fleet_dir!r}: {e}")
+            self._lease = None
+            return
+        self._lease_stop.clear()
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, name="fleet-lease", daemon=True
+        )
+        self._lease_thread.start()
+
+    def _lease_loop(self) -> None:
+        while not self._lease_stop.wait(self.lease_interval):
+            lease = self._lease
+            if lease is None:
+                return
+            try:
+                # Each refresh is a full atomic rewrite stamping a fresh
+                # heartbeat_ts — fleetd treats a stale stamp as a dead job.
+                from tpu_resiliency.fleet.registry import write_lease
+
+                write_lease(self.fleet_dir, lease)
+            except OSError:
+                log.debug("fleet lease refresh failed", exc_info=True)
+
     # -- request handling ---------------------------------------------------
 
     def _handle(self, req: BaseHTTPRequestHandler) -> None:
@@ -180,6 +283,16 @@ class TelemetryServer:
             self.refresh()
             body = self.merged_registry().to_prometheus().encode()
             self._respond(req, 200, body, "text/plain; version=0.0.4")
+        elif path == "/metrics.json":
+            self.refresh()
+            doc = self.merged_registry().snapshot()
+            self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/incidents":
+            self._respond(
+                req, 200, _json_body(self._incidents_doc()), "application/json"
+            )
+        elif path == "/snapshot":
+            self._respond(req, 200, self._snapshot_body(), "application/json")
         elif path == "/goodput":
             summary = self.refresh()
             self._respond(req, 200, _json_body(summary), "application/json")
@@ -216,8 +329,10 @@ class TelemetryServer:
             self._respond(
                 req, 404,
                 _json_body({"error": f"unknown path {path!r}",
-                            "endpoints": ["/metrics", "/goodput", "/healthz",
-                                          "/hangz", "/autoscale"]}),
+                            "endpoints": ["/metrics", "/metrics.json",
+                                          "/goodput", "/healthz", "/hangz",
+                                          "/autoscale", "/incidents",
+                                          "/snapshot"]}),
                 "application/json",
             )
 
@@ -241,6 +356,103 @@ class TelemetryServer:
                     doc = {"healthy": False, "error": repr(e)}
             self._health_cache = (time.monotonic(), doc)
             return doc
+
+    #: incident feed length cap: the fleet wants the recent tail, not a
+    #: job-lifetime archive (artifacts on disk remain the full record)
+    INCIDENTS_LIMIT = 50
+
+    def _incidents_doc(self) -> dict:
+        """Recent incident-artifact summaries, newest first. Heavy forensic
+        fields (event window, flight dumps, chain, census) are trimmed to
+        counts — the fleet feed answers "what happened, when, how bad";
+        ``tpu-incident-report`` against the artifact answers "why"."""
+        doc: dict = {"schema": "tpu-incidents-1", "job": self.job, "incidents": []}
+        if not self.incidents_dir:
+            return doc
+        try:
+            names = [
+                n for n in os.listdir(self.incidents_dir)
+                if n.startswith("incident-") and n.endswith(".json")
+            ]
+        except OSError as e:
+            doc["error"] = repr(e)
+            return doc
+        for name in sorted(names, reverse=True)[: self.INCIDENTS_LIMIT]:
+            try:
+                with open(os.path.join(self.incidents_dir, name)) as f:
+                    art = json.load(f)
+            except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+                continue  # torn/foreign file: skip, never degrade the feed
+            if not isinstance(art, dict) or art.get("schema") != "tpu-incident-1":
+                continue
+            doc["incidents"].append({
+                "id": art.get("id"),
+                "trigger": art.get("trigger"),
+                "detail": art.get("detail"),
+                "outcome": art.get("outcome"),
+                "ranks": art.get("ranks"),
+                "node_id": art.get("node_id"),
+                "opened_ts": art.get("opened_ts"),
+                "closed_ts": art.get("closed_ts"),
+                "fault_ts": art.get("fault_ts"),
+                "slo": art.get("slo"),
+                "events": len(art.get("events") or []),
+                "chain": len(art.get("chain") or []),
+                "flight_dumps": len(art.get("flight") or {}),
+                "artifact": name,
+            })
+        doc["incidents"].sort(
+            key=lambda i: -(i.get("opened_ts") if isinstance(
+                i.get("opened_ts"), (int, float)) else 0.0)
+        )
+        return doc
+
+    def snapshot_doc(self) -> dict:
+        """The consolidated per-job document — one GET answers a fleet
+        scrape (``schema: tpu-job-snapshot-1``). Every section degrades
+        independently: a wedged census or crashed health_fn yields an error
+        field in its section, never a failed snapshot."""
+        goodput = self.refresh()
+        doc: dict = {
+            "schema": "tpu-job-snapshot-1",
+            "job": self.job,
+            "node_id": self.node_id,
+            "pid": os.getpid(),
+            "ts": time.time(),
+            "metrics": self.merged_registry().snapshot(),
+            "goodput": goodput,
+            "health": self._health_doc(),
+            "incidents": self._incidents_doc()["incidents"],
+        }
+        if self.census_fn is not None:
+            try:
+                doc["hangz"] = dict(self.census_fn())
+            except Exception as e:
+                doc["hangz"] = {"error": repr(e)}
+            doc["hangz"].setdefault("schema", "tpu-hangz-1")
+        if self.autoscale_fn is not None:
+            try:
+                doc["autoscale"] = dict(self.autoscale_fn())
+            except Exception as e:
+                doc["autoscale"] = {"error": repr(e)}
+            doc["autoscale"].setdefault("schema", "tpu-autoscale-1")
+        return doc
+
+    def _snapshot_body(self) -> bytes:
+        """The /snapshot response bytes, TTL-cached. Compute-inside-the-lock
+        like ``_health_doc``: concurrent fleet scrapers during a slow build
+        serialize, and the laggards reuse the fresh bytes — rendered once,
+        not once per scraper."""
+        with self._snapshot_lock:
+            now = time.monotonic()
+            if (
+                self._snapshot_cache is not None
+                and now - self._snapshot_cache[0] < self.snapshot_ttl
+            ):
+                return self._snapshot_cache[1]
+            body = _json_body(self.snapshot_doc())
+            self._snapshot_cache = (time.monotonic(), body)
+            return body
 
     @staticmethod
     def _respond(

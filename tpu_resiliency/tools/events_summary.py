@@ -21,6 +21,9 @@ Usage::
     # slice to one incident: absolute epoch, ISO-8601, or stream-relative +SECS
     python -m tpu_resiliency.tools.events_summary ev.jsonl --since +42 --until +97
     python -m tpu_resiliency.tools.events_summary ev.jsonl --trace 4f2a91b0c3d4e5f6
+    # slice a fleet-shared stream back to one job (launcher --fleet-dir stamps
+    # the job identity onto every record)
+    python -m tpu_resiliency.tools.events_summary ev.jsonl --job trainer-a
 """
 
 from __future__ import annotations
@@ -76,11 +79,14 @@ def parse_kinds(spec: Optional[str]) -> Optional[frozenset]:
 
 def make_filter(
     since: Optional[str], until: Optional[str], trace: Optional[str], t0: float,
-    kinds: Optional[frozenset] = None,
+    kinds: Optional[frozenset] = None, job: Optional[str] = None,
 ):
-    """Record predicate for the --since/--until/--trace/--kind slicers;
+    """Record predicate for the --since/--until/--trace/--kind/--job slicers;
     ``t0`` resolves relative (+SECS) bounds. The kind set composes with the
-    time/trace bounds, so timeline AND footer reflect one slice."""
+    time/trace bounds, so timeline AND footer reflect one slice. ``job``
+    matches the envelope's fleet job identity ($TPU_RESILIENCY_JOB, stamped
+    by launchers running under --fleet-dir) — the slicer that takes a stream
+    several jobs share back to one job."""
     lo = hi = None
     if since is not None:
         s, rel = parse_when(since)
@@ -96,6 +102,8 @@ def make_filter(
         if hi is not None and (not isinstance(ts, (int, float)) or ts > hi):
             return False
         if trace is not None and rec.get("trace_id") != trace:
+            return False
+        if job is not None and rec.get("job") != job:
             return False
         if kinds is not None and rec.get("kind") not in kinds:
             return False
@@ -381,6 +389,7 @@ def _follow(
     since: Optional[str] = None,
     until: Optional[str] = None,
     trace: Optional[str] = None,
+    job: Optional[str] = None,
 ) -> int:
     # Incremental footer state, not a record list: a multi-day follow on a
     # chatty job must not grow RSS one dict per event.
@@ -399,7 +408,9 @@ def _follow(
                     continue
                 if t0 is None:
                     t0 = rec["ts"]
-                    keep = make_filter(since, until, trace, t0, kinds=kinds)
+                    keep = make_filter(
+                        since, until, trace, t0, kinds=kinds, job=job
+                    )
                 if not keep(rec):
                     continue
                 counts[rec["kind"]] += 1
@@ -454,6 +465,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "shared by several)",
     )
     ap.add_argument(
+        "--job",
+        help="show only records stamped with this fleet job identity "
+        "($TPU_RESILIENCY_JOB, the launcher's --rdzv-id under --fleet-dir) — "
+        "slice a fleet-merged stream back to one job post-hoc; composes with "
+        "the other slicers",
+    )
+    ap.add_argument(
         "--no-timeline", action="store_true", help="print only the summary footer"
     )
     ap.add_argument(
@@ -475,7 +493,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.follow:
         return _follow(
             args.events_file, args.kind,
-            since=args.since, until=args.until, trace=args.trace,
+            since=args.since, until=args.until, trace=args.trace, job=args.job,
         )
     # read_events tolerates unreadable files (shared-stream readers race the
     # first writer); a CLI invocation on a missing/denied/directory path must
@@ -488,9 +506,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 1
     records = read_events(args.events_file)
     keep = None
-    if args.since or args.until or args.trace:
+    if args.since or args.until or args.trace or args.job:
         tss = [r["ts"] for r in records if isinstance(r.get("ts"), (int, float))]
-        keep = make_filter(args.since, args.until, args.trace, min(tss) if tss else 0.0)
+        keep = make_filter(
+            args.since, args.until, args.trace, min(tss) if tss else 0.0,
+            job=args.job,
+        )
     if pipe_safe(
         lambda: summarize(
             records, kind=args.kind, timeline=not args.no_timeline, keep=keep

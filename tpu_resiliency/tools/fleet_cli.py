@@ -1,0 +1,189 @@
+"""``tpu-fleet``: render the fleet view offline (or from a live fleetd).
+
+The operator-side twin of ``tpu-fleetd``: takes the ``tpu-fleet-snapshot-1``
+document the daemon persists (``--snapshot fleet.json``) — or fetches one
+from a live fleetd (``--url http://host:port``) — and renders the scoreboard,
+SLO ranking, or incident feed as tables. Offline by design: the snapshot is
+self-contained, so a postmortem needs no running fleet.
+
+Usage::
+
+    tpu-fleet scoreboard --snapshot fleet.json
+    tpu-fleet slo --snapshot fleet.json
+    tpu-fleet incidents --snapshot fleet.json --job trainer-a
+    tpu-fleet scoreboard --url http://127.0.0.1:9400
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Optional
+
+from tpu_resiliency.fleet.aggregator import SNAPSHOT_SCHEMA
+from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
+
+
+def _fmt_ratio(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def _fmt_s(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    return f"{v * 1e3:.0f}ms" if v < 1.0 else f"{v:.1f}s"
+
+
+def _table(rows: list[list[str]], header: list[str], out) -> None:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    line = "  ".join(h.ljust(w) for h, w in zip(header, widths))
+    print(line, file=out)
+    print("-" * len(line), file=out)
+    for r in rows:
+        print("  ".join(str(c).ljust(w) for c, w in zip(r, widths)), file=out)
+
+
+def render_scoreboard(doc: dict, out=None) -> None:
+    out = sys.stdout if out is None else out
+    gp = doc.get("goodput") or {}
+    fleet = gp.get("fleet") or {}
+    print(
+        f"fleet: {fleet.get('jobs', 0)} job(s), "
+        f"{fleet.get('reachable', 0)} reachable, "
+        f"goodput_ratio={_fmt_ratio(fleet.get('goodput_ratio'))}",
+        file=out,
+    )
+    rows = []
+    for r in gp.get("jobs") or []:
+        phases = r.get("phases") or {}
+        rows.append([
+            r.get("job", "?"), r.get("status", "?"),
+            _fmt_ratio(r.get("goodput_ratio")),
+            r.get("steps") if r.get("steps") is not None else "-",
+            _fmt_s(phases.get("train")), _fmt_s(phases.get("restart")),
+            _fmt_s(phases.get("ckpt_stall")),
+            r.get("error") or "",
+        ])
+    if rows:
+        _table(
+            rows,
+            ["job", "status", "goodput", "steps", "train", "restart",
+             "ckpt_stall", "detail"],
+            out,
+        )
+
+
+def render_slo(doc: dict, out=None) -> None:
+    out = sys.stdout if out is None else out
+    slo = doc.get("slo") or {}
+    rows = []
+    for r in slo.get("jobs") or []:
+        share = r.get("restart_share")
+        ttd, ttr = r.get("time_to_detect_s") or {}, r.get("time_to_recover_s") or {}
+        rows.append([
+            r.get("job", "?"), r.get("status", "?"),
+            f"{share * 100:.1f}%" if isinstance(share, (int, float)) else "-",
+            _fmt_s(r.get("restart_s")),
+            r.get("restarts") if r.get("restarts") is not None else "-",
+            r.get("incidents") if r.get("incidents") is not None else "-",
+            _fmt_s(ttd.get("p95")), _fmt_s(ttr.get("p95")),
+        ])
+    print("SLO ranking (worst first: time-in-restart share)", file=out)
+    if rows:
+        _table(
+            rows,
+            ["job", "status", "restart%", "restart_s", "restarts",
+             "incidents", "detect_p95", "recover_p95"],
+            out,
+        )
+    else:
+        print("no jobs", file=out)
+
+
+def render_incidents(doc: dict, job: Optional[str] = None, out=None) -> None:
+    out = sys.stdout if out is None else out
+    feed = (doc.get("incidents") or {}).get("incidents") or []
+    if job is not None:
+        feed = [i for i in feed if i.get("job") == job]
+    scope = f" for job {job!r}" if job else ""
+    print(f"{len(feed)} incident(s){scope} (newest first)", file=out)
+    for inc in feed:
+        slo = inc.get("slo") or {}
+        ranks = inc.get("ranks") or []
+        print(
+            f"  [{inc.get('job', '?')}] {inc.get('id', '?')}: "
+            f"{inc.get('trigger', '?')} -> {inc.get('outcome', '?')}"
+            + (f" ranks={ranks}" if ranks else "")
+            + (f" detect={_fmt_s(slo.get('time_to_detect_s'))}"
+               f" recover={_fmt_s(slo.get('time_to_recover_s'))}"
+               if slo else ""),
+            file=out,
+        )
+
+
+def load_snapshot(args) -> dict:
+    if args.url:
+        with urllib.request.urlopen(
+            f"{args.url.rstrip('/')}/fleet/snapshot", timeout=10
+        ) as r:
+            doc = json.load(r)
+    else:
+        with open(args.snapshot) as f:
+            doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != SNAPSHOT_SCHEMA:
+        raise ValueError(
+            f"not a {SNAPSHOT_SCHEMA} document "
+            f"(got schema {doc.get('schema') if isinstance(doc, dict) else None!r})"
+        )
+    return doc
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-fleet",
+        description="Render a fleet snapshot (tpu-fleetd --snapshot output or "
+        "a live fleetd's /fleet/snapshot) as operator tables.",
+    )
+    ap.add_argument(
+        "view", nargs="?", default="scoreboard",
+        choices=("scoreboard", "slo", "incidents"),
+        help="which fleet view to render (default: scoreboard)",
+    )
+    ap.add_argument("--snapshot", default=None, help="fleet snapshot JSON file")
+    ap.add_argument(
+        "--url", default=None,
+        help="live fleetd base URL (fetches /fleet/snapshot instead of --snapshot)",
+    )
+    ap.add_argument(
+        "--job", default=None,
+        help="incidents view: slice the feed to one job",
+    )
+    args = ap.parse_args(argv)
+    if bool(args.snapshot) == bool(args.url):
+        print("exactly one of --snapshot / --url is required", file=sys.stderr)
+        return 2
+    try:
+        doc = load_snapshot(args)
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"cannot load fleet snapshot: {e}", file=sys.stderr)
+        return 1
+
+    def emit() -> None:
+        if args.view == "scoreboard":
+            render_scoreboard(doc)
+        elif args.view == "slo":
+            render_slo(doc)
+        else:
+            render_incidents(doc, job=args.job)
+
+    if pipe_safe(emit):
+        return SIGPIPE_EXIT
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

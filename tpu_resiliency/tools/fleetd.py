@@ -1,0 +1,183 @@
+"""``tpu-fleetd``: the standalone fleet aggregation daemon.
+
+One fleetd watches one ``--fleet-dir`` (the shared directory launchers with
+``--fleet-dir`` register their telemetry leases in), scrapes every live job
+in parallel, and serves the merged fleet view:
+
+- ``/fleet/metrics`` — merged Prometheus exposition (``job=`` labels +
+  ``fleet:*`` cross-job totals + fleetd's own operational metrics);
+- ``/fleet/goodput`` — the per-job goodput scoreboard;
+- ``/fleet/slo`` — jobs ranked worst-first by time-in-restart;
+- ``/fleet/incidents`` — the cross-job incident feed;
+- ``/fleet/hangz`` — the fleet-wide hang census;
+- ``/fleet/snapshot`` — the whole fold as one offline-renderable document.
+
+Jobs appear when their lease lands, disappear when it is removed (clean
+stop) or expires (crash — fleetd unlinks stale leases itself), all without a
+fleetd restart. One crashed/hung job marks that job ``unreachable``; every
+fleet endpoint keeps answering 200.
+
+Usage::
+
+    tpu-fleetd --fleet-dir /shared/fleet                  # serve forever
+    tpu-fleetd --fleet-dir /shared/fleet --port 9400
+    tpu-fleetd --fleet-dir /shared/fleet --snapshot fleet.json --once
+    tpu-fleet scoreboard --snapshot fleet.json            # render offline
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from tpu_resiliency.fleet.aggregator import FleetAggregator
+from tpu_resiliency.fleet.registry import DEFAULT_TTL_S
+from tpu_resiliency.fleet.server import PORT_FILE_NAME, FleetServer
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-fleetd",
+        description="Fleet federation daemon: scrape every registered job's "
+        "telemetry endpoint and serve the merged fleet view.",
+    )
+    p.add_argument(
+        "--fleet-dir", required=True,
+        help="shared discovery directory the launchers register their "
+        "telemetry leases in (launcher --fleet-dir)",
+    )
+    p.add_argument(
+        "--port", type=int, default=0,
+        help="fleet endpoint port (0 = ephemeral; the bound port lands in "
+        "--port-file)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port-file", default=None,
+        help=f"port-file handshake path (default: <fleet-dir>/{PORT_FILE_NAME})",
+    )
+    p.add_argument(
+        "--lease-ttl", type=float, default=DEFAULT_TTL_S,
+        help="seconds after which a non-refreshed lease is a dead job "
+        "(expired and unlinked by the scrape loop)",
+    )
+    p.add_argument(
+        "--scrape-timeout", type=float, default=2.0,
+        help="per-job HTTP timeout: one hung job costs this much once per "
+        "scrape, never the fleet endpoint",
+    )
+    p.add_argument(
+        "--scrape-interval", type=float, default=5.0,
+        help="background scrape cadence; endpoint requests between beats "
+        "serve the cached view (--scrape-ttl)",
+    )
+    p.add_argument(
+        "--scrape-ttl", type=float, default=2.0,
+        help="endpoint-triggered scrapes are collapsed to one fan-out per "
+        "this many seconds",
+    )
+    p.add_argument(
+        "--snapshot", default=None,
+        help="also persist the fleet snapshot document here (atomic write) "
+        "after every scrape — the tpu-fleet offline input",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="one scrape: print a one-line fleet summary (and write "
+        "--snapshot), then exit — for scripts and smoke tests",
+    )
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    fleet_dir = os.path.abspath(args.fleet_dir)
+    os.makedirs(fleet_dir, exist_ok=True)
+    aggregator = FleetAggregator(
+        fleet_dir,
+        lease_ttl=args.lease_ttl,
+        timeout=args.scrape_timeout,
+    )
+    server = FleetServer(
+        aggregator,
+        port=args.port,
+        host=args.host,
+        port_file=args.port_file or os.path.join(fleet_dir, PORT_FILE_NAME),
+        scrape_ttl=args.scrape_ttl,
+    )
+    if args.once:
+        view = aggregator.scrape()
+        doc = view.goodput_doc()
+        fleet = doc["fleet"]
+        print(
+            f"fleet: {fleet['jobs']} job(s), {fleet['reachable']} reachable, "
+            f"goodput_ratio={fleet['goodput_ratio']} "
+            f"(scrape {view.scrape_s * 1e3:.1f} ms)"
+        )
+        for row in doc["jobs"]:
+            ratio = row.get("goodput_ratio")
+            print(
+                f"  {row['job']}: {row['status']}"
+                + (f" ratio={ratio}" if ratio is not None else "")
+                + (f" ({row['error']})" if row.get("error") else "")
+            )
+        if args.snapshot:
+            _write_snapshot(view, args.snapshot)
+            print(f"wrote {args.snapshot}")
+        return 0
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        log.info(f"fleetd: signal {signum}, shutting down")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    port = server.start()
+    log.info(
+        f"tpu-fleetd watching {fleet_dir} on http://{args.host}:{port} "
+        f"(lease ttl {args.lease_ttl}s, scrape every {args.scrape_interval}s)"
+    )
+    try:
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                # The background beat drives the same TTL cache the endpoints
+                # read, so dashboards see a view at most interval+ttl old
+                # even when nobody scrapes fleetd itself.
+                view = server.view(max_age=0.0)
+                if view is not None and args.snapshot:
+                    _write_snapshot(view, args.snapshot)
+            except Exception:
+                log.warning("fleetd scrape beat failed", exc_info=True)
+            elapsed = time.monotonic() - t0
+            stop.wait(max(0.1, args.scrape_interval - elapsed))
+    finally:
+        server.stop()
+    return 0
+
+
+def _write_snapshot(view, path: str) -> None:
+    import json
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(view.snapshot_doc(), f, indent=2, default=repr)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,14 @@ instead of the metrics report: wall clock classified into train / ckpt_stall /
 restart / incident / unattributed, the goodput ratio, and per-rank rows — the
 offline twin of the launcher's live ``/goodput`` endpoint, computed from the
 same stream by the same ledger.
+
+``--job`` slices fleet-scope inputs back to one job post-hoc: on an events
+JSONL it keeps only records stamped with that job identity
+($TPU_RESILIENCY_JOB, set by launchers under ``--fleet-dir``); the input may
+also be a metrics *snapshot* document (``MetricsRegistry.snapshot`` format —
+e.g. the ``metrics`` section of a ``tpu-fleetd`` snapshot), in which case the
+series carrying the matching ``job=`` label are kept (the ``fleet:*``
+cross-job totals, which belong to no single job, are dropped from the slice).
 """
 
 from __future__ import annotations
@@ -37,6 +45,42 @@ from tpu_resiliency.utils.metrics import MetricsRegistry, aggregate
 def _counter_total(reg: MetricsRegistry, name: str) -> float:
     snap = reg.snapshot()["metrics"].get(name, [])
     return sum(e.get("value", 0.0) for e in snap)
+
+
+def load_snapshot_doc(path: str) -> Optional[dict]:
+    """Parse ``path`` as a metrics snapshot document, or None when it is not
+    one (an events JSONL line also parses as a dict — only a whole-file JSON
+    object with a ``metrics`` dict is a snapshot)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if isinstance(doc, dict) and isinstance(doc.get("metrics"), dict):
+        return doc
+    return None
+
+
+def slice_snapshot_job(doc: dict, job: str) -> dict:
+    """One job's slice of a fleet-merged snapshot: series whose ``job`` label
+    matches, with the label dropped (the slice IS that job's view — keeping
+    it would make the slice unmergeable with the job's own snapshots);
+    ``fleet:*`` totals and other-job series are excluded."""
+    out: dict = {"ts": doc.get("ts"), "metrics": {}}
+    for name, entries in (doc.get("metrics") or {}).items():
+        if name.startswith("fleet:") or not isinstance(entries, list):
+            continue
+        kept = []
+        for e in entries:
+            if not isinstance(e, dict):
+                continue
+            labels = dict(e.get("labels") or {})
+            if labels.pop("job", None) != job:
+                continue
+            kept.append({**e, "labels": labels})
+        if kept:
+            out["metrics"][name] = kept
+    return out
 
 
 def _fmt_s(v: float) -> str:
@@ -200,6 +244,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "minus the baseline), the arithmetic the autoscale chaos scenario "
         "gates on",
     )
+    ap.add_argument(
+        "--job", default=None,
+        help="slice a fleet-scope input back to one job: on an events JSONL, "
+        "keep only records stamped with this job identity (launcher "
+        "--fleet-dir); on a metrics snapshot document, keep only series "
+        "carrying the matching job= label (fleet:* totals dropped)",
+    )
     args = ap.parse_args(argv)
     if args.baseline and not args.goodput:
         print("--baseline requires --goodput", file=sys.stderr)
@@ -210,7 +261,27 @@ def main(argv: Optional[list[str]] = None) -> int:
     except OSError as e:
         print(f"cannot read events file: {e}", file=sys.stderr)
         return 1
+    snapshot_doc = load_snapshot_doc(args.events_file) if args.job else None
+    if snapshot_doc is not None:
+        if args.goodput:
+            print(
+                "--goodput needs an events stream, not a metrics snapshot",
+                file=sys.stderr,
+            )
+            return 2
+        reg = MetricsRegistry()
+        try:
+            reg.merge(slice_snapshot_job(snapshot_doc, args.job))
+        except (ValueError, TypeError) as e:
+            print(f"cannot slice snapshot: {e}", file=sys.stderr)
+            return 1
+        return _emit_registry(reg, args)
     records = read_events(args.events_file)
+    if args.job is not None:
+        records = [r for r in records if r.get("job") == args.job]
+        if not records:
+            print(f"no events for job {args.job!r}", file=sys.stderr)
+            return 1
     if not records:
         print("no events to aggregate", file=sys.stderr)
         return 1
@@ -263,7 +334,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         if pipe_safe(emit_goodput):
             return SIGPIPE_EXIT
         return 0
-    reg = aggregate(records)
+    return _emit_registry(aggregate(records), args)
+
+
+def _emit_registry(reg: MetricsRegistry, args) -> int:
+    """Render a built registry per --format/--output (the shared tail of the
+    events-aggregation and snapshot-slice paths)."""
     if args.format == "json" and args.output:
         reg.write_json(args.output)
         print(f"wrote {args.output}")

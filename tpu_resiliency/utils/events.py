@@ -79,13 +79,20 @@ FLIGHT_DIR_ENV = "TPU_RESILIENCY_FLIGHT_DIR"
 #: the launcher's telemetry endpoint merges the published snapshots into one
 #: job-level view instead of scraping every rank's files.
 METRICS_PUSH_ENV = "TPU_RESILIENCY_METRICS_PUSH"
+#: Set to a job identity (the launcher exports its --rdzv-id when --fleet-dir
+#: is on) to stamp ``job`` into every event's envelope. Fleet-scope consumers
+#: (``tools/fleetd.py``, ``tpu-metrics-dump --job``, ``tpu-events-summary
+#: --job``) use it to slice a stream several jobs share back to one job.
+JOB_ENV = "TPU_RESILIENCY_JOB"
 
 #: Envelope keys every JSONL record carries; payload keys that collide are
 #: renamed ``p_<key>`` by ``to_json``. Consumers (events_summary, trace_export)
 #: use this to split envelope from payload — one schema, one place.
 #: ``trace_id``/``span_id`` are envelope members too (omitted when tracing is
-#: inactive) so a payload key of the same name can never forge causal context.
-RESERVED_KEYS = ("ts", "source", "kind", "pid", "rank", "trace_id", "span_id")
+#: inactive) so a payload key of the same name can never forge causal context;
+#: same for ``job`` (fleet federation's job identity, from $TPU_RESILIENCY_JOB).
+RESERVED_KEYS = ("ts", "source", "kind", "pid", "rank", "trace_id", "span_id",
+                 "job")
 
 
 @dataclasses.dataclass
@@ -100,6 +107,8 @@ class Event:
     #: active when this event was recorded — None outside any trace
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
+    #: fleet job identity ($TPU_RESILIENCY_JOB) — None outside fleet scope
+    job: Optional[str] = None
 
     def to_json(self) -> str:
         env = {
@@ -114,6 +123,8 @@ class Event:
             env["trace_id"] = self.trace_id
         if self.span_id is not None:
             env["span_id"] = self.span_id
+        if self.job is not None:
+            env["job"] = self.job
         return json.dumps(
             {
                 **env,
@@ -305,6 +316,7 @@ def record(source: str, kind: str, **payload: Any) -> None:
         rank=int(rank_s) if rank_s and rank_s.isdigit() else None,
         trace_id=trace_id,
         span_id=span_id,
+        job=os.environ.get(JOB_ENV) or None,
     )
     for sink in sinks:
         try:

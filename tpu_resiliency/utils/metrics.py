@@ -410,7 +410,7 @@ class MetricsRegistry:
             out["metrics"].setdefault(name, []).append(entry)
         return _plain_json(out)
 
-    def merge(self, snapshot: dict) -> None:
+    def merge(self, snapshot: dict, extra_labels: Optional[dict] = None) -> None:
         """Fold one :meth:`snapshot` document into this registry.
 
         The merge algebra (what makes a tree of partial merges equal the flat
@@ -427,6 +427,15 @@ class MetricsRegistry:
         its snapshot up the store topology and any node can fold the set —
         or a subtree's partial fold — into one job-level registry without
         ever touching another rank's files.
+
+        ``extra_labels`` are stamped onto every series of the incoming
+        snapshot *before* the fold (overriding same-named snapshot labels) —
+        the fleet-federation step: merging two jobs' snapshots under distinct
+        ``job=`` labels keeps their same-named series separate instead of
+        summing ``tpu_restarts_total`` across unrelated jobs
+        (``tools/fleetd.py``). Series that already carry the label from an
+        earlier labelled merge re-merge idempotently, so a tree of labelled
+        partial merges still equals the flat labelled merge.
         """
         metrics = snapshot.get("metrics") if isinstance(snapshot, dict) else None
         if not isinstance(metrics, dict):
@@ -434,6 +443,9 @@ class MetricsRegistry:
         default_ts = snapshot.get("ts")
         if not isinstance(default_ts, (int, float)):
             default_ts = 0.0
+        extra = {
+            str(k): str(v) for k, v in (extra_labels or {}).items()
+        }
         for name, entries in sorted(metrics.items()):
             if not isinstance(entries, list):
                 continue
@@ -445,6 +457,7 @@ class MetricsRegistry:
                     str(k): str(v)
                     for k, v in (e.get("labels") or {}).items()
                 }
+                labels.update(extra)
                 help = e.get("help") or ""
                 if kind == "counter":
                     v = e.get("value")
@@ -854,6 +867,34 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "preemption notices withdrawn before their grace window elapsed "
             "(the deferred drain/save was cancelled)",
         ).inc()
+    elif kind == "fleet_scrape":
+        # One per fleetd scrape fan-out (tools/fleetd.py): how many jobs the
+        # fleet control plane currently sees and what a full scrape costs.
+        if isinstance(rec.get("jobs"), (int, float)):
+            reg.gauge(
+                "tpu_fleet_jobs",
+                "jobs with a live discovery lease at the last fleet scrape",
+            ).set(rec["jobs"])
+        if isinstance(rec.get("unreachable"), (int, float)):
+            reg.gauge(
+                "tpu_fleet_jobs_unreachable",
+                "leased jobs whose telemetry endpoint failed the last scrape",
+            ).set(rec["unreachable"])
+        if isinstance(rec.get("duration_s"), (int, float)):
+            reg.histogram(
+                "tpu_fleet_scrape_seconds",
+                "wall clock of one full fleet scrape (parallel fan-out over "
+                "every live job)",
+            ).observe(rec["duration_s"])
+    elif kind == "fleet_job_unreachable":
+        # One per failed per-job scrape: the job stays on the scoreboard as
+        # `unreachable`; this counter is the rate of that degradation.
+        reg.counter(
+            "tpu_fleet_scrape_errors_total",
+            "per-job scrape failures during fleet aggregation, by job "
+            "(the job is marked unreachable, the fleet endpoints keep serving)",
+            job=str(rec.get("job", "?")),
+        ).inc()
     elif kind == "remediation_action":
         reg.counter(
             "tpu_remediation_actions_total",
@@ -948,6 +989,8 @@ class MetricsSink:
             **{f"p_{k}" if k in RESERVED_KEYS else k: v
                for k, v in event.payload.items()},
         }
+        if getattr(event, "job", None) is not None:
+            rec["job"] = event.job
         observe_record(rec, self.registry)
         if self.json_path is not None:
             now = time.monotonic()
