@@ -188,6 +188,13 @@ def bench(mb: int) -> dict:
     full_peer = sum(moved for _, moved in full)
     tpu_events.remove_sink(seen.append)
 
+    # Phase decomposition from the SAME event stream, through the same code
+    # path tpu-critpath runs for operators (tools/critpath.py) — no more
+    # bench-private stopwatch arithmetic.
+    from tpu_resiliency.tools.critpath import reshard_decomposition
+
+    phases = reshard_decomposition([e.to_record() for e in seen])
+
     for s in stores:
         s.close()
     srv.close()
@@ -203,6 +210,10 @@ def bench(mb: int) -> dict:
         "ranged_s": round(ranged_s, 4),
         "ranged_peer_bytes": ranged_peer,
         "ranged_local_bytes": ranged_local,
+        #: tools/critpath.py:reshard_decomposition over the run's events —
+        #: plan-build vs ranged-fetch wall split (fetch_s is the serve-side
+        #: target ROADMAP item 4 attacks)
+        "phases": phases,
         "full_s": round(full_s, 4),
         "full_peer_bytes": full_peer,
         "bytes_ratio": round(ranged_peer / full_peer, 4) if full_peer else None,

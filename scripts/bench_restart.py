@@ -181,7 +181,9 @@ time.sleep(0.5)
 
 def bench_injob(warm_spares: int = 0, fast_path: bool = True) -> dict:
     """Respawn latency, decomposed from the launcher's own structured event
-    stream (wall-clock, same clock as the worker stamps):
+    stream by ``tools/critpath.py:restart_decomposition`` — the SAME code
+    path ``tpu-critpath`` runs for operators, anchored here at the worker's
+    own fault/re-entry stamps (same wall clock as the stream):
 
     - ``detect_ms``: fault injection (the worker's exit stamp) →
       ``failure_detected`` (the supervise loop's ``wait_change`` return) —
@@ -241,45 +243,31 @@ def bench_injob(warm_spares: int = 0, fast_path: bool = True) -> dict:
             with open(os.path.join(stamps, name)) as f:
                 return float(f.read())
 
+        from tpu_resiliency.tools.critpath import restart_decomposition
+
         evs = [json.loads(line) for line in open(events)]
-
-        def first_ts(kind, after=0.0):
-            return next(
-                e["ts"] for e in evs if e.get("kind") == kind and e["ts"] > after
-            )
-
         t_exit = read("exit_0")
         t_reentry = read("entry_1_0")
-        t_detect = first_ts("failure_detected")
-        t_req = first_ts("restart_requested")
-        rounds = [e["ts"] for e in evs if e.get("kind") == "rendezvous_round"]
-        t_round1 = next(ts for ts in rounds if ts > t_detect)
+        dec = restart_decomposition(evs, fault_ts=t_exit, resume_ts=t_reentry)
+        assert dec is not None, "no restart episode in the event stream"
+        segs = {s["name"]: s["duration_ms"] for s in dec["segments"]}
         out = {
             "respawn_ms": (t_reentry - t_exit) * 1e3,
-            "detect_ms": (t_detect - t_exit) * 1e3,
-            "teardown_ms": (t_req - t_detect) * 1e3,
-            "rendezvous_ms": (t_round1 - t_req) * 1e3,
-            "fast_path_rendezvous": any(
-                e.get("kind") == "rendezvous_fast_path"
-                and e.get("outcome") == "reused" for e in evs
-            ),
+            "detect_ms": segs["detect"],
+            "teardown_ms": segs["teardown"],
+            "rendezvous_ms": segs["rendezvous"],
+            "fast_path_rendezvous": dec["fast_path"],
             "python_startup_floor_ms": startup_ms,
         }
         if warm_spares:
-            promos = [
-                e["ts"] for e in evs
-                if e.get("kind") == "worker_promoted"
-                and e.get("outcome") == "promoted" and e.get("round", 0) >= 1
-            ]
-            assert promos, "warm leg never promoted a spare"
-            t_promo = min(promos)
-            out["promote_ms"] = (t_promo - t_round1) * 1e3
+            assert dec["promoted"], "warm leg never promoted a spare"
+            out["promote_ms"] = segs["promote"]
             # Clamped: the promoted shim starts executing the instant the spec
             # hits its pipe, which can beat the launcher's own event stamp by
             # a fraction of a millisecond.
-            out["first_step_ready_ms"] = max(0.0, (t_reentry - t_promo) * 1e3)
+            out["first_step_ready_ms"] = max(0.0, segs["first_step_ready"])
         else:
-            out["spawn_and_startup_ms"] = (t_reentry - t_round1) * 1e3
+            out["spawn_and_startup_ms"] = segs["spawn_and_startup"]
         return out
 
 

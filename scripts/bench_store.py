@@ -1,0 +1,230 @@
+"""Op-storm benchmark for the coordination store: the "before" picture.
+
+ROADMAP item 2 (shard the store, tree the collectives) will be judged against
+a latency curve — this harness records it. N concurrent clients on loopback
+hammer one :class:`KVServer` with the mixed small-op workload the launcher
+actually generates (set/get/add/touch + a periodic prefix scan), and the
+report is client-observed p50/p95 latency and aggregate throughput per
+concurrency level, plus the server's OWN ``store_stats`` view of the same
+storm (handle vs queue-wait split — the number that says whether the loop or
+the wire is the bottleneck).
+
+The second leg is the **telemetry overhead gate**: the same storm against a
+``stats_enabled=False`` control server. Per-op accounting must cost <5% of
+client-observed p50 (the knob defaults ON, so the tax is paid by every job —
+``tests/platform/test_store_perf.py`` enforces the gate as a slow-marked
+test).
+
+Usage::
+
+    python scripts/bench_store.py [--ops N] [--out BENCH_store_baseline.json]
+    python scripts/bench_store.py --smoke     # CI: tiny storm, sanity asserts
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import platform
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tpu_resiliency.platform.store import KVClient, KVServer  # noqa: E402
+
+#: concurrency levels of the committed baseline curve
+LEVELS = (1, 4, 16, 64)
+
+
+def storm_client(port: int, client_id: int, ops: int, q) -> None:
+    """One client's slice of the storm: the launcher-shaped small-op mix,
+    per-op latency sampled client-side (the operator-visible number)."""
+    c = KVClient("127.0.0.1", port, timeout=30.0)
+    lat: list[float] = []
+    try:
+        for i in range(ops):
+            kind = i % 8
+            key = f"storm/c{client_id}/k{i % 16}"
+            t0 = time.perf_counter()
+            if kind < 3:
+                c.set(key, i)
+            elif kind < 6:
+                c.try_get(key)
+            elif kind == 6:
+                c.add(f"storm/c{client_id}/ctr", 1)
+            else:
+                c.touch(f"storm/hb/c{client_id}")
+            lat.append(time.perf_counter() - t0)
+            if i % 64 == 63:
+                t0 = time.perf_counter()
+                c.prefix_get(f"storm/c{client_id}/")
+                lat.append(time.perf_counter() - t0)
+    finally:
+        c.close()
+    q.put((client_id, lat))
+
+
+def run_storm(port: int, clients: int, ops_per_client: int) -> dict:
+    """Storm with client PROCESSES — the deployment shape (workers are
+    separate processes), and the measurement shape: in-process client threads
+    would share the server loop's GIL and misattribute their own framing cost
+    to server latency."""
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=storm_client, args=(port, i, ops_per_client, q))
+        for i in range(clients)
+    ]
+    t_start = time.perf_counter()
+    for p in procs:
+        p.start()
+    lats: list[float] = []
+    for _ in range(clients):
+        _, lat = q.get(timeout=300)
+        lats.extend(lat)
+    wall = time.perf_counter() - t_start
+    for p in procs:
+        p.join(20.0)
+        if p.is_alive():
+            p.terminate()
+    lats.sort()
+
+    def qtile(p: float) -> float:
+        return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+    return {
+        "clients": clients,
+        "ops": len(lats),
+        "p50_us": round(qtile(0.50) * 1e6, 2),
+        "p95_us": round(qtile(0.95) * 1e6, 2),
+        "p99_us": round(qtile(0.99) * 1e6, 2),
+        "ops_per_s": round(len(lats) / wall, 1),
+        "wall_s": round(wall, 3),
+    }
+
+
+def bench_levels(levels=LEVELS, ops_per_client: int = 1500) -> dict:
+    """The latency-vs-concurrency curve, one server for the whole sweep (the
+    production shape: one store outlives every client), plus the server's own
+    store_stats account of it."""
+    srv = KVServer(host="127.0.0.1", port=0, stats_interval=3600.0)
+    try:
+        rows = [run_storm(srv.port, n, ops_per_client) for n in levels]
+        probe = KVClient("127.0.0.1", srv.port)
+        try:
+            stats = probe.store_stats()
+        finally:
+            probe.close()
+        # Trim the per-op table to the storm's hot ops (the committed JSON
+        # stays reviewable).
+        stats["ops"] = {
+            op: row for op, row in (stats.get("ops") or {}).items()
+            if row.get("count", 0) >= len(levels)
+        }
+        return {"levels": rows, "store_stats": stats}
+    finally:
+        srv.close()
+
+
+def bench_overhead(clients: int = 1, ops_per_client: int = 1500,
+                   trials: int = 9) -> dict:
+    """Client-observed p50 with per-op telemetry on vs off: N interleaved
+    trials per mode (on/off alternating, fresh server each — background-load
+    spikes hit both arms), compared by MEDIAN. One client on purpose — no
+    queueing amplification, so the delta is the collector's own service-time
+    tax, the number the <5% gate is about."""
+    import statistics
+
+    p50 = {True: [], False: []}
+    for _ in range(trials):
+        for enabled in (True, False):
+            srv = KVServer(
+                host="127.0.0.1", port=0,
+                stats_enabled=enabled, stats_interval=3600.0,
+            )
+            try:
+                p50[enabled].append(
+                    run_storm(srv.port, clients, ops_per_client)["p50_us"]
+                )
+            finally:
+                srv.close()
+    on = statistics.median(p50[True])
+    off = statistics.median(p50[False])
+    return {
+        "clients": clients,
+        "trials": trials,
+        "stats_on_p50_us": round(on, 2),
+        "stats_off_p50_us": round(off, 2),
+        "overhead_frac": round(on / off - 1.0, 4) if off else None,
+        "p50_us_all": {"on": p50[True], "off": p50[False]},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ops", type=int, default=1500,
+                    help="ops per client per level")
+    ap.add_argument(
+        "--out", default=os.path.join(REPO_ROOT, "BENCH_store_baseline.json")
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tiny storm asserting the telemetry answers (op counts, wait/"
+        "handle split, hot prefixes) without writing the committed file",
+    )
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        res = bench_levels(levels=(2,), ops_per_client=200)
+        print(json.dumps({"layer": "store-storm", **res["levels"][0]}))
+        stats = res["store_stats"]
+        ok = (
+            stats.get("enabled") is True
+            and stats.get("ops", {}).get("set", {}).get("count", 0) > 0
+            and stats["ops"]["set"]["handle"]["p50_us"] > 0
+            and stats["ops"]["set"]["wait"]["count"] > 0
+            and any(
+                r["prefix"].startswith("storm/")
+                for r in stats.get("hot_prefixes", [])
+            )
+            and stats.get("bytes", {}).get("in", 0) > 0
+        )
+        print(json.dumps({"bench_store_smoke": "PASS" if ok else "FAIL",
+                          "stats_enabled": stats.get("enabled")}))
+        return 0 if ok else 1
+
+    curve = bench_levels(levels=LEVELS, ops_per_client=args.ops)
+    for row in curve["levels"]:
+        print(json.dumps({"layer": "store-storm", **row}))
+    overhead = bench_overhead()
+    print(json.dumps({"layer": "telemetry-overhead", **overhead}))
+    summary = {
+        "host": platform.node(),
+        "cpus": os.cpu_count(),
+        **curve,
+        "telemetry_overhead": overhead,
+    }
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "metric": "flat-store op latency vs concurrency (loopback, "
+                  "client-observed)",
+        "p50_us_by_clients": {
+            str(r["clients"]): r["p50_us"] for r in curve["levels"]
+        },
+        "p95_us_by_clients": {
+            str(r["clients"]): r["p95_us"] for r in curve["levels"]
+        },
+        "telemetry_overhead_frac": overhead["overhead_frac"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,6 +90,31 @@ from tpu_resiliency.platform.store import CoordStore, KVServer  # noqa: E402
 from tpu_resiliency.utils.events import read_events  # noqa: E402
 
 
+def _assert_byteflow_accounts(seen, min_frac: float = 0.95) -> None:
+    """The byte-flow acceptance gate: the ledger (``utils/byteflow.py``) must
+    attribute ≥95% of every byte this scenario moved to a purpose, and the
+    residue must surface as a metric through the same events→metrics path
+    everything else uses. Runs inside the chaos scenarios so every smoke and
+    e2e repro inherits the gate."""
+    from tpu_resiliency.utils.byteflow import ByteFlowLedger
+    from tpu_resiliency.utils.metrics import aggregate as _aggregate
+
+    ledger = ByteFlowLedger()
+    ledger.observe_many(e.to_record() for e in seen)
+    bf = ledger.summary()
+    assert bf["total_bytes"] > 0, "scenario moved no accountable bytes"
+    assert bf["accounted_frac"] >= min_frac, (
+        f"byte-flow ledger attributed only "
+        f"{100 * bf['accounted_frac']:.1f}% of {bf['total_bytes']} bytes "
+        f"(residue {bf['residue_bytes']}): {bf['families']}"
+    )
+    pub: list = []
+    ledger.publish(lambda source, kind, **p: pub.append({"kind": kind, **p}))
+    prom = _aggregate(pub).to_prometheus()
+    assert "tpu_byteflow_bytes_total" in prom, prom[:2000]
+    assert "tpu_byteflow_accounted_ratio" in prom, prom[:2000]
+
+
 # -- scenario: coordination store -------------------------------------------
 
 STORE_SPEC = (
@@ -324,6 +349,7 @@ def scenario_disk(seed: int, fallback: bool = False, spec: str | None = None):
         prom = reg.to_prometheus()
         assert "tpu_ckpt_integrity_failures_total" in prom, prom[:2000]
         assert 'kind="ckpt_quarantined"' in prom, prom[:2000]
+        _assert_byteflow_accounts(seen)
     finally:
         chaos.clear_plan()
         tpu_events.remove_sink(seen.append)
@@ -488,6 +514,7 @@ def scenario_elastic(seed: int, spec: str | None = None):
              e.payload["local_bytes"], e.payload["peer_bytes"])
             for e in plans
         )
+        _assert_byteflow_accounts(seen)
     finally:
         chaos.clear_plan()
         tpu_events.remove_sink(seen.append)

@@ -159,6 +159,15 @@ prom = urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5).rea
 assert "tpu_goodput_ratio" in prom, prom[:2000]
 assert "tpu_time_attributed_seconds_total" in prom, prom[:2000]
 assert "tpu_step_seconds_bucket" in prom, prom[:2000]
+# Forensics plane: the live /storez document must answer 200 with nonzero
+# op counts from the launcher-hosted coordination store.
+sz = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/storez", timeout=5).read())
+assert sz["schema"] == "tpu-storez-1", sz
+assert sz.get("enabled") is True, sz
+assert sum(r.get("count", 0) for r in (sz.get("ops") or {}).values()) > 0, sz
+print(f"/storez OK: {len(sz.get('ops') or {})} op families, "
+      f"conns={sz.get('conns')}")
 try:
     hz = json.loads(urllib.request.urlopen(
         f"http://127.0.0.1:{port}/healthz", timeout=5).read())
@@ -173,6 +182,34 @@ python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --goodput | sed '
 python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --goodput --format json | \
     python -c "import json,sys; d=json.load(sys.stdin); assert d['phases']['restart']>0 and d['phases']['ckpt_stall']>0, d" \
     || { echo "FAIL: offline --goodput lost the restart/ckpt attribution"; exit 1; }
+
+echo "== smoke: performance forensics (critical path + byte-flow ledger + store op storm)"
+# The restart episode in the goodput run's stream must name rendezvous.round
+# on its critical path, and the milestone decomposition must be present.
+CP=$(python -m tpu_resiliency.tools.critpath "$GP/events.jsonl" --episode restart)
+echo "$CP" | sed 's/^/    /'
+echo "$CP" | grep -q "rendezvous.round" \
+    || { echo "FAIL: rendezvous.round missing from the restart critical path"; exit 1; }
+echo "$CP" | grep -q "rendezvous " \
+    || { echo "FAIL: milestone segments missing from tpu-critpath output"; exit 1; }
+# Highlighted trace export round-trips.
+python -m tpu_resiliency.tools.critpath "$GP/events.jsonl" --trace "$GP/crit.trace.json" > /dev/null
+python - "$GP/crit.trace.json" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+crit = [e for e in doc["traceEvents"] if e.get("args", {}).get("critical_path")]
+assert crit, "no critical-path spans highlighted in the trace"
+assert all("self_time_ms" in e["args"] for e in doc["traceEvents"]
+           if e.get("ph") == "X"), "span slices lost self_time_ms"
+print(f"highlighted trace OK: {len(crit)} critical-path spans")
+PY
+# Byte-flow ledger: the run's bytes attribute to purposes with <5% residue.
+python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --bytes | sed 's/^/    /'
+python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --bytes --format json | \
+    python -c "import json,sys; d=json.load(sys.stdin); assert d['total_bytes']>0 and d['accounted_frac']>=0.95, d" \
+    || { echo "FAIL: byte-flow ledger residue exceeds 5%"; exit 1; }
+# Store op storm: telemetry answers under load (server-side account sane).
+python scripts/bench_store.py --smoke
 
 echo "== smoke: elastic reshard (ranged fetch moves fewer bytes than full mirrors)"
 python scripts/bench_reshard.py --smoke

@@ -142,7 +142,7 @@ def test_unknown_path_is_404_with_directory(server):
     doc = json.loads(ei.value.read())
     assert set(doc["endpoints"]) == {
         "/metrics", "/metrics.json", "/goodput", "/healthz", "/hangz",
-        "/autoscale", "/incidents", "/snapshot",
+        "/autoscale", "/incidents", "/snapshot", "/storez",
     }
 
 
@@ -348,3 +348,53 @@ def test_local_events_feed_the_served_registry(server):
     srv.stop()
     events.record("launcher", "worker_failed", global_rank=0)
     assert srv.registry.counter("tpu_worker_failures_total").value == 1
+
+
+def test_storez_serves_and_degrades(server):
+    srv, _ = server
+    # No source wired: degraded doc, 200.
+    status, body, _ = _get(srv.port, "/storez")
+    assert status == 200
+    doc = json.loads(body)
+    assert doc["schema"] == "tpu-storez-1" and "error" in doc
+    # Wired: wraps the store_stats document with the job identity.
+    srv.store_stats_fn = lambda: {
+        "schema": "tpu-store-stats-1", "enabled": True,
+        "ops": {"set": {"count": 16}}, "conns": 2, "parked": 0,
+    }
+    doc = json.loads(_get(srv.port, "/storez")[1])
+    assert doc["schema"] == "tpu-storez-1"
+    assert doc["enabled"] is True and doc["ops"]["set"]["count"] == 16
+    assert doc["job"] == srv.job
+    # A crashing collector degrades the document, never the endpoint.
+    srv.store_stats_fn = lambda: (_ for _ in ()).throw(RuntimeError("loop gone"))
+    status, body, _ = _get(srv.port, "/storez")
+    assert status == 200
+    assert "loop gone" in json.loads(body)["error"]
+
+
+def test_snapshot_folds_storez(server):
+    srv, _ = server
+    srv.store_stats_fn = lambda: {"enabled": True, "ops": {}}
+    doc = json.loads(_get(srv.port, "/snapshot")[1])
+    assert doc["storez"]["schema"] == "tpu-storez-1"
+    assert doc["storez"]["enabled"] is True
+    # Without the source the section is simply absent (fleetd contract:
+    # sections appear when wired, never as mandatory nulls).
+    srv.store_stats_fn = None
+    srv._snapshot_cache = None
+    doc = json.loads(_get(srv.port, "/snapshot")[1])
+    assert "storez" not in doc
+
+
+def test_refresh_feeds_byteflow_ledger(server):
+    srv, tmp_path = server
+    with open(tmp_path / "ev.jsonl", "w") as f:
+        f.write(json.dumps({
+            "ts": time.time(), "kind": "p2p_transfer", "direction": "send",
+            "bytes": 2048, "dst": 1, "tag": "repl/0", "pid": 9,
+        }) + "\n")
+    _get(srv.port, "/goodput")  # refresh publishes byteflow_update too
+    _, body, _ = _get(srv.port, "/metrics")
+    assert 'tpu_byteflow_bytes_total{direction="send",purpose="replicate"} 2048' in body
+    assert "tpu_byteflow_accounted_ratio 1" in body

@@ -1,0 +1,59 @@
+"""Slow-marked store-telemetry perf gates (scripts/bench_store.py harness):
+the op-telemetry knob (default ON) must add <5% to client-observed p50 on a
+seeded loopback op storm vs a ``stats_enabled=False`` control run, and the
+storm harness itself must produce a sane latency curve + server-side account
+— the regression anchors behind BENCH_store_baseline.json."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench_store  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def test_telemetry_overhead_under_five_percent():
+    """The <5% gate. Interleaved median-of-N on/off trials (background-load
+    spikes hit both arms); one noise-guard retry — a real regression (the
+    pre-sampling collector measured 8-18%) fails both batches, a scheduler
+    hiccup does not."""
+    res = bench_store.bench_overhead(clients=1, ops_per_client=1500, trials=9)
+    if res["overhead_frac"] >= 0.05:
+        retry = bench_store.bench_overhead(
+            clients=1, ops_per_client=1500, trials=9
+        )
+        res = min((res, retry), key=lambda r: r["overhead_frac"])
+    assert res["overhead_frac"] < 0.05, (
+        f"op telemetry costs {100 * res['overhead_frac']:.1f}% p50 "
+        f"(on {res['stats_on_p50_us']} us vs off {res['stats_off_p50_us']} us)"
+    )
+
+
+def test_storm_curve_and_server_account():
+    """The latency-curve harness: client-observed quantiles are ordered and
+    positive, and the server's own store_stats document accounts the storm
+    (op counts in the right ballpark, wait/handle split populated)."""
+    res = bench_store.bench_levels(levels=(1, 4), ops_per_client=400)
+    by_clients = {r["clients"]: r for r in res["levels"]}
+    for row in res["levels"]:
+        assert 0 < row["p50_us"] <= row["p95_us"] <= row["p99_us"], row
+        assert row["ops_per_s"] > 0
+    # More concurrency on one loop means more queueing, never less.
+    assert by_clients[4]["p50_us"] > by_clients[1]["p50_us"]
+    stats = res["store_stats"]
+    assert stats["enabled"] is True
+    total_ops = sum(r["count"] for r in stats["ops"].values())
+    real_ops = sum(r["ops"] for r in res["levels"])
+    # Sampled estimate within a generous band of the true storm volume.
+    assert 0.5 * real_ops <= total_ops <= 1.6 * real_ops, (total_ops, real_ops)
+    hot = {r["prefix"] for r in stats["hot_prefixes"]}
+    assert any(p.startswith("storm/") for p in hot), hot
+    set_row = stats["ops"].get("set")
+    assert set_row and set_row["handle"]["count"] > 0
+    assert set_row["wait"]["count"] > 0

@@ -1,0 +1,296 @@
+"""Store op telemetry: the ``store_stats`` wire op, the sampled collector,
+version-skew containment, and the periodic ``store_stats`` events →
+``tpu_store_*`` metrics parity."""
+
+import time
+
+import pytest
+
+from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.platform import store as store_mod
+from tpu_resiliency.platform.store import KVClient, KVServer
+from tpu_resiliency.utils import events
+from tpu_resiliency.utils.metrics import aggregate
+from tpu_resiliency.utils.opstats import (
+    LatencyHist,
+    OpStats,
+    SpaceSaving,
+    key_prefix,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    yield
+    events.clear_sinks()
+
+
+@pytest.fixture
+def server():
+    srv = KVServer(host="127.0.0.1", port=0)
+    yield srv
+    srv.close()
+
+
+def _client(srv, **kw):
+    return KVClient("127.0.0.1", srv.port, **kw)
+
+
+# -- the wire op --------------------------------------------------------------
+
+
+def test_store_stats_op_accounts_ops_and_bytes(server):
+    c = _client(server)
+    try:
+        for i in range(200):
+            c.set(f"jobs/a/k{i % 4}", i)
+            assert c.get(f"jobs/a/k{i % 4}", timeout=1.0) == i
+        doc = c.store_stats()
+        assert doc["schema"] == "tpu-store-stats-1"
+        assert doc["enabled"] is True
+        # Sampled-scaled tallies: 200 of each, ±SAMPLE granularity — allow a
+        # generous statistical band.
+        for op in ("set", "get"):
+            row = doc["ops"][op]
+            assert 48 <= row["count"] <= 420, (op, row)  # wide: sampled estimate
+            assert row["bytes_in"] > 0
+            assert row["handle"]["count"] >= 3
+            assert row["handle"]["p50_us"] > 0
+            assert row["wait"]["count"] >= 1
+            assert row["seconds"] > 0
+        assert doc["bytes"]["in"] > 0 and doc["bytes"]["out"] > 0
+        assert doc["conns"] == 1 and doc["conns_peak"] >= 1
+        assert doc["parked"] == 0
+        assert doc["keys"] == 4
+    finally:
+        c.close()
+
+
+def test_hot_prefix_table_ranks_the_hot_namespace(server):
+    c = _client(server)
+    try:
+        for i in range(400):
+            c.set(f"hot/ns/k{i % 8}", i)
+        for i in range(16):
+            c.set(f"cold/ns/k{i}", i)
+        hot = c.store_stats()["hot_prefixes"]
+        assert hot, "no hot prefixes collected"
+        assert hot[0]["prefix"] == "hot/ns"
+    finally:
+        c.close()
+
+
+def test_park_depth_visible_while_barrier_waits(server):
+    c = _client(server)
+    waiter = _client(server)
+    try:
+        import threading
+
+        t = threading.Thread(
+            target=lambda: waiter.barrier_join("b/iter", 0, 2, timeout=10.0),
+            daemon=True,
+        )
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            doc = c.store_stats()
+            if doc["parked"] >= 1:
+                break
+            time.sleep(0.02)
+        assert doc["parked"] >= 1, doc
+        assert doc["barriers_open"] == 1
+        # Release so teardown is clean.
+        c.barrier_join("b/iter", 1, 2, timeout=5.0)
+        t.join(5.0)
+    finally:
+        waiter.close()
+        c.close()
+
+
+def test_dedup_hit_rate_counts_replays(server):
+    c = _client(server)
+    try:
+        # Same req_id twice: the second application must be a dedup hit.
+        req = {"op": "add", "key": "ctr", "amount": 1, "req_id": "fixed:1"}
+        assert c._call(dict(req)) == 1
+        assert c._call(dict(req)) == 1  # replayed response, not re-applied
+        doc = c.store_stats()
+        assert doc["dedup"]["lookups"] >= 2
+        assert doc["dedup"]["hits"] >= 1
+        assert c.get("ctr", timeout=1.0) == 1
+    finally:
+        c.close()
+
+
+def test_store_stats_is_idempotent_classified():
+    assert "store_stats" in store_mod._IDEMPOTENT_OPS
+    assert "store_stats" not in store_mod._NONIDEMPOTENT_OPS
+
+
+# -- version skew -------------------------------------------------------------
+
+
+def test_new_client_old_server_fails_fast_without_retry_burn(server, monkeypatch):
+    """A pre-telemetry server answers ``store_stats`` with unknown-op: the
+    client must surface StoreError in ONE round trip — server-side error
+    responses are never transport-retried, so no retry budget burns."""
+    monkeypatch.setattr(KVServer, "_op_store_stats", None)
+    seen = []
+    events.add_sink(seen.append)
+    c = _client(server, retry_budget=8.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(StoreError, match="unknown op"):
+            c.store_stats()
+        assert time.monotonic() - t0 < 1.0, "unknown-op burned a retry ladder"
+        assert not [e for e in seen if e.kind == "store_retry"], (
+            "unknown-op reply consumed transport retries"
+        )
+    finally:
+        c.close()
+
+
+def test_old_client_new_server_unaffected(server):
+    """An old client simply never sends the op; every pre-existing op keeps
+    its contract against the new server (the whole existing suite is the
+    real assertion — this pins the cheap invariant)."""
+    c = _client(server)
+    try:
+        c.set("k", 1)
+        assert c.get("k", timeout=1.0) == 1
+        assert c.add("ctr", 2) == 2
+    finally:
+        c.close()
+
+
+# -- containment --------------------------------------------------------------
+
+
+def test_crashing_collector_degrades_doc_never_op_path(server):
+    c = _client(server)
+    try:
+        def boom(*a, **k):
+            raise RuntimeError("collector bug")
+
+        server._opstats.note_op = boom
+        # Ops keep working while the broken collector gets disabled.
+        for i in range(40):
+            c.set(f"k{i}", i)
+            assert c.get(f"k{i}", timeout=1.0) == i
+        doc = c.store_stats()
+        assert doc["enabled"] is False
+        assert "collector bug" in doc.get("error", "")
+        # Live server state still reported even with the collector dead.
+        assert doc["conns"] == 1 and doc["keys"] == 40
+        # And the server survives further traffic.
+        assert c.add("ctr", 1) == 1
+    finally:
+        c.close()
+
+
+def test_stats_disabled_server_serves_degraded_doc():
+    srv = KVServer(host="127.0.0.1", port=0, stats_enabled=False)
+    c = KVClient("127.0.0.1", srv.port)
+    try:
+        c.set("k", 1)
+        doc = c.store_stats()
+        assert doc["enabled"] is False
+        assert doc["keys"] == 1
+    finally:
+        c.close()
+        srv.close()
+
+
+# -- periodic events → metrics parity ----------------------------------------
+
+
+def test_periodic_store_stats_events_reach_metrics():
+    seen = []
+    events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0, stats_interval=0.05)
+    c = KVClient("127.0.0.1", srv.port)
+    try:
+        for i in range(100):
+            c.set(f"k{i % 4}", i)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if any(e.kind == "store_stats" for e in seen):
+                break
+            c.ping()
+            time.sleep(0.05)
+        evs = [e for e in seen if e.kind == "store_stats"]
+        assert evs, "no periodic store_stats event emitted"
+        p = evs[0].payload
+        assert p["ops"].get("set", 0) > 0
+        assert p["conns"] >= 1
+    finally:
+        c.close()
+        srv.close()
+    # Teardown emits the final deltas; the aggregated stream must show the
+    # full tpu_store_* family set (live/post-hoc parity).
+    prom = aggregate([e.to_record() for e in seen]).to_prometheus()
+    assert 'tpu_store_ops_total{op="set"}' in prom
+    assert "tpu_store_op_seconds" in prom
+    assert 'tpu_store_bytes_total{direction="in"}' in prom
+    assert 'tpu_store_bytes_total{direction="out"}' in prom
+    assert "tpu_store_conns" in prom
+
+
+def test_teardown_flushes_final_deltas():
+    """A short-lived store (shorter than stats_interval) still leaves its
+    totals in the stream: close() flushes the tail."""
+    seen = []
+    events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0, stats_interval=3600.0)
+    c = KVClient("127.0.0.1", srv.port)
+    for i in range(64):
+        c.set(f"k{i % 2}", i)
+    c.close()
+    srv.close()
+    evs = [e for e in seen if e.kind == "store_stats"]
+    assert evs, "teardown did not flush store_stats deltas"
+    assert sum(e.payload.get("ops", {}).get("set", 0) for e in evs) > 0
+
+
+# -- collector unit coverage --------------------------------------------------
+
+
+def test_latency_hist_quantiles_interpolate():
+    h = LatencyHist()
+    for _ in range(100):
+        h.observe(3e-6)
+    assert 2.5e-6 <= h.quantile(0.5) <= 5e-6
+    assert h.count == 100 and h.max == pytest.approx(3e-6)
+    doc = h.doc()
+    assert doc["count"] == 100 and doc["p50_us"] > 0
+
+
+def test_space_saving_guarantees_heavy_hitters():
+    s = SpaceSaving(k=4)
+    for i in range(1000):
+        s.add("hot")
+        s.add(f"cold{i}")  # churn far past capacity
+    items = s.items()
+    assert items[0]["prefix"] == "hot"
+    assert items[0]["count"] >= 1000  # may over-estimate, never under
+    assert len(s.counts) <= 4
+
+
+def test_key_prefix_depth():
+    assert key_prefix("a/b/c/d") == "a/b"
+    assert key_prefix("a/b") == "a/b"
+    assert key_prefix("flat") == "flat"
+
+
+def test_opstats_deltas_are_monotone_and_resettable():
+    st = OpStats()
+    st.note_op("set", 1e-6, 2e-6, 100, {"key": "a/b/c"}, False)
+    d1 = st.take_deltas()
+    assert d1["ops"]["set"] == OpStats.SAMPLE
+    assert d1["bytes_in"] == 100 * OpStats.SAMPLE
+    assert st.take_deltas() is None  # nothing moved
+    st.note_op("set", 1e-6, 2e-6, 50, None, True)
+    d2 = st.take_deltas()
+    assert d2["ops"]["set"] == OpStats.SAMPLE
+    assert d2["bytes_in"] == 50 * OpStats.SAMPLE

@@ -1,0 +1,154 @@
+"""Byte-flow ledger: purpose attribution by transfer tag, family
+reconciliation, residue accounting, publish→metrics parity, and the
+``tpu-metrics-dump --bytes`` CLI."""
+
+import io
+import json
+
+import pytest
+
+from tpu_resiliency.tools import metrics_dump
+from tpu_resiliency.utils.byteflow import (
+    ByteFlowLedger,
+    render_table,
+    tag_purpose,
+)
+from tpu_resiliency.utils.metrics import aggregate
+
+
+def _records():
+    return [
+        {"kind": "p2p_transfer", "direction": "send", "bytes": 1000,
+         "dst": 1, "tag": "repl/3"},
+        {"kind": "p2p_transfer", "direction": "recv", "bytes": 1000,
+         "src": 0, "tag": "repl/3"},
+        {"kind": "p2p_transfer", "direction": "recv", "bytes": 512,
+         "src": 2, "tag": "remir/0"},
+        {"kind": "p2p_transfer", "direction": "recv", "bytes": 500,
+         "src": 2, "tag": "retr/1"},
+        {"kind": "p2p_transfer", "direction": "recv", "bytes": 300,
+         "src": 3, "tag": "rread/0/1"},
+        {"kind": "p2p_transfer", "direction": "recv", "bytes": 200, "src": 2},
+        {"kind": "reshard_fetch", "via": "peer", "holder": 2, "bytes": 256},
+        {"kind": "reshard_fetch", "via": "local", "bytes": 700},
+        {"kind": "ckpt_write_file", "container": "main", "bytes": 4096},
+        {"kind": "store_stats", "bytes_in": 100, "bytes_out": 150,
+         "ops": {"set": 3}},
+    ]
+
+
+def test_tag_purposes():
+    assert tag_purpose("repl/3") == "replicate"
+    assert tag_purpose("remir/0") == "replicate"
+    assert tag_purpose("retr/1") == "retrieve"
+    assert tag_purpose("rread/0/7") == "reshard"
+    assert tag_purpose(None) == "unknown"
+    assert tag_purpose("mystery/1") == "unknown"
+
+
+def test_summary_attribution_and_residue():
+    led = ByteFlowLedger()
+    led.observe_many(_records())
+    s = led.summary()
+    assert s["schema"] == "tpu-byteflow-1"
+    assert s["by_purpose"]["replicate"] == 2512
+    assert s["by_purpose"]["retrieve"] == 500
+    assert s["by_purpose"]["reshard"] == 300 + 256 + 700
+    assert s["by_purpose"]["ckpt_write"] == 4096
+    assert s["by_purpose"]["store"] == 250
+    assert s["by_purpose"]["unknown"] == 200
+    assert s["residue_bytes"] == 200
+    assert s["total_bytes"] == sum(s["by_purpose"].values())
+    assert 0.0 < s["accounted_frac"] < 1.0
+    # p2p family: total includes the unknown-tag frame; others fully account.
+    fam = s["families"]["p2p"]
+    assert fam["total"] == 2512 + 500 + 300 + 200
+    assert fam["residue"] == 200
+    assert s["families"]["ckpt_write"]["residue"] == 0
+    # peer dimension survives into flows.
+    peers = {(f["purpose"], f["peer"]) for f in s["flows"]}
+    assert ("replicate", "r1") in peers and ("reshard", "r2") in peers
+
+
+def test_reconcile_matches_counter_families():
+    recs = _records()
+    led = ByteFlowLedger()
+    led.observe_many(recs)
+    recon = led.reconcile(aggregate(recs))
+    # Both sides consume the identical stream: zero drift everywhere.
+    for name, row in recon.items():
+        assert row["drift_bytes"] == 0, (name, row)
+    assert recon["p2p"]["counter_bytes"] == 2512 + 500 + 300 + 200
+    assert recon["store"]["counter_bytes"] == 250
+
+
+def test_publish_deltas_reach_metrics_and_never_double():
+    led = ByteFlowLedger()
+    led.observe_many(_records())
+    pub = []
+    rec = lambda source, kind, **p: pub.append({"kind": kind, **p})  # noqa: E731
+    led.publish(rec)
+    led.publish(rec)  # nothing new moved: no second event
+    assert len(pub) == 1
+    prom = aggregate(pub).to_prometheus()
+    assert 'tpu_byteflow_bytes_total{direction="recv",purpose="replicate"}' in prom
+    assert "tpu_byteflow_residue_bytes 200" in prom
+    assert "tpu_byteflow_accounted_ratio" in prom
+    # More traffic → one more event with only the delta.
+    led.observe({"kind": "ckpt_write_file", "container": "main", "bytes": 10})
+    led.publish(rec)
+    assert len(pub) == 2
+    assert pub[1]["flows"] == {"ckpt_write/write": 10}
+
+
+def test_own_narration_is_not_evidence():
+    led = ByteFlowLedger()
+    led.observe({"kind": "byteflow_update", "flows": {"replicate/send": 999}})
+    assert led.summary()["total_bytes"] == 0
+
+
+def test_render_table_mentions_everything(capsys):
+    led = ByteFlowLedger()
+    led.observe_many(_records())
+    out = io.StringIO()
+    render_table(led.summary(), out=out)
+    text = out.getvalue()
+    for want in ("byte flow:", "replicate", "reshard", "ckpt_write",
+                 "tpu_ckpt_replication_bytes_total", "residue"):
+        assert want in text, text
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _write_events(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    with open(path, "w") as f:
+        for rec in _records():
+            f.write(json.dumps({"ts": 1.0, "source": "t", "pid": 1, **rec}) + "\n")
+    return str(path)
+
+
+def test_metrics_dump_bytes_table(tmp_path, capsys):
+    path = _write_events(tmp_path)
+    assert metrics_dump.main([path, "--bytes"]) == 0
+    out = capsys.readouterr().out
+    assert "byte flow:" in out and "replicate" in out
+    assert "counter drift 0 B" in out
+
+
+def test_metrics_dump_bytes_json(tmp_path, capsys):
+    path = _write_events(tmp_path)
+    assert metrics_dump.main([path, "--bytes", "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema"] == "tpu-byteflow-1"
+    assert doc["residue_bytes"] == 200
+    assert doc["reconcile"]["p2p"]["drift_bytes"] == 0
+
+
+def test_metrics_dump_bytes_conflicts(tmp_path, capsys):
+    path = _write_events(tmp_path)
+    assert metrics_dump.main([path, "--bytes", "--goodput"]) == 2
+    assert metrics_dump.main(
+        [path, "--bytes", "--goodput", "--baseline", path]
+    ) == 2

@@ -101,3 +101,47 @@ def test_cli_main_against_live_and_dead_endpoints(capsys):
         raise AssertionError("argparse must reject a portless endpoint")
     except SystemExit as e:
         assert e.code == 2
+
+
+def test_stats_flag_renders_live_op_table(capsys):
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        c = KVClient("127.0.0.1", server.port)
+        for i in range(120):
+            c.set(f"hb/r{i % 4}", i)
+            c.get("hb/r0", timeout=1.0)
+        c.close()
+        assert store_info.main([f"127.0.0.1:{server.port}", "--stats"]) == 0
+        text = capsys.readouterr().out
+        assert "store stats" in text
+        assert "set" in text and "get" in text
+        assert "hot key prefixes" in text and "hb/r0" in text
+        assert "dedup:" in text
+    finally:
+        server.close()
+
+
+def test_stats_flag_exit_codes(capsys):
+    # Disabled stats: message + exit 1.
+    server = KVServer(host="127.0.0.1", port=0, stats_enabled=False)
+    try:
+        assert store_info.main([f"127.0.0.1:{server.port}", "--stats"]) == 1
+        assert "disabled" in capsys.readouterr().out
+    finally:
+        server.close()
+    # Unreachable store: exit 1 (the existing fail-fast path).
+    assert store_info.main([f"127.0.0.1:{server.port}", "--stats"]) == 1
+
+
+def test_stats_flag_against_pre_telemetry_server(capsys, monkeypatch):
+    """Version skew: an old server answers unknown-op; the CLI reports and
+    exits 1 in one round trip (no retry ladder)."""
+    monkeypatch.setattr(KVServer, "_op_store_stats", None)
+    server = KVServer(host="127.0.0.1", port=0)
+    try:
+        t0 = time.monotonic()
+        assert store_info.main([f"127.0.0.1:{server.port}", "--stats"]) == 1
+        assert time.monotonic() - t0 < 2.0
+        assert "pre-telemetry" in capsys.readouterr().err
+    finally:
+        server.close()

@@ -158,3 +158,41 @@ def test_cli_fails_visibly(tmp_path, capsys):
     empty = tmp_path / "empty.jsonl"
     empty.write_text("")
     assert trace_export.main([str(empty)]) == 1
+
+
+def test_slices_carry_self_time_and_critical_highlight(tmp_path):
+    recs = [
+        {"ts": 1.0, "source": "l", "kind": "span_begin", "pid": 5,
+         "span_id": "par", "span": "launcher.round"},
+        {"ts": 1.2, "source": "r", "kind": "span_begin", "pid": 5,
+         "span_id": "kid", "span": "rendezvous.round", "parent_id": "par"},
+        {"ts": 1.5, "source": "r", "kind": "span_end", "pid": 5,
+         "span_id": "kid", "span": "rendezvous.round", "duration_s": 0.3},
+        {"ts": 2.0, "source": "l", "kind": "span_end", "pid": 5,
+         "span_id": "par", "span": "launcher.round", "duration_s": 1.0},
+    ]
+    trace = trace_export.to_chrome_trace(recs, critical_ids={"kid"})
+    slices = {e["args"]["span_id"]: e for e in trace["traceEvents"]
+              if e["ph"] == "X"}
+    # Parent self-time excludes the child's 300 ms window.
+    assert slices["par"]["args"]["self_time_ms"] == pytest.approx(700.0)
+    assert slices["kid"]["args"]["self_time_ms"] == pytest.approx(300.0)
+    # The critical-path span is highlighted; the parent is not.
+    assert slices["kid"]["args"].get("critical_path") is True
+    assert slices["kid"].get("cname")
+    assert "critical_path" not in slices["par"]["args"]
+    assert slices["par"].get("cname") is None
+
+
+def test_unfinished_accounting_survives_highlighting():
+    recs = [
+        {"ts": 1.0, "source": "w", "kind": "span_begin", "pid": 5,
+         "span_id": "open", "span": "worker.spawn"},
+        {"ts": 2.0, "source": "w", "kind": "iteration_start", "pid": 5,
+         "iteration": 0},
+    ]
+    trace = trace_export.to_chrome_trace(recs, critical_ids={"open"})
+    sl = next(e for e in trace["traceEvents"] if e["ph"] == "X")
+    assert sl["args"]["unfinished"] is True
+    assert sl["cname"] == "terrible"  # unfinished red wins over the highlight
+    assert sl["args"]["critical_path"] is True

@@ -365,7 +365,8 @@ class PeerExchange:
                 src, tag, payload = msg["src"], msg["tag"], msg["blob"]
             nbytes = memoryview(payload).cast("B").nbytes if payload is not None else 0
             _transfer_event(
-                "recv", nbytes, time.perf_counter() - t0, src=src, frame=kind
+                "recv", nbytes, time.perf_counter() - t0, src=src, frame=kind,
+                tag=tag,
             )
             with self._cond:
                 self._inbox.setdefault((src, tag), []).append(payload)
@@ -520,7 +521,8 @@ class PeerExchange:
                 framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
                 nbytes = len(blob)
                 frame = "obj"
-        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
+        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst,
+                        frame=frame, tag=tag)
         return nbytes
 
     def open_send_stream(self, dst: int, tag: str, nbytes: int) -> "StreamSend":
@@ -581,7 +583,8 @@ class PeerExchange:
                 framing.send_obj(conn, {"src": self.rank, "tag": tag, "blob": blob})
                 nbytes = len(blob)
                 frame = "obj"
-        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst, frame=frame)
+        _transfer_event("send", nbytes, time.perf_counter() - t0, dst=dst,
+                        frame=frame, tag=tag)
         return nbytes
 
     def recv(self, src: int, tag: str, timeout: Optional[float] = None):
@@ -858,6 +861,7 @@ class StreamSend:
         _transfer_event(
             "send", self.nbytes, time.perf_counter() - self._t0,
             dst=self.dst, frame="bulk" if self._use_bulk else "obj",
+            tag=self.tag,
         )
 
     def abort(self) -> None:

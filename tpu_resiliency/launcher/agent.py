@@ -210,6 +210,13 @@ class ElasticAgent:
         def fetch_snapshots() -> list:
             return [v for v in store.prefix_get("").values() if isinstance(v, dict)]
 
+        def store_stats() -> dict:
+            # The /storez source: the store's own self-telemetry op, over the
+            # same dedicated client the snapshot pull uses. A pre-telemetry
+            # store's unknown-op error (or a dead store) degrades the /storez
+            # document inside TelemetryServer — never the endpoint.
+            return store.client.store_stats()
+
         self.telemetry = TelemetryServer(
             port=self.cfg.telemetry_port or 0,
             port_file=os.path.join(self.cfg.run_dir, PORT_FILE_NAME),
@@ -220,6 +227,7 @@ class ElasticAgent:
             autoscale_fn=(
                 self.autoscale.status if self.autoscale is not None else None
             ),
+            store_stats_fn=store_stats,
             fleet_dir=self.cfg.fleet_dir or None,
             job=self.cfg.job_id,
             node_id=self.cfg.node_id,

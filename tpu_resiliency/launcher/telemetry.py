@@ -40,6 +40,12 @@ dir), serving three endpoints:
   (``schema: tpu-job-snapshot-1``): metrics snapshot + goodput + health +
   hangz + incidents in ONE round trip, so a fleet scrape costs one GET per
   job (``tools/fleetd.py``).
+- ``GET /storez`` — the coordination store's live self-telemetry document
+  (``schema: tpu-storez-1``, wrapping the ``store_stats`` wire op's
+  ``tpu-store-stats-1`` body): per-op latency with queue-wait/handle split,
+  bytes in/out, connection counts, dedup hit rate, barrier park depth, hot
+  key prefixes. Folded into ``/snapshot`` so fleetd gets it for free; a
+  crashing collector degrades the document, never the endpoint.
 
 ``/healthz`` results are TTL-cached (``health_ttl``, default 1 s) behind a
 lock, so a scrape storm from fleet pollers costs one ``health_fn``
@@ -101,6 +107,7 @@ class TelemetryServer:
         health_fn: Optional[Callable[[], dict]] = None,
         census_fn: Optional[Callable[[], dict]] = None,
         autoscale_fn: Optional[Callable[[], dict]] = None,
+        store_stats_fn: Optional[Callable[[], dict]] = None,
         health_ttl: float = 1.0,
         fleet_dir: Optional[str] = None,
         job: str = "default",
@@ -111,6 +118,12 @@ class TelemetryServer:
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.ledger = GoodputLedger()
+        # The byte-flow ledger rides the same incremental tail as the goodput
+        # ledger, so the live tpu_byteflow_* view and a post-hoc
+        # `tpu-metrics-dump --bytes` of the same stream agree.
+        from tpu_resiliency.utils.byteflow import ByteFlowLedger
+
+        self.byteflow = ByteFlowLedger()
         self._host = host
         self._want_port = port
         self.port_file = port_file
@@ -119,6 +132,7 @@ class TelemetryServer:
         self.health_fn = health_fn
         self.census_fn = census_fn
         self.autoscale_fn = autoscale_fn
+        self.store_stats_fn = store_stats_fn
         #: fleet discovery (``fleet/registry.py``): directory the job's lease
         #: lives in; None keeps the server single-job (no registration).
         self.fleet_dir = fleet_dir
@@ -203,7 +217,8 @@ class TelemetryServer:
         if self.fleet_dir:
             self._register_lease(port)
         log.info(f"telemetry endpoint on http://{self._host}:{port} "
-                 f"(/metrics /goodput /healthz /hangz /autoscale /snapshot)")
+                 f"(/metrics /goodput /healthz /hangz /autoscale /snapshot "
+                 f"/storez)")
         return port
 
     def stop(self) -> None:
@@ -325,6 +340,10 @@ class TelemetryServer:
                     doc = {"schema": "tpu-hangz-1", "error": repr(e)}
             doc.setdefault("schema", "tpu-hangz-1")
             self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/storez":
+            self._respond(
+                req, 200, _json_body(self._storez_doc()), "application/json"
+            )
         else:
             self._respond(
                 req, 404,
@@ -332,9 +351,27 @@ class TelemetryServer:
                             "endpoints": ["/metrics", "/metrics.json",
                                           "/goodput", "/healthz", "/hangz",
                                           "/autoscale", "/incidents",
-                                          "/snapshot"]}),
+                                          "/snapshot", "/storez"]}),
                 "application/json",
             )
+
+    def _storez_doc(self) -> dict:
+        """The /storez body (schema ``tpu-storez-1``): the coordination
+        store's ``store_stats`` document wrapped with the job identity. A
+        crashing collector — or a store that predates the op — degrades the
+        document to an ``error`` field, never the endpoint (the /hangz
+        contract: the forensics plane must answer during the incidents it
+        exists for)."""
+        doc: dict = {"schema": "tpu-storez-1", "job": self.job}
+        if self.store_stats_fn is None:
+            doc["error"] = "no store stats source wired"
+            return doc
+        try:
+            doc.update(dict(self.store_stats_fn()))
+        except Exception as e:
+            doc["error"] = repr(e)
+        doc["schema"] = "tpu-storez-1"
+        return doc
 
     def _health_doc(self) -> dict:
         """The /healthz body, TTL-cached. Computation happens INSIDE the lock
@@ -436,6 +473,8 @@ class TelemetryServer:
             except Exception as e:
                 doc["autoscale"] = {"error": repr(e)}
             doc["autoscale"].setdefault("schema", "tpu-autoscale-1")
+        if self.store_stats_fn is not None:
+            doc["storez"] = self._storez_doc()
         return doc
 
     def _snapshot_body(self) -> bytes:
@@ -474,6 +513,8 @@ class TelemetryServer:
         with self._refresh_lock:
             for rec in self._read_new_events():
                 self.ledger.observe(rec)
+                self.byteflow.observe(rec)
+            self.byteflow.publish()
             return self.ledger.publish()
 
     def _read_new_events(self) -> list[dict]:
@@ -525,10 +566,11 @@ class TelemetryServer:
         return merged
 
     def observe(self, rec: dict) -> None:
-        """Feed one flat record straight into local registry + ledger (tests
+        """Feed one flat record straight into local registry + ledgers (tests
         and embedders without an events file)."""
         observe_record(rec, self.registry)
         self.ledger.observe(rec)
+        self.byteflow.observe(rec)
 
 
 def _json_body(doc: dict) -> bytes:

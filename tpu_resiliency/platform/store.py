@@ -77,7 +77,7 @@ _IDEMPOTENT_OPS = frozenset({
     "ping", "get", "getv", "check", "set", "delete", "touch", "stale",
     "prefix_get", "prefix_clear", "num_keys", "keys", "barriers",
     "wait_changed", "list_get", "list_clear", "set_get", "set_add",
-    "barrier_status", "barrier_del", "barrier_census",
+    "barrier_status", "barrier_del", "barrier_census", "store_stats",
 })
 
 #: Ops where a blind retry double-applies (increment, append, CAS, barrier
@@ -180,7 +180,8 @@ class _Conn:
     write buffer, auth state, and at most one parked request (the client protocol
     is strictly request/response per socket)."""
 
-    __slots__ = ("sock", "rbuf", "wbuf", "awaiting_mac", "nonce", "park", "auth_deadline")
+    __slots__ = ("sock", "rbuf", "wbuf", "awaiting_mac", "nonce", "park",
+                 "auth_deadline", "recv_ts", "frame_bytes")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
@@ -190,6 +191,10 @@ class _Conn:
         self.nonce: bytes = b""
         self.park: Optional[_Park] = None
         self.auth_deadline: float = 0.0
+        #: op-telemetry stamps: when the request's bytes landed on the socket
+        #: (queue wait = dispatch - recv_ts) and the parsed frame's wire size
+        self.recv_ts: float = 0.0
+        self.frame_bytes: int = 0
 
 
 class KVServer:
@@ -219,6 +224,8 @@ class KVServer:
         port: int = 0,
         auth_key: str | None = None,
         auth_timeout: float = 30.0,
+        stats_enabled: bool = True,
+        stats_interval: float = 10.0,
     ):
         if auth_key is None:
             auth_key = os.environ.get(AUTH_KEY_ENV) or None
@@ -255,6 +262,27 @@ class KVServer:
         #: response or finds nothing applied at all.
         self._dedup: collections.OrderedDict[str, tuple] = collections.OrderedDict()
         self._shutdown = threading.Event()
+        #: op telemetry (utils/opstats.py): loop-thread-owned, lock-free. A
+        #: collector exception disables stats for the server's lifetime and
+        #: degrades the store_stats document — never the op path.
+        self._opstats = None
+        self._stats_error: Optional[str] = None
+        self.stats_interval = stats_interval
+        self._last_stats_emit = time.monotonic()
+        #: countdown to the next sampled (clocked) op; starts at 1 so the
+        #: very first op is sampled and a short-lived store gets quantiles.
+        #: The reload is jittered (LCG) — a fixed stride aliases with
+        #: periodic workloads (a strict set/get alternation would put EVERY
+        #: sample on the same op and double-count it).
+        self._stats_tick = 1
+        self._stats_seed = 0x5EED
+        #: set by a sampled op so _send attributes exactly that op's
+        #: response bytes (scaled); False costs one short-circuit per send
+        self._stats_armed = False
+        if stats_enabled:
+            from tpu_resiliency.utils.opstats import OpStats
+
+            self._opstats = OpStats()
 
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -346,6 +374,14 @@ class KVServer:
                             if events & selectors.EVENT_READ:
                                 self._read(conn)
                     self._expire_parked()
+                    # `now` is the loop-top stamp — stale by at most one
+                    # select, irrelevant at a multi-second emit interval and
+                    # one fewer clock read per wakeup.
+                    if (
+                        self._opstats is not None
+                        and now - self._last_stats_emit >= self.stats_interval
+                    ):
+                        self._emit_stats()
                 except Exception:
                     # A coordinator must not die on one bad connection; per-conn
                     # errors are handled inline, so this is a genuine bug — log it
@@ -354,7 +390,39 @@ class KVServer:
         finally:
             self._teardown()
 
+    # -- op telemetry ------------------------------------------------------
+
+    def _stats_disable(self, e: Exception) -> None:
+        """First collector exception wins: stop paying for a broken collector
+        and surface the failure through the stats document, never the op."""
+        self._stats_error = repr(e)
+        self._opstats = None
+        log.warning(f"store: op-stats collector failed; stats disabled: {e!r}")
+
+    def _emit_stats(self) -> None:
+        """One ``store_stats`` event with counter deltas (loop thread) — the
+        live/post-hoc parity path: replaying the stream reconstructs the same
+        ``tpu_store_*`` totals the live registry holds. Called when the loop's
+        interval check fires, and once at teardown so even a short-lived
+        store leaves its totals behind."""
+        st = self._opstats
+        if st is None:
+            return
+        self._last_stats_emit = time.monotonic()
+        try:
+            deltas = st.take_deltas()
+        except Exception as e:
+            self._stats_disable(e)
+            return
+        if deltas is None:
+            return
+        record_event(
+            "store", "store_stats",
+            conns=len(self._conns), parked=len(self._parked), **deltas,
+        )
+
     def _teardown(self) -> None:
+        self._emit_stats()
         shutdown_resp = {"status": "error", "error": repr(RuntimeError("store shut down"))}
         for conn in list(self._conns.values()):
             if conn.park is not None:
@@ -428,6 +496,11 @@ class KVServer:
             conn = _Conn(sock)
             self._conns[sock] = conn
             self._sel.register(sock, selectors.EVENT_READ, conn)
+            if self._opstats is not None:
+                try:
+                    self._opstats.note_conn(len(self._conns))
+                except Exception as e:
+                    self._stats_disable(e)
             # Connection hello; challenge/response when auth is on. A peer that
             # never completes the challenge is dropped at the deadline (the
             # threaded server's 30 s handshake timeout).
@@ -466,7 +539,14 @@ class KVServer:
     _MAX_WBUF = 4 * framing.DEFAULT_MAX_FRAME
 
     def _send(self, conn: _Conn, obj: Any) -> None:
-        conn.wbuf += framing.encode_obj(obj)
+        frame = framing.encode_obj(obj)
+        if self._stats_armed:
+            # Sampled-scaled outbound byte tally: exactly the sampled op's
+            # response, ×SAMPLE — same estimate semantics as the op tallies.
+            self._stats_armed = False
+            if self._opstats is not None:
+                self._opstats.bytes_out += len(frame) * self._opstats.SAMPLE
+        conn.wbuf += frame
         if len(conn.wbuf) > self._MAX_WBUF:
             log.warning("store: dropping connection with %d B of undrained responses",
                         len(conn.wbuf))
@@ -501,6 +581,12 @@ class KVServer:
         if not chunk:
             self._drop(conn)  # peer gone; any parked request dies with it
             return
+        if self._opstats is not None and self._stats_tick <= 1:
+            # Queue-wait anchor, read only by the next (sampled) op. A frame
+            # that crosses the sample boundary mid-chunk finds recv_ts == 0
+            # and skips its wait observation — under-sampling, never a stale
+            # stamp.
+            conn.recv_ts = time.perf_counter()
         conn.rbuf += chunk
         if len(conn.rbuf) > self._MAX_RBUF:
             log.warning("store: dropping connection with %d B of unparsed input",
@@ -524,6 +610,7 @@ class KVServer:
                 return
             obj, consumed = decoded
             del conn.rbuf[:consumed]
+            conn.frame_bytes = consumed
             if conn.awaiting_mac:
                 mac = obj.get("mac", b"") if isinstance(obj, dict) else b""
                 ok = isinstance(mac, (bytes, bytearray)) and hmac.compare_digest(
@@ -539,6 +626,28 @@ class KVServer:
             self._handle_request(conn, obj)
 
     def _handle_request(self, conn: _Conn, req: Any) -> None:
+        # Op telemetry, fully sampled: 1 op in OpStats.SAMPLE pays the whole
+        # accounting (op/error/byte tallies scaled by SAMPLE, queue wait =
+        # socket readable → here, handle = the dispatch itself — a park is a
+        # wait, not work, and parks aren't re-counted on wake); the other
+        # SAMPLE-1 ops pay ONE counter decrement. Exact per-op counting was
+        # measured at 2-4 µs/op of py3.10 attribute traffic — a >5% tax on a
+        # ~35 µs loopback op, which is why every figure in the doc is a
+        # sampled estimate and the knob stays ON by default. Contained: a
+        # collector bug disables stats, the response still goes out.
+        sampled = False
+        t0 = 0.0
+        if self._opstats is not None:
+            self._stats_tick -= 1
+            if self._stats_tick <= 0:
+                # Jittered reload, mean SAMPLE (6..10): breaks phase lock
+                # with periodic op mixes.
+                seed = (self._stats_seed * 1103515245 + 12345) & 0x7FFFFFFF
+                self._stats_seed = seed
+                self._stats_tick = self._opstats.SAMPLE - 2 + seed % 5
+                self._stats_armed = True
+                sampled = True
+                t0 = time.perf_counter()
         try:
             resp = self._dispatch(req)
         except BarrierOverflow as e:
@@ -547,6 +656,21 @@ class KVServer:
             resp = {"status": "timeout"}
         except Exception as e:  # surface server-side faults to the client
             resp = {"status": "error", "error": repr(e)}
+        if sampled and self._opstats is not None:
+            try:
+                is_dict = type(req) is dict
+                self._opstats.note_op(
+                    req.get("op", "?") if is_dict else "?",
+                    (t0 - conn.recv_ts) if conn.recv_ts else -1.0,
+                    time.perf_counter() - t0,
+                    conn.frame_bytes,
+                    req if is_dict else None,
+                    type(resp) is dict
+                    and resp.get("status") not in ("ok", None),
+                )
+                conn.recv_ts = 0.0  # consumed: never reused as a stale anchor
+            except Exception as e:
+                self._stats_disable(e)
         if isinstance(resp, _Park):
             ready = resp.ready()
             if ready is not None:
@@ -606,6 +730,11 @@ class KVServer:
         req_id = req.get("req_id")
         if req_id is not None:
             hit = self._dedup.get(req_id)
+            st = self._opstats
+            if st is not None:  # inline attribute adds: this is a hot path
+                st.dedup_lookups += 1
+                if hit is not None and hit[0] == "resp":
+                    st.dedup_hits += 1
             if hit is not None and hit[0] == "resp":
                 # Retry of a request that fully applied; replay the recorded
                 # response instead of re-applying the mutation.
@@ -997,6 +1126,44 @@ class KVServer:
         self._stale_cache.clear()
         return self._ok(removed)
 
+    def _op_store_stats(self, req: dict) -> dict:
+        """The server's self-telemetry document (schema ``tpu-store-stats-1``):
+        per-op latency (queue wait vs handle split), bytes in/out, connection
+        counts, dedup-LRU hit rate, barrier park depth, hot key prefixes.
+
+        Idempotent and read-only. A broken/disabled collector degrades the
+        document (``enabled: false`` + ``error``), never the op — this is the
+        instrument the perf work is judged with, so it must answer even when
+        it has nothing to say."""
+        base = {
+            "conns": len(self._conns),
+            "parked": len(self._parked),
+            "barriers_open": sum(
+                1 for b in self._barriers.values() if b.world_size
+            ),
+            "keys": len(self._data),
+            "dedup_entries": len(self._dedup),
+        }
+        if self._opstats is None:
+            from tpu_resiliency.utils.opstats import SCHEMA as STATS_SCHEMA
+
+            doc = {"schema": STATS_SCHEMA, "enabled": False, **base}
+            if self._stats_error:
+                doc["error"] = self._stats_error
+            return self._ok(doc)
+        try:
+            doc = self._opstats.snapshot()
+        except Exception as e:
+            self._stats_disable(e)
+            from tpu_resiliency.utils.opstats import SCHEMA as STATS_SCHEMA
+
+            return self._ok({
+                "schema": STATS_SCHEMA, "enabled": False,
+                "error": self._stats_error, **base,
+            })
+        doc.update(base)
+        return self._ok(doc)
+
 
 class KVClient:
     """Client for :class:`KVServer`: one persistent connection for fast ops, one-shot
@@ -1312,6 +1479,14 @@ class KVClient:
 
     def barrier_del(self, name: str) -> bool:
         return self._call({"op": "barrier_del", "name": name})
+
+    def store_stats(self) -> dict:
+        """The server's self-telemetry document (``tpu-store-stats-1``;
+        ``platform/store.py:_op_store_stats``). Raises :class:`StoreError`
+        against a pre-stats server — server-side *error responses* are never
+        retried, so the unknown-op reply costs one round trip, not a retry
+        budget (version-skew containment, tested both directions)."""
+        return self._call({"op": "store_stats"})
 
 
 class StoreView:

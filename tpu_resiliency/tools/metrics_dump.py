@@ -20,6 +20,13 @@ restart / incident / unattributed, the goodput ratio, and per-rank rows — the
 offline twin of the launcher's live ``/goodput`` endpoint, computed from the
 same stream by the same ledger.
 
+``--bytes`` renders the byte-flow ledger (``utils/byteflow.py``) instead:
+every byte moved attributed to (purpose, direction, peer) — replicate /
+retrieve / reshard / store / ckpt_write — reconciled against the per-family
+byte counters with the unaccounted residue called out. This is the gate
+instrument for the replication byte-economy work ("5-10× fewer bytes" must
+show up HERE, not in a hand-picked counter).
+
 ``--job`` slices fleet-scope inputs back to one job post-hoc: on an events
 JSONL it keeps only records stamped with that job identity
 ($TPU_RESILIENCY_JOB, set by launchers under ``--fleet-dir``); the input may
@@ -245,6 +252,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "gates on",
     )
     ap.add_argument(
+        "--bytes", action="store_true", dest="bytes_flow",
+        help="render the byte-flow ledger (bytes attributed to purpose/"
+        "direction/peer, reconciled against the per-family byte counters "
+        "with the residue called out) instead of the metrics report; "
+        "--format json emits the tpu-byteflow-1 document",
+    )
+    ap.add_argument(
         "--job", default=None,
         help="slice a fleet-scope input back to one job: on an events JSONL, "
         "keep only records stamped with this job identity (launcher "
@@ -255,6 +269,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     if args.baseline and not args.goodput:
         print("--baseline requires --goodput", file=sys.stderr)
         return 2
+    if args.bytes_flow and (args.goodput or args.baseline):
+        print("--bytes and --goodput are mutually exclusive", file=sys.stderr)
+        return 2
     try:
         with open(args.events_file):
             pass
@@ -263,9 +280,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 1
     snapshot_doc = load_snapshot_doc(args.events_file) if args.job else None
     if snapshot_doc is not None:
-        if args.goodput:
+        if args.goodput or args.bytes_flow:
             print(
-                "--goodput needs an events stream, not a metrics snapshot",
+                "--goodput/--bytes need an events stream, not a metrics "
+                "snapshot",
                 file=sys.stderr,
             )
             return 2
@@ -285,6 +303,35 @@ def main(argv: Optional[list[str]] = None) -> int:
     if not records:
         print("no events to aggregate", file=sys.stderr)
         return 1
+    if args.bytes_flow:
+        from tpu_resiliency.utils.byteflow import ByteFlowLedger, render_table
+
+        ledger = ByteFlowLedger()
+        ledger.observe_many(records)
+        summary = ledger.summary()
+        # Belt and suspenders: the same stream through the independent
+        # counter mapping — any drift names an emitter one side misreads.
+        recon = ledger.reconcile(aggregate(records))
+
+        def emit_bytes() -> None:
+            if args.format == "json":
+                json.dump({**summary, "reconcile": recon}, sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                render_table(summary, reconcile=recon)
+
+        if args.output:
+            with open(args.output, "w") as f:
+                old, sys.stdout = sys.stdout, f
+                try:
+                    emit_bytes()
+                finally:
+                    sys.stdout = old
+            print(f"wrote {args.output}")
+            return 0
+        if pipe_safe(emit_bytes):
+            return SIGPIPE_EXIT
+        return 0
     if args.goodput:
         from tpu_resiliency.utils.goodput import (
             GoodputLedger,

@@ -20,6 +20,8 @@ Usage::
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stale hb/ --max-age 10
     # live blocked-collective census: arrived/missing/absent + waiter ages
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --barriers
+    # live op telemetry: per-op latency, hot prefixes, park depth, dedup rate
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stats
 """
 
 from __future__ import annotations
@@ -115,6 +117,82 @@ def report_barriers(client: KVClient, prefix: str, out=None) -> None:
             print(f"    absent (proxied dead): {b['absent']}", file=out)
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024.0 or unit == "GB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+    return f"{n:.1f} GB"
+
+
+def report_stats(client: KVClient, out=None) -> int:
+    """Render the live ``store_stats`` document (``tpu-store-stats-1``): the
+    per-op latency table (queue wait vs handle split), hot key prefixes,
+    connection/park/dedup state. Returns an exit code: 1 when the server
+    predates the op (version skew — the error is one round trip, never a
+    retry budget) or runs with stats disabled."""
+    out = sys.stdout if out is None else out
+    try:
+        doc = client.store_stats()
+    except StoreError as e:
+        print(f"store does not answer store_stats (pre-telemetry server?): {e}",
+              file=sys.stderr)
+        return 1
+    if not doc.get("enabled", False):
+        detail = doc.get("error", "stats_enabled=False")
+        print(f"store stats disabled: {detail}", file=out)
+        print(
+            f"conns: {doc.get('conns', '?')} live   "
+            f"parked: {doc.get('parked', '?')}   "
+            f"keys: {doc.get('keys', '?')}",
+            file=out,
+        )
+        return 1
+    b = doc.get("bytes") or {}
+    dd = doc.get("dedup") or {}
+    print(
+        f"store stats (up {doc.get('uptime_s', 0):.0f}s): "
+        f"conns {doc.get('conns', 0)} live / {doc.get('conns_peak', 0)} peak "
+        f"/ {doc.get('conns_total', 0)} total   parked {doc.get('parked', 0)}   "
+        f"open barriers {doc.get('barriers_open', 0)}   keys {doc.get('keys', 0)}",
+        file=out,
+    )
+    print(
+        f"bytes: in {_fmt_bytes(b.get('in', 0))}, out {_fmt_bytes(b.get('out', 0))}"
+        f"   dedup: {dd.get('hits', 0)}/{dd.get('lookups', 0)} hits "
+        f"({100.0 * dd.get('hit_rate', 0.0):.1f}%)",
+        file=out,
+    )
+    ops = doc.get("ops") or {}
+    if ops:
+        print("ops (handle = dispatch time; wait = socket -> dispatch):", file=out)
+        print(
+            f"    {'op':<16} {'count':>9} {'err':>5} {'p50':>9} {'p95':>9} "
+            f"{'max':>9} {'wait p95':>9} {'bytes in':>10}",
+            file=out,
+        )
+        ranked = sorted(ops.items(), key=lambda kv: -kv[1].get("count", 0))
+        for op, row in ranked:
+            h = row.get("handle") or {}
+            w = row.get("wait") or {}
+            print(
+                f"    {op:<16} {row.get('count', 0):>9} "
+                f"{row.get('errors', 0):>5} "
+                f"{h.get('p50_us', 0):>7.1f}us {h.get('p95_us', 0):>7.1f}us "
+                f"{h.get('max_us', 0):>7.1f}us {w.get('p95_us', 0):>7.1f}us "
+                f"{_fmt_bytes(row.get('bytes_in', 0)):>10}",
+                file=out,
+            )
+    hot = doc.get("hot_prefixes") or []
+    if hot:
+        print("hot key prefixes (space-saving top-K; count may over-estimate "
+              "by err):", file=out)
+        for row in hot[:10]:
+            err = f" (±{row['err']})" if row.get("err") else ""
+            print(f"    {row['prefix']:<40} ~{row['count']}{err}", file=out)
+    return 0
+
+
 def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Introspect a live tpu-resiliency coordination store"
@@ -130,6 +208,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "--barriers", action="store_true",
         help="render only the live barrier census: per wait key, who arrived "
         "(with waiter ages), who is missing, who was proxied absent",
+    )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="render only the live op-telemetry document (store_stats op): "
+        "per-op latency with queue-wait/handle split, bytes in/out, dedup "
+        "hit rate, park depth, hot key prefixes; exit 1 when the store is "
+        "unreachable, predates the op, or runs with stats disabled",
     )
     args = ap.parse_args(argv)
     host, _, port_s = args.endpoint.partition(":")
@@ -150,12 +235,21 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(str(e), file=sys.stderr)
         return 1
     try:
-        body = (
-            (lambda: report_barriers(client, args.prefix)) if args.barriers
-            else (lambda: report(client, args.prefix, args.stale, args.max_age))
-        )
+        rc = 0
+        if args.stats:
+            def body() -> None:
+                nonlocal rc
+                rc = report_stats(client)
+        elif args.barriers:
+            body = lambda: report_barriers(client, args.prefix)  # noqa: E731
+        else:
+            body = lambda: report(  # noqa: E731
+                client, args.prefix, args.stale, args.max_age
+            )
         if pipe_safe(body):
             return SIGPIPE_EXIT
+        if rc:
+            return rc
     except (OSError, StoreError) as e:
         print(f"store at {args.endpoint} failed mid-report: {e}", file=sys.stderr)
         return 1

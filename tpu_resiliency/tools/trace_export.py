@@ -12,12 +12,17 @@ event as an instant marker on the row it belongs to.
 Mapping:
 
 - matched ``span_begin``/``span_end`` (same envelope ``span_id``) → one
-  complete ``"X"`` slice with the begin payload + duration as args;
+  complete ``"X"`` slice with the begin payload + duration as args, plus a
+  computed ``self_time_ms`` (duration minus the union of child-span overlap:
+  the part only that span's own code explains);
 - unmatched ``span_begin`` (process died mid-span — exactly the interesting
   case) → an ``"X"`` slice running to the last event's timestamp, flagged
   ``unfinished``;
 - every other record → an instant ``"i"`` marker;
-- per-pid ``"M"`` metadata rows naming each process by its dominant source.
+- per-pid ``"M"`` metadata rows naming each process by its dominant source;
+- spans named in ``critical_ids`` (``tpu-critpath``'s dominant chain) get a
+  distinct ``cname`` + ``critical_path: true`` arg, so Perfetto shows the
+  chain that gated the episode without manual inspection.
 
 Usage::
 
@@ -48,8 +53,46 @@ def _tid(rec: dict) -> int:
     return rank if isinstance(rank, int) else 0
 
 
-def to_chrome_trace(records: list[dict]) -> dict:
-    """Convert parsed event records to a Chrome trace-event document."""
+def _self_times(spans: list[dict]) -> dict[tuple, float]:
+    """``(pid, span_id) -> self seconds``: each span's duration minus the
+    union of its children's overlap (children = spans whose begin carried
+    this span's id as ``parent_id`` — cross-process children count, the
+    parenting is env-propagated). The number an optimizer actually needs:
+    where must a fix land to move this span."""
+    from tpu_resiliency.utils.goodput import (
+        merge_intervals,
+        subtract_intervals,
+        total_seconds,
+    )
+
+    by_parent: dict[str, list[tuple[float, float]]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent:
+            by_parent.setdefault(parent, []).append((s["t0"], s["t1"]))
+    out: dict[tuple, float] = {}
+    for s in spans:
+        children = [
+            (max(c0, s["t0"]), min(c1, s["t1"]))
+            for c0, c1 in by_parent.get(s.get("span_id") or "", [])
+            if c1 > s["t0"] and c0 < s["t1"]
+        ]
+        if children:
+            own = subtract_intervals(
+                merge_intervals([(s["t0"], s["t1"])]), merge_intervals(children)
+            )
+            out[(s["pid"], s["span_id"])] = total_seconds(own)
+        else:
+            out[(s["pid"], s["span_id"])] = max(0.0, s["t1"] - s["t0"])
+    return out
+
+
+def to_chrome_trace(records: list[dict], critical_ids=None) -> dict:
+    """Convert parsed event records to a Chrome trace-event document.
+
+    ``critical_ids``: span ids on a ``tpu-critpath`` dominant chain — those
+    slices get a distinct color and a ``critical_path`` arg."""
+    critical_ids = critical_ids or set()
     records = [
         r for r in records
         if isinstance(r.get("ts"), (int, float)) and isinstance(r.get("kind"), str)
@@ -64,6 +107,9 @@ def to_chrome_trace(records: list[dict]) -> dict:
         return (ts - t0) * 1e6
 
     events: list[dict] = []
+    #: collected span slices, completed in a second pass so self-time can see
+    #: every child before any slice is rendered
+    spans: list[dict] = []
     #: (pid, span_id) -> begin record; span ids are unique per span but scoping
     #: by pid keeps a forked child that inherited its parent's stack harmless.
     open_spans: dict[tuple, dict] = {}
@@ -89,17 +135,17 @@ def to_chrome_trace(records: list[dict]) -> dict:
                 })
                 continue
             bp = _payload(begin)
-            args = {**bp, **p, "span_id": rec["span_id"]}
-            args.pop("span", None)
-            events.append({
+            spans.append({
                 "name": str(bp.get("span", "span")),
                 "cat": begin.get("source", "?"),
-                "ph": "X",
-                "ts": us(begin["ts"]),
-                "dur": max(0.0, us(rec["ts"]) - us(begin["ts"])),
                 "pid": pid,
                 "tid": _tid(begin),
-                "args": args,
+                "span_id": rec["span_id"],
+                "parent_id": bp.get("parent_id"),
+                "t0": begin["ts"],
+                "t1": rec["ts"],
+                "finished": True,
+                "args": {**bp, **p},
             })
             continue
         # Plain event → instant marker, thread-scoped.
@@ -116,15 +162,41 @@ def to_chrome_trace(records: list[dict]) -> dict:
     # the crashed-mid-span slice jumps out of a busy trace.
     for (pid, sid), begin in open_spans.items():
         bp = _payload(begin)
-        args = {**bp, "span_id": sid, "unfinished": True}
-        args.pop("span", None)
-        events.append({
-            "name": str(bp.get("span", "span")), "cat": begin.get("source", "?"),
-            "ph": "X", "ts": us(begin["ts"]),
-            "dur": max(0.0, us(t_last) - us(begin["ts"])),
-            "pid": pid, "tid": _tid(begin), "args": args,
-            "cname": "terrible",
+        spans.append({
+            "name": str(bp.get("span", "span")),
+            "cat": begin.get("source", "?"),
+            "pid": pid,
+            "tid": _tid(begin),
+            "span_id": sid,
+            "parent_id": bp.get("parent_id"),
+            "t0": begin["ts"],
+            "t1": t_last,
+            "finished": False,
+            "args": {**bp, "unfinished": True},
         })
+
+    selfs = _self_times(spans)
+    for s in spans:
+        args = {
+            **s["args"], "span_id": s["span_id"],
+            "self_time_ms": round(selfs.get((s["pid"], s["span_id"]), 0.0) * 1e3, 3),
+        }
+        args.pop("span", None)
+        slice_ev = {
+            "name": s["name"], "cat": s["cat"],
+            "ph": "X", "ts": us(s["t0"]),
+            "dur": max(0.0, us(s["t1"]) - us(s["t0"])),
+            "pid": s["pid"], "tid": s["tid"], "args": args,
+        }
+        if not s["finished"]:
+            slice_ev["cname"] = "terrible"
+        if s["span_id"] in critical_ids:
+            # Distinct from the unfinished red: the chain that gated the
+            # episode reads off the trace without manual inspection.
+            args["critical_path"] = True
+            if s["finished"]:
+                slice_ev["cname"] = "thread_state_runnable"
+        events.append(slice_ev)
 
     # Name each pid row by its dominant event source (launcher/worker/monitor).
     dominant: dict[int, tuple[str, int]] = {}

@@ -110,7 +110,12 @@ class Event:
     #: fleet job identity ($TPU_RESILIENCY_JOB) — None outside fleet scope
     job: Optional[str] = None
 
-    def to_json(self) -> str:
+    def to_record(self) -> dict:
+        """The flat dict shape a parsed JSONL line has (envelope + payload,
+        colliding payload keys renamed ``p_<key>``) — what every stream
+        consumer (``observe_record``, the ledgers, ``critpath``) eats, minus
+        the JSON round trip. In-process sinks use this to feed the same code
+        paths the offline tools run."""
         env = {
             "ts": self.ts,
             "source": self.source,
@@ -125,14 +130,14 @@ class Event:
             env["span_id"] = self.span_id
         if self.job is not None:
             env["job"] = self.job
-        return json.dumps(
-            {
-                **env,
-                **{f"p_{k}" if k in RESERVED_KEYS else k: v
-                   for k, v in self.payload.items()},
-            },
-            default=repr,
-        )
+        return {
+            **env,
+            **{f"p_{k}" if k in RESERVED_KEYS else k: v
+               for k, v in self.payload.items()},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_record(), default=repr)
 
 
 class JsonlSink:

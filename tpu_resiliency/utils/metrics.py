@@ -789,6 +789,71 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "tpu_replication_mbps", "p2p shard transfer throughput (MB/s)",
                 THROUGHPUT_BUCKETS_MBPS, direction=d,
             ).observe(rec["mbps"])
+    elif kind == "store_stats":
+        # Periodic self-telemetry deltas from the coordination store's event
+        # loop (platform/store.py + utils/opstats.py): counters carry
+        # movement since the previous emit, so replaying the stream
+        # reconstructs the live totals exactly.
+        ops = rec.get("ops")
+        if isinstance(ops, dict):
+            for op, n in sorted(ops.items()):
+                if isinstance(n, (int, float)) and n > 0:
+                    reg.counter(
+                        "tpu_store_ops_total",
+                        "coordination-store operations served, by op",
+                        op=str(op),
+                    ).inc(n)
+        secs = rec.get("op_seconds")
+        if isinstance(secs, dict):
+            for op, s in sorted(secs.items()):
+                if isinstance(s, (int, float)) and s > 0:
+                    reg.counter(
+                        "tpu_store_op_seconds",
+                        "seconds of store event-loop handle time, by op "
+                        "(rate ÷ tpu_store_ops_total rate = mean handle "
+                        "latency; quantiles live in the store_stats doc)",
+                        op=str(op),
+                    ).inc(s)
+        for field, direction in (("bytes_in", "in"), ("bytes_out", "out")):
+            v = rec.get(field)
+            if isinstance(v, (int, float)) and v > 0:
+                reg.counter(
+                    "tpu_store_bytes_total",
+                    "coordination-store wire bytes by direction",
+                    direction=direction,
+                ).inc(v)
+        if isinstance(rec.get("conns"), (int, float)):
+            reg.gauge(
+                "tpu_store_conns", "live coordination-store connections"
+            ).set(rec["conns"])
+    elif kind == "byteflow_update":
+        # The byte-flow ledger's per-(purpose,direction) attribution deltas
+        # (utils/byteflow.py) — same delta discipline as goodput_update.
+        flows = rec.get("flows")
+        if isinstance(flows, dict):
+            for key, nbytes in sorted(flows.items()):
+                if not isinstance(nbytes, (int, float)) or nbytes <= 0:
+                    continue
+                purpose, _, direction = str(key).partition("/")
+                reg.counter(
+                    "tpu_byteflow_bytes_total",
+                    "bytes moved, attributed by the byte-flow ledger "
+                    "(purpose: replicate | retrieve | reshard | store | "
+                    "ckpt_write | unknown)",
+                    purpose=purpose, direction=direction or "?",
+                ).inc(nbytes)
+        if isinstance(rec.get("residue_bytes"), (int, float)) and rec["residue_bytes"] > 0:
+            reg.counter(
+                "tpu_byteflow_residue_bytes",
+                "bytes the ledger observed but could not attribute to a "
+                "purpose (unknown-tag wire traffic) — the gap instrument",
+            ).inc(rec["residue_bytes"])
+        if isinstance(rec.get("accounted_ratio"), (int, float)):
+            reg.gauge(
+                "tpu_byteflow_accounted_ratio",
+                "fraction of observed bytes the ledger attributed to a "
+                "purpose (the ≥0.95 acceptance gate)",
+            ).set(rec["accounted_ratio"])
     elif kind == "store_retry":
         reg.counter(
             "tpu_store_retries_total",
@@ -983,14 +1048,17 @@ class MetricsSink:
     def __call__(self, event) -> None:
         # Same flat shape as the JSONL line (including the p_-rename of payload
         # keys that collide with the envelope), minus the json round-trip.
-        rec = {
-            "ts": event.ts, "source": event.source, "kind": event.kind,
-            "pid": event.pid, "rank": event.rank,
-            **{f"p_{k}" if k in RESERVED_KEYS else k: v
-               for k, v in event.payload.items()},
-        }
-        if getattr(event, "job", None) is not None:
-            rec["job"] = event.job
+        if hasattr(event, "to_record"):
+            rec = event.to_record()
+        else:
+            rec = {
+                "ts": event.ts, "source": event.source, "kind": event.kind,
+                "pid": event.pid, "rank": event.rank,
+                **{f"p_{k}" if k in RESERVED_KEYS else k: v
+                   for k, v in event.payload.items()},
+            }
+            if getattr(event, "job", None) is not None:
+                rec["job"] = event.job
         observe_record(rec, self.registry)
         if self.json_path is not None:
             now = time.monotonic()
