@@ -1,24 +1,38 @@
-"""Op-storm benchmark for the coordination store: the "before" picture.
+"""Op-storm benchmarks for the coordination store: before AND after.
 
-ROADMAP item 2 (shard the store, tree the collectives) will be judged against
-a latency curve — this harness records it. N concurrent clients on loopback
-hammer one :class:`KVServer` with the mixed small-op workload the launcher
-actually generates (set/get/add/touch + a periodic prefix scan), and the
-report is client-observed p50/p95 latency and aggregate throughput per
-concurrency level, plus the server's OWN ``store_stats`` view of the same
-storm (handle vs queue-wait split — the number that says whether the loop or
-the wire is the bottleneck).
+**Baseline leg** (default): N concurrent clients on loopback hammer one
+:class:`KVServer` with the mixed small-op workload the launcher actually
+generates (set/get/add/touch + a periodic prefix scan); the report is
+client-observed p50/p95 latency and aggregate throughput per concurrency
+level, plus the server's OWN ``store_stats`` view of the same storm (handle
+vs queue-wait split). This is the committed ``BENCH_store_baseline.json``
+"before" curve ROADMAP item 2 is judged against.
 
-The second leg is the **telemetry overhead gate**: the same storm against a
-``stats_enabled=False`` control server. Per-op accounting must cost <5% of
-client-observed p50 (the knob defaults ON, so the tax is paid by every job —
-``tests/platform/test_store_perf.py`` enforces the gate as a slow-marked
-test).
+**Telemetry overhead leg**: the same storm against a ``stats_enabled=False``
+control server. Per-op accounting must cost <5% of client-observed p50
+(``tests/platform/test_store_perf.py`` enforces the gate).
+
+**Scale leg** (``--ranks N``): the "after" picture — a simulated N-rank
+rendezvous + barrier storm + metrics-push storm against a **sharded clique**
+of ``--shards`` KVServer *processes*, driven by ``--procs`` light loopback
+worker processes each multiplexing a contiguous slice of ranks. The tree
+barrier executes level-stepped (deepest level first, an mp barrier between
+levels), which is DAG-faithful: op counts, key layout, and shard routing are
+exactly the deployment protocol's — only the park-and-wake idling is elided,
+so the measured figures are store service times, the quantity the baseline
+curve also measures. The report: per-op p50/p95 across the storm (the
+apples-to-apples number vs the baseline's 64-client point), per-shard op
+totals from the aggregated ``store_stats`` (how evenly the hash spreads the
+storm), and a flat-vs-tree comparison table with analytic critical-path hop
+counts (``treecomm.flat_hops``/``tree_hops``) plus measured per-rank op
+counts and wall clocks. Committed as ``BENCH_store_scale.json``.
 
 Usage::
 
     python scripts/bench_store.py [--ops N] [--out BENCH_store_baseline.json]
+    python scripts/bench_store.py --ranks 4096 --shards 4   # scale storm
     python scripts/bench_store.py --smoke     # CI: tiny storm, sanity asserts
+    python scripts/bench_store.py --smoke --ranks 128 --shards 2  # + scale leg
 """
 
 from __future__ import annotations
@@ -165,6 +179,369 @@ def bench_overhead(clients: int = 1, ops_per_client: int = 1500,
     }
 
 
+# -- scale leg: sharded clique + tree collectives ---------------------------
+
+
+def _quantiles(lats: list) -> dict:
+    lats = sorted(lats)
+
+    def q(p: float) -> float:
+        return lats[min(len(lats) - 1, int(p * len(lats)))] if lats else 0.0
+
+    return {
+        "ops": len(lats),
+        "p50_us": round(q(0.50) * 1e6, 2),
+        "p95_us": round(q(0.95) * 1e6, 2),
+        "p99_us": round(q(0.99) * 1e6, 2),
+    }
+
+
+def _storm_worker(spec: str, proc_id: int, ranks: range, world: int,
+                  fanout: int, rounds: int, depth: int, lvl_barrier, q) -> None:
+    """One light loopback process multiplexing ``ranks``: per round, the
+    rendezvous write burst, the level-stepped tree barrier (exact deployment
+    key layout/op counts — see module doc), and the metrics-push burst.
+    Reports (proc_id, per-op latencies, per-rank op count)."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+    from tpu_resiliency.platform.treecomm import children, tree_depth
+
+    c = ShardedKVClient(parse_endpoints(spec), timeout=60.0)
+    lat: list[float] = []
+    ops_by_rank = dict.fromkeys(ranks, 0)
+
+    def op(rank, fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        lat.append(time.perf_counter() - t0)
+        ops_by_rank[rank] += 1
+        return out
+
+    def depth_of(i: int) -> int:
+        d = 0
+        while i > 0:
+            i = (i - 1) // fanout
+            d += 1
+        return d
+
+    try:
+        for r in range(1, rounds + 1):
+            # Phase 1: rendezvous registration burst (keyed writes, scattered
+            # across shards by hash — the round-open census shape).
+            for rank in ranks:
+                op(rank, c.set, f"rdzv/r{r}/{rank}", rank)
+            lvl_barrier.wait()
+            # Phase 2: tree barrier, level-stepped. Up: deepest level first,
+            # so every child's arrival key is committed before its parent
+            # reads it (the parked wait of the live protocol, minus idling).
+            for lvl in range(depth, -1, -1):
+                for rank in ranks:
+                    if depth_of(rank) != lvl:
+                        continue
+                    for ch in children(rank, world, fanout):
+                        got = op(rank, c.get, f"bar/u/{ch}", 30.0)
+                        assert got == r, (ch, got, r)
+                    if rank != 0:
+                        op(rank, c.set, f"bar/u/{rank}", r)
+                lvl_barrier.wait()
+            # Down: release propagates root→leaves on per-child keys.
+            for lvl in range(0, depth + 1):
+                for rank in ranks:
+                    if depth_of(rank) != lvl:
+                        continue
+                    if rank != 0:
+                        got = op(rank, c.get, f"bar/d/{rank}", 30.0)
+                        assert got == r, (rank, got, r)
+                    for ch in children(rank, world, fanout):
+                        op(rank, c.set, f"bar/d/{ch}", r)
+                lvl_barrier.wait()
+            # Phase 3: metrics-push burst (heartbeat touch + snapshot set —
+            # the per-tick publisher shape).
+            for rank in ranks:
+                op(rank, c.touch, f"mhb/{rank}")
+                op(rank, c.set, f"jobmetrics/{rank}", {"rank": rank, "round": r})
+            lvl_barrier.wait()
+    finally:
+        c.close()
+    q.put((proc_id, lat, max(ops_by_rank.values()) if ops_by_rank else 0))
+
+
+def bench_scale(ranks: int = 4096, shards: int = 4, procs: int = 16,
+                rounds: int = 3, fanout: int = 8) -> dict:
+    """The simulated N-rank storm against a spawned shard clique."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, SpawnedClique
+    from tpu_resiliency.platform.treecomm import flat_hops, tree_depth, tree_hops
+
+    procs = min(procs, ranks)
+    clique = SpawnedClique(shards)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    depth = tree_depth(ranks, fanout)
+    lvl_barrier = ctx.Barrier(procs)
+    slices = []
+    per = ranks // procs
+    extra = ranks % procs
+    lo = 0
+    for i in range(procs):
+        hi = lo + per + (1 if i < extra else 0)
+        slices.append(range(lo, hi))
+        lo = hi
+    try:
+        workers = [
+            ctx.Process(
+                target=_storm_worker,
+                args=(clique.spec, i, slices[i], ranks, fanout, rounds,
+                      depth, lvl_barrier, q),
+            )
+            for i in range(procs)
+        ]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        lats: list[float] = []
+        max_rank_ops = 0
+        for _ in range(procs):
+            _, lat, rank_ops = q.get(timeout=600)
+            lats.extend(lat)
+            max_rank_ops = max(max_rank_ops, rank_ops)
+        wall = time.perf_counter() - t0
+        for w in workers:
+            w.join(30.0)
+            if w.is_alive():
+                w.terminate()
+        probe = ShardedKVClient(clique.endpoints)
+        try:
+            stats = probe.store_stats()
+        finally:
+            probe.close()
+    finally:
+        clique.close()
+    shard_ops = [s["ops_total"] for s in stats.get("shards", [])]
+    total_shard_ops = sum(shard_ops) or 1
+    return {
+        "ranks": ranks,
+        "shards": shards,
+        "procs": procs,
+        "rounds": rounds,
+        "fanout": fanout,
+        **_quantiles(lats),
+        "ops_per_s": round(len(lats) / wall, 1) if wall else 0.0,
+        "wall_s": round(wall, 3),
+        "max_ops_per_rank_per_round": round(max_rank_ops / rounds, 1),
+        "hops": {
+            "flat": flat_hops(ranks),
+            "tree": tree_hops(ranks, fanout),
+            "win": round(flat_hops(ranks) / tree_hops(ranks, fanout), 1),
+        },
+        "shard_balance": {
+            "backend": stats.get("backend"),
+            "per_shard_ops": shard_ops,
+            # 1/shards is perfect balance; 1.0 means one loop served it all.
+            "busiest_shard_frac": round(max(shard_ops) / total_shard_ops, 3)
+            if shard_ops else 1.0,
+        },
+    }
+
+
+def bench_tree_vs_flat(sizes=(64, 256, 1024), fanout: int = 8,
+                       shards: int = 4, procs: int = 8) -> list[dict]:
+    """Flat vs tree collective round at each world size, same clique: wall
+    clock, per-rank op ceiling, and the analytic critical-path hop counts
+    the ≥4×-at-256 acceptance gate reads. The flat leg reproduces today's
+    ``StoreComm.all_gather`` op sequence (set + entry barrier + prefix_get +
+    exit barrier); the tree leg is the level-stepped tree gather."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, SpawnedClique
+    from tpu_resiliency.platform.treecomm import flat_hops, tree_depth, tree_hops
+
+    out = []
+    clique = SpawnedClique(shards)
+    ctx = mp.get_context("fork")
+    try:
+        for world in sizes:
+            nproc = min(procs, world)
+            q = ctx.Queue()
+            lvl_barrier = ctx.Barrier(nproc)
+            per = world // nproc
+            extra = world % nproc
+            slices, lo = [], 0
+            for i in range(nproc):
+                hi = lo + per + (1 if i < extra else 0)
+                slices.append(range(lo, hi))
+                lo = hi
+
+            def run(target):
+                workers = [
+                    ctx.Process(
+                        target=target,
+                        args=(clique.spec, i, slices[i], world, fanout,
+                              lvl_barrier, q),
+                    )
+                    for i in range(nproc)
+                ]
+                t0 = time.perf_counter()
+                for w in workers:
+                    w.start()
+                lats, rank_ops = [], 0
+                for _ in range(nproc):
+                    _, lat, ro = q.get(timeout=600)
+                    lats.extend(lat)
+                    rank_ops = max(rank_ops, ro)
+                wall = time.perf_counter() - t0
+                for w in workers:
+                    w.join(30.0)
+                return wall, lats, rank_ops
+
+            flat_wall, flat_lats, flat_rank_ops = run(_flat_gather_worker)
+            tree_wall, tree_lats, tree_rank_ops = run(_tree_gather_worker)
+            out.append({
+                "world": world,
+                "flat": {"wall_s": round(flat_wall, 3),
+                         "ops_per_rank": flat_rank_ops,
+                         "hops": flat_hops(world), **_quantiles(flat_lats)},
+                "tree": {"wall_s": round(tree_wall, 3),
+                         "ops_per_rank": tree_rank_ops,
+                         "hops": tree_hops(world, fanout),
+                         "depth": tree_depth(world, fanout),
+                         **_quantiles(tree_lats)},
+                "hop_win": round(flat_hops(world) / tree_hops(world, fanout), 1),
+            })
+    finally:
+        clique.close()
+    return out
+
+
+def _flat_gather_worker(spec, proc_id, ranks, world, fanout, lvl_barrier, q):
+    """Today's flat all_gather shape: value set, entry barrier (non-blocking
+    registration — the level-stepped stand-in for the parked join), one
+    whole-world prefix_get per rank, exit barrier."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+
+    c = ShardedKVClient(parse_endpoints(spec), timeout=60.0)
+    lat, ops = [], dict.fromkeys(ranks, 0)
+
+    def op(rank, fn, *a, **kw):
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        lat.append(time.perf_counter() - t0)
+        ops[rank] += 1
+        return out
+
+    try:
+        for rank in ranks:
+            op(rank, c.set, f"fg{world}/v/{rank}", rank)
+            op(rank, c.barrier_join, f"fg{world}/b0", rank, world, 30.0, False)
+        lvl_barrier.wait()
+        for rank in ranks:
+            vals = op(rank, c.prefix_get, f"fg{world}/v/")
+            assert len(vals) == world, (rank, len(vals))
+            op(rank, c.barrier_join, f"fg{world}/b1", rank, world, 30.0, False)
+        lvl_barrier.wait()
+    finally:
+        c.close()
+    q.put((proc_id, lat, max(ops.values()) if ops else 0))
+
+
+def _tree_gather_worker(spec, proc_id, ranks, world, fanout, lvl_barrier, q):
+    """The tree all_gather DAG, level-stepped: fan-in merged dicts up,
+    result fan-out down per-child keys, ack fan-in, root GC."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+    from tpu_resiliency.platform.treecomm import children, tree_depth
+
+    c = ShardedKVClient(parse_endpoints(spec), timeout=60.0)
+    lat, ops = [], dict.fromkeys(ranks, 0)
+    depth = tree_depth(world, fanout)
+
+    def op(rank, fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        lat.append(time.perf_counter() - t0)
+        ops[rank] += 1
+        return out
+
+    def depth_of(i):
+        d = 0
+        while i > 0:
+            i = (i - 1) // fanout
+            d += 1
+        return d
+
+    try:
+        for lvl in range(depth, -1, -1):  # fan-in
+            for rank in ranks:
+                if depth_of(rank) != lvl:
+                    continue
+                merged = {rank: rank}
+                for ch in children(rank, world, fanout):
+                    merged.update(op(rank, c.get, f"tg{world}/v/{ch}", 30.0))
+                if rank == 0:
+                    assert len(merged) == world, len(merged)
+                    for ch in children(rank, world, fanout):
+                        op(rank, c.set, f"tg{world}/res/{ch}", merged)
+                else:
+                    op(rank, c.set, f"tg{world}/v/{rank}", merged)
+            lvl_barrier.wait()
+        for lvl in range(1, depth + 1):  # result fan-out
+            for rank in ranks:
+                if depth_of(rank) != lvl:
+                    continue
+                res = op(rank, c.get, f"tg{world}/res/{rank}", 30.0)
+                assert len(res) == world, (rank, len(res))
+                for ch in children(rank, world, fanout):
+                    op(rank, c.set, f"tg{world}/res/{ch}", res)
+            lvl_barrier.wait()
+        for lvl in range(depth, 0, -1):  # ack fan-in
+            for rank in ranks:
+                if depth_of(rank) != lvl:
+                    continue
+                for ch in children(rank, world, fanout):
+                    op(rank, c.get, f"tg{world}/a/{ch}", 30.0)
+                op(rank, c.set, f"tg{world}/a/{rank}", 1)
+            lvl_barrier.wait()
+        for rank in ranks:
+            if rank == 0:
+                for ch in children(0, world, fanout):
+                    op(rank, c.get, f"tg{world}/a/{ch}", 30.0)
+                op(rank, c.prefix_clear, f"tg{world}/")
+        lvl_barrier.wait()
+    finally:
+        c.close()
+    q.put((proc_id, lat, max(ops.values()) if ops else 0))
+
+
+def bench_scale_report(ranks: int, shards: int, procs: int, rounds: int,
+                       fanout: int, compare_sizes) -> dict:
+    """The full scale leg + the committed baseline replayed side-by-side."""
+    storm = bench_scale(ranks=ranks, shards=shards, procs=procs,
+                        rounds=rounds, fanout=fanout)
+    compare = bench_tree_vs_flat(
+        sizes=tuple(s for s in compare_sizes if s <= ranks) or (ranks,),
+        fanout=fanout, shards=shards,
+        procs=min(procs, 8),
+    )
+    report = {
+        "host": platform.node(),
+        "cpus": os.cpu_count(),
+        "storm": storm,
+        "tree_vs_flat": compare,
+    }
+    base_path = os.path.join(REPO_ROOT, "BENCH_store_baseline.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)
+        levels = base.get("levels") or []
+        report["baseline"] = {
+            "p50_us_by_clients": {str(r["clients"]): r["p50_us"] for r in levels},
+            "p95_us_by_clients": {str(r["clients"]): r["p95_us"] for r in levels},
+        }
+        b64 = next((r for r in levels if r.get("clients") == 64), None)
+        if b64:
+            # THE acceptance ratio: per-op p95 under the N-rank sharded storm
+            # vs the flat server's 64-client point. <2.0 = the curve held.
+            report["p95_vs_baseline64"] = round(
+                storm["p95_us"] / b64["p95_us"], 3
+            ) if b64["p95_us"] else None
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ops", type=int, default=1500,
@@ -175,7 +552,27 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--smoke", action="store_true",
         help="tiny storm asserting the telemetry answers (op counts, wait/"
-        "handle split, hot prefixes) without writing the committed file",
+        "handle split, hot prefixes) without writing the committed file; "
+        "with --ranks, also a reduced sharded scale storm with its own "
+        "sanity asserts",
+    )
+    ap.add_argument(
+        "--ranks", type=int, default=0,
+        help="run the SCALE leg: simulated N-rank rendezvous + tree-barrier "
+        "+ metrics-push storm over a sharded clique; writes "
+        "BENCH_store_scale.json (unless --smoke)",
+    )
+    ap.add_argument("--shards", type=int, default=4,
+                    help="store clique size for the scale leg")
+    ap.add_argument("--procs", type=int, default=16,
+                    help="worker processes multiplexing the simulated ranks")
+    ap.add_argument("--rounds", type=int, default=3,
+                    help="storm rounds (rendezvous+barrier+metrics each)")
+    ap.add_argument("--fanout", type=int, default=8, help="tree arity")
+    ap.add_argument(
+        "--scale-out",
+        default=os.path.join(REPO_ROOT, "BENCH_store_scale.json"),
+        help="output for the scale leg's committed report",
     )
     args = ap.parse_args(argv)
 
@@ -185,6 +582,7 @@ def main(argv=None) -> int:
         stats = res["store_stats"]
         ok = (
             stats.get("enabled") is True
+            and stats.get("backend") == "epoll"
             and stats.get("ops", {}).get("set", {}).get("count", 0) > 0
             and stats["ops"]["set"]["handle"]["p50_us"] > 0
             and stats["ops"]["set"]["wait"]["count"] > 0
@@ -195,8 +593,60 @@ def main(argv=None) -> int:
             and stats.get("bytes", {}).get("in", 0) > 0
         )
         print(json.dumps({"bench_store_smoke": "PASS" if ok else "FAIL",
-                          "stats_enabled": stats.get("enabled")}))
+                          "stats_enabled": stats.get("enabled"),
+                          "backend": stats.get("backend")}))
+        if ok and args.ranks:
+            # Reduced sharded storm: the scale plumbing end to end (clique
+            # spawn, hash fan-out, tree DAG, aggregated per-shard stats).
+            storm = bench_scale(
+                ranks=args.ranks, shards=args.shards,
+                procs=min(args.procs, 4), rounds=1, fanout=args.fanout,
+            )
+            bal = storm["shard_balance"]
+            scale_ok = (
+                storm["p95_us"] > 0
+                and storm["hops"]["tree"] < storm["hops"]["flat"]
+                and bal["backend"] == "epoll"
+                and len(bal["per_shard_ops"]) == args.shards
+                and sum(bal["per_shard_ops"]) > 0
+                and bal["busiest_shard_frac"] < 1.0
+            )
+            print(json.dumps({
+                "layer": "store-scale-storm", "ranks": storm["ranks"],
+                "shards": storm["shards"], "p95_us": storm["p95_us"],
+                "hop_win": storm["hops"]["win"],
+                "busiest_shard_frac": bal["busiest_shard_frac"],
+            }))
+            print(json.dumps(
+                {"bench_store_scale_smoke": "PASS" if scale_ok else "FAIL"}
+            ))
+            ok = ok and scale_ok
         return 0 if ok else 1
+
+    if args.ranks:
+        report = bench_scale_report(
+            ranks=args.ranks, shards=args.shards, procs=args.procs,
+            rounds=args.rounds, fanout=args.fanout,
+            compare_sizes=(64, 256, 1024),
+        )
+        with open(args.scale_out, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({
+            "metric": "sharded-store scale storm (simulated ranks, loopback)",
+            "ranks": report["storm"]["ranks"],
+            "shards": report["storm"]["shards"],
+            "p50_us": report["storm"]["p50_us"],
+            "p95_us": report["storm"]["p95_us"],
+            "p95_vs_baseline64": report.get("p95_vs_baseline64"),
+            "busiest_shard_frac":
+                report["storm"]["shard_balance"]["busiest_shard_frac"],
+            "hop_win_at": {
+                str(row["world"]): row["hop_win"]
+                for row in report["tree_vs_flat"]
+            },
+        }))
+        return 0
 
     curve = bench_levels(levels=LEVELS, ops_per_client=args.ops)
     for row in curve["levels"]:

@@ -166,6 +166,95 @@ def scenario_store(seed: int, clients: int = 3, keys: int = 8, rounds: int = 3,
     return plan.schedule()
 
 
+# -- scenario: sharded clique + tree collectives ----------------------------
+
+#: Faults timed to land MID-tree-gather and MID-shard-fanout: the first
+#: resets hit while edge values are flowing up the tree, the truncations
+#:  while the prefix fan-out reads every shard. Every op on these paths is
+#: idempotent (set/get/prefix_get) or req_id-deduped (barrier arrivals), so
+#: the client's reconnect-retry ladder must absorb all of it byte-identically.
+STORE_SCALE_SPEC = (
+    "{seed}:store.send.reset@at=5;store.send.truncate@at=13;"
+    "store.recv.reset@at=8;store.recv.truncate@at=21;store.accept.eof@at=3;"
+    "store.send.reset@at=34;store.recv.truncate@at=55"
+)
+
+
+def scenario_store_scale(seed: int, world: int = 9, shards: int = 2,
+                         rounds: int = 2, spec: str | None = None):
+    """Tree collectives over a sharded store clique under seeded faults.
+
+    ``world`` member threads run ``StoreComm`` with the TREE paths forced on
+    (fanout 2 → a 3-level tree at world 9) over a ``shards``-wide
+    ``LocalClique``; per round every member all_gathers a distinct payload,
+    crosses a tree barrier, and the leader does a shard-fanout ``prefix_get``
+    census. Convergence: every member's every gather is byte-identical to the
+    expected list (same values, same order — the flat contract), the census
+    sees every member's key across all shards, and two runs of one seed
+    produce the identical injection schedule AND identical gathered bytes.
+    Returns ``(schedule, gathered_digest)``.
+    """
+    import hashlib
+    import pickle
+
+    from tpu_resiliency.platform.shardstore import LocalClique
+
+    plan = chaos.ChaosPlan.parse(spec or STORE_SCALE_SPEC.format(seed=seed))
+    chaos.install_plan(plan)
+    clique = LocalClique(shards)
+    stores = []
+    results: dict[int, list] = {}
+    try:
+        def body(rank: int):
+            st = clique.client(prefix="soak/")
+            stores.append(st)
+            comm = StoreComm(
+                st, rank, list(range(world)), timeout=60.0,
+                tree_fanout=2, tree_min_world=2,  # force the tree shape
+            )
+            gathered = []
+            for r in range(rounds):
+                st.set(f"census/{rank}/r{r}", (rank, r))
+                gathered.append(comm.all_gather((rank, r, b"x" * (rank + 1)),
+                                                tag="ag"))
+                comm.barrier("bar", timeout=60.0)
+                if comm.is_leader:
+                    # Peers may already be writing round r+1 keys (the
+                    # barrier releases them forward), so assert the fan-out
+                    # found EVERY key owed so far, not an exact count.
+                    census = st.prefix_get("census/")
+                    owed = {
+                        f"census/{k}/r{j}"
+                        for k in range(world) for j in range(r + 1)
+                    }
+                    assert owed <= set(census), (
+                        f"shard-fanout census lost keys: "
+                        f"{sorted(owed - set(census))}"
+                    )
+            results[rank] = gathered
+
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(body, rank) for rank in range(world)]:
+                f.result(timeout=180)
+
+        for r in range(rounds):
+            expect = [(peer, r, b"x" * (peer + 1)) for peer in range(world)]
+            for rank in range(world):
+                assert results[rank][r] == expect, (
+                    f"tree gather diverged at rank {rank} round {r}: "
+                    f"{results[rank][r]!r}"
+                )
+        digest = hashlib.sha256(
+            pickle.dumps([results[rank] for rank in range(world)])
+        ).hexdigest()
+    finally:
+        chaos.clear_plan()
+        for s in stores:
+            s.close()
+        clique.close()
+    return plan.schedule(), digest
+
+
 # -- scenario: clique replication -------------------------------------------
 
 #: Send-side faults are retried by the sender and MUST converge; a recv-side
@@ -1333,6 +1422,20 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     s2 = scenario_store(seed, spec=store_spec)
     assert s1 == s2, f"store schedule not reproducible:\n{s1}\n{s2}"
     out["store_injections"] = [list(i) for i in s1]
+    # Sharded clique + tree collectives under the same store-channel faults,
+    # twice per seed: schedule AND gathered bytes must both reproduce.
+    scale_spec = (
+        chaos.random_spec(seed, channels=("store",), ops=("send", "recv", "connect"))
+        if randomized else None
+    )
+    ss1 = scenario_store_scale(seed, spec=scale_spec)
+    ss2 = scenario_store_scale(seed, spec=scale_spec)
+    assert ss1[0] == ss2[0], (
+        f"store-scale schedule not reproducible:\n{ss1[0]}\n{ss2[0]}"
+    )
+    assert ss1[1] == ss2[1], "store-scale gathered bytes not reproducible"
+    out["store_scale_injections"] = [list(i) for i in ss1[0]]
+    out["store_scale_digest"] = ss1[1]
     r1 = scenario_replication(seed, spec=repl_spec)
     r2 = scenario_replication(seed, spec=repl_spec)
     assert r1 == r2, f"replication schedule not reproducible:\n{r1}\n{r2}"

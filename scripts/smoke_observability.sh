@@ -208,8 +208,43 @@ python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --bytes | sed 's/
 python -m tpu_resiliency.tools.metrics_dump "$GP/events.jsonl" --bytes --format json | \
     python -c "import json,sys; d=json.load(sys.stdin); assert d['total_bytes']>0 and d['accounted_frac']>=0.95, d" \
     || { echo "FAIL: byte-flow ledger residue exceeds 5%"; exit 1; }
-# Store op storm: telemetry answers under load (server-side account sane).
-python scripts/bench_store.py --smoke
+# Store op storm: telemetry answers under load (server-side account sane),
+# plus the store-scale leg — reduced-rank sharded storm (clique spawn, hash
+# fan-out, tree DAG, aggregated per-shard stats asserted inside).
+python scripts/bench_store.py --smoke --ranks 128 --shards 2
+
+echo "== smoke: store scale (clique shard map + per-shard op totals render)"
+SSDIR="$WORKDIR/store_scale"
+mkdir -p "$SSDIR"
+python - "$SSDIR" <<'PY'
+import subprocess, sys
+from tpu_resiliency.platform.shardstore import CLIQUE_KEY, SpawnedClique
+from tpu_resiliency.platform.store import CoordStore
+
+clique = SpawnedClique(2)
+try:
+    shard0 = CoordStore(*clique.endpoints[0])
+    shard0.set(CLIQUE_KEY, clique.spec)
+    st = clique.client()
+    for i in range(32):
+        st.set(f"smoke/{i}", i)
+    # Single classic endpoint in, whole-clique aggregate out (discovery).
+    out = subprocess.run(
+        [sys.executable, "-m", "tpu_resiliency.tools.store_info",
+         f"127.0.0.1:{clique.port}", "--stats"],
+        capture_output=True, text=True, timeout=60,
+    )
+    sys.stdout.write(out.stdout)
+    assert out.returncode == 0, out.stderr
+    assert "backend: epoll" in out.stdout, out.stdout
+    assert "shards: 2 (crc32" in out.stdout, out.stdout
+    assert "per-shard op totals:" in out.stdout, out.stdout
+    assert out.stdout.count("epoll") >= 3, out.stdout  # header + 2 shard rows
+    st.close(); shard0.close()
+finally:
+    clique.close()
+print("store-scale stats render OK: backend + shard map + per-shard totals")
+PY
 
 echo "== smoke: elastic reshard (ranged fetch moves fewer bytes than full mirrors)"
 python scripts/bench_reshard.py --smoke

@@ -35,6 +35,36 @@ def test_telemetry_overhead_under_five_percent():
     )
 
 
+def test_scale_storm_holds_the_curve():
+    """The sharded+tree "after" gate behind BENCH_store_scale.json: a
+    1024-rank simulated storm (sharded clique, tree barrier DAG) must hold
+    per-op p95 within 2× of a FRESH same-host flat 64-client measurement
+    (host-relative, so shared-CI speed doesn't skew the ratio), the tree's
+    critical-path hop count must win ≥4× at 256+, and the hash must actually
+    spread the storm across the shards. One noise-guard retry, same policy
+    as the overhead gate."""
+    from tpu_resiliency.platform.treecomm import flat_hops, tree_hops
+
+    assert flat_hops(256) / tree_hops(256, 8) >= 4.0
+    assert flat_hops(4096) / tree_hops(4096, 8) >= 4.0
+
+    flat64 = bench_store.bench_levels(levels=(64,), ops_per_client=300)
+    flat_p95 = flat64["levels"][0]["p95_us"]
+    storm = bench_store.bench_scale(ranks=1024, shards=2, procs=8, rounds=1)
+    if storm["p95_us"] > 2.0 * flat_p95:
+        storm = bench_store.bench_scale(ranks=1024, shards=2, procs=8,
+                                        rounds=1)
+    assert storm["p95_us"] <= 2.0 * flat_p95, (
+        f"scale storm p95 {storm['p95_us']}us vs flat 64-client p95 "
+        f"{flat_p95}us — the sharded curve no longer holds"
+    )
+    bal = storm["shard_balance"]
+    assert bal["backend"] == "epoll"
+    assert len(bal["per_shard_ops"]) == 2 and min(bal["per_shard_ops"]) > 0
+    assert bal["busiest_shard_frac"] < 0.75, bal
+    assert storm["hops"]["win"] >= 4.0
+
+
 def test_storm_curve_and_server_account():
     """The latency-curve harness: client-observed quantiles are ordered and
     positive, and the server's own store_stats document accounts the storm

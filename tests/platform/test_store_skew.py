@@ -1,0 +1,131 @@
+"""Version-skew contract for the coordination-plane scale work.
+
+Two directions must keep working with zero wire changes:
+
+1. **Old client → epoll server.** The event-loop server speaks the exact
+   frame protocol the thread-per-connection ancestor did. A minimal
+   "old-build" client — raw framing, no req_id nonces, no store_stats, no
+   shard awareness — must round-trip every pre-scale op untouched.
+2. **New client → 1-shard store.** Sharding degenerates at N=1 to today's
+   layout exactly: same keys on the same single server, flat collectives,
+   classic CoordStore behavior — so a rolling upgrade can ship the client
+   first and flip the clique on later.
+"""
+
+import socket
+
+import pytest
+
+from tpu_resiliency.platform import framing
+from tpu_resiliency.platform.shardstore import (
+    LocalClique,
+    ShardedKVClient,
+    connect_store,
+    format_endpoints,
+)
+from tpu_resiliency.platform.store import CoordStore, _client_hello
+
+
+class OldWireClient:
+    """A pre-scale-era client: one blocking socket, raw pickled frames, only
+    the op fields that existed before req_id dedup and store_stats shipped.
+    Deliberately NOT built on KVClient — the point is the wire, not the
+    library."""
+
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port), timeout=10.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _client_hello(self.sock, None)
+
+    def call(self, **req):
+        framing.send_obj(self.sock, req)
+        return framing.recv_obj(self.sock)
+
+    def close(self):
+        self.sock.close()
+
+
+def test_old_wire_client_against_epoll_server(kv_server):
+    c = OldWireClient("127.0.0.1", kv_server.port)
+    try:
+        assert c.call(op="ping")["value"] == "pong"
+        assert c.call(op="set", key="skew/a", value=41)["status"] == "ok"
+        assert c.call(op="get", key="skew/a", timeout=1.0)["value"] == 41
+        assert c.call(op="add", key="skew/ctr", amount=2)["value"] == 2
+        assert c.call(op="cas", key="skew/c", expected=None,
+                      desired="v")["value"] == (True, "v")
+        assert c.call(op="prefix_get", prefix="skew/")["value"] == {
+            "skew/a": 41, "skew/ctr": 2, "skew/c": "v",
+        }
+        # Old-style barrier join: no req_id — server must not require one.
+        resp = c.call(op="barrier", name="skew/b", rank=0, world_size=1,
+                      timeout=5.0, wait=True)
+        assert resp["status"] == "ok" and resp["value"] == 1
+        # Unknown future op: one structured error frame, connection intact.
+        resp = c.call(op="quantum_entangle", key="skew/a")
+        assert resp["status"] == "error" and "unknown op" in resp["error"]
+        assert c.call(op="ping")["value"] == "pong"
+    finally:
+        c.close()
+
+
+def test_new_client_against_one_shard_degenerates(kv_server):
+    """ShardedKVClient with one endpoint: every op lands on the single
+    server exactly where a classic KVClient would put it — interoperable in
+    both directions mid-flight."""
+    sharded = ShardedKVClient([("127.0.0.1", kv_server.port)], timeout=30.0)
+    classic = CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+    try:
+        sharded.set("skew/x", "from-sharded")
+        assert classic.get("skew/x", timeout=1.0) == "from-sharded"
+        classic.set("skew/y", "from-classic")
+        assert sharded.get("skew/y", timeout=1.0) == "from-classic"
+        assert sharded.prefix_get("skew/") == classic.prefix_get("skew/")
+        assert sharded.num_keys() == classic.client.num_keys()
+        # Barriers interoperate: arrivals from either client shape release
+        # one server-side round.
+        sharded.barrier_join("skew/b2", 0, 2, timeout=0.0, wait=False)
+        classic.barrier_join("skew/b2", 1, 2, timeout=5.0)
+        st = sharded.barrier_status("skew/b2")
+        assert st is not None and st["generation"] == 1
+        doc = sharded.store_stats()
+        assert doc["shard_map"]["nshards"] == 1
+        assert doc["backend"] == "epoll"
+    finally:
+        sharded.close()
+        classic.close()
+
+
+def test_factory_degenerates_without_spec(kv_server, monkeypatch):
+    from tpu_resiliency.platform.shardstore import SHARDS_ENV
+
+    monkeypatch.delenv(SHARDS_ENV, raising=False)
+    st = connect_store("127.0.0.1", kv_server.port, prefix="p/")
+    try:
+        assert isinstance(st, CoordStore)
+        st.set("k", 1)
+        assert st.get("k", timeout=1.0) == 1
+    finally:
+        st.close()
+
+
+def test_old_wire_client_against_a_clique_shard():
+    """An old client pointed at ONE shard of a clique still works against
+    that shard (the wire is unchanged); it simply sees only that shard's
+    slice — the documented skew behavior, not a crash."""
+    clique = LocalClique(2)
+    new = ShardedKVClient(clique.endpoints, timeout=30.0)
+    try:
+        for i in range(8):
+            new.set(f"sk/{i}", i)
+        old = OldWireClient(*clique.endpoints[0])
+        try:
+            seen = old.call(op="prefix_get", prefix="sk/")["value"]
+            whole = new.prefix_get("sk/")
+            assert set(seen) <= set(whole)
+            assert 0 < len(seen) < len(whole)  # a slice, not the world
+        finally:
+            old.close()
+    finally:
+        new.close()
+        clique.close()

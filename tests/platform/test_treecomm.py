@@ -1,0 +1,220 @@
+"""Tree collectives (platform/treecomm.py): correctness of the barrier and
+all_gather protocols against flat-path semantics, hop-count math, reentrancy,
+timeout-is-fatal, and the StoreComm integration that switches shapes on the
+world-size floor."""
+
+import threading
+
+import pytest
+
+from tpu_resiliency.exceptions import BarrierTimeout
+from tpu_resiliency.platform import treecomm
+from tpu_resiliency.platform.shardstore import LocalClique
+from tpu_resiliency.platform.store import CoordStore
+from tpu_resiliency.platform.treecomm import (
+    TreeComm,
+    children,
+    flat_hops,
+    parent,
+    tree_depth,
+    tree_hops,
+)
+
+
+def test_tree_topology_math():
+    assert children(0, 9, 2) == [1, 2]
+    assert children(1, 9, 2) == [3, 4]
+    assert children(3, 9, 2) == [7, 8]
+    assert children(4, 9, 2) == []  # clipped at world
+    assert parent(8, 2) == 3 and parent(3, 2) == 1 and parent(1, 2) == 0
+    assert tree_depth(1, 8) == 0
+    assert tree_depth(9, 8) == 1
+    assert tree_depth(256, 8) == 3
+    # The acceptance gate's shape: tree wins ≥4× at 256+ ranks.
+    for world in (256, 1024, 4096):
+        assert flat_hops(world) / tree_hops(world, 8) >= 4.0, world
+    # Monotone: hops grow ~log in world, flat grows linearly.
+    assert tree_hops(4096, 8) < 2 * tree_hops(256, 8)
+
+
+def _run_world(store_factory, world, fanout, body):
+    out = [None] * world
+    errs = []
+
+    def run(i, st):
+        try:
+            out[i] = body(TreeComm(st, i, world, fanout=fanout), i)
+        except Exception as e:  # noqa: BLE001 — surfaced below
+            errs.append((i, e))
+
+    stores = [store_factory() for _ in range(world)]
+    try:
+        threads = [
+            threading.Thread(target=run, args=(i, stores[i]))
+            for i in range(world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+    finally:
+        for s in stores:
+            s.close()
+    assert not errs, errs
+    return out
+
+
+@pytest.mark.parametrize("world,fanout", [(5, 2), (9, 2), (13, 3)])
+def test_tree_barrier_releases_everyone(kv_server, world, fanout):
+    def factory():
+        return CoordStore("127.0.0.1", kv_server.port, timeout=30.0,
+                          prefix="t/")
+
+    def body(tc, i):
+        r1 = tc.barrier("b", timeout=30.0)
+        r2 = tc.barrier("b", timeout=30.0)  # reentrant: fixed keys, new round
+        return (r1, r2)
+
+    out = _run_world(factory, world, fanout, body)
+    assert all(o == (1, 2) for o in out), out
+
+
+def test_tree_all_gather_matches_flat_contract(kv_server):
+    world, fanout = 9, 2
+
+    def factory():
+        return CoordStore("127.0.0.1", kv_server.port, timeout=30.0,
+                          prefix="g/")
+
+    def body(tc, i):
+        a = tc.all_gather({"rank": i, "blob": b"v" * (i + 1)}, tag="ag",
+                          timeout=30.0)
+        b = tc.all_gather(i * 3, tag="ag", timeout=30.0)  # second round
+        return (a, b)
+
+    out = _run_world(factory, world, fanout, body)
+    expect_a = [{"rank": i, "blob": b"v" * (i + 1)} for i in range(world)]
+    expect_b = [i * 3 for i in range(world)]
+    for a, b in out:
+        assert a == expect_a
+        assert b == expect_b
+    # Round keys were GC'd by the root after the ack fan-in.
+    probe = CoordStore("127.0.0.1", kv_server.port, timeout=5.0)
+    try:
+        assert probe.client.keys("g/ag/") == []
+    finally:
+        probe.close()
+
+
+def test_tree_over_sharded_clique():
+    """The compounding case: edges hash across shards; every shard serves a
+    slice of the round and the result still matches the flat contract."""
+    clique = LocalClique(3)
+    try:
+        world, fanout = 9, 2
+
+        def body(tc, i):
+            tc.barrier("b", timeout=30.0)
+            return tc.all_gather(i, tag="ag", timeout=30.0)
+
+        out = _run_world(lambda: clique.client(prefix="t/"), world, fanout, body)
+        assert all(o == list(range(world)) for o in out)
+        # The round's ops actually spread: more than one shard saw writes.
+        touched = sum(1 for srv in clique.servers if srv._version_clock > 0)
+        assert touched >= 2, "tree edges all hashed to one shard"
+    finally:
+        clique.close()
+
+
+def test_tree_barrier_timeout_is_fatal(kv_server):
+    """A missing member starves its ancestors: everyone who waits surfaces
+    BarrierTimeout, the flat contract."""
+    world, fanout = 5, 2
+    stores = [
+        CoordStore("127.0.0.1", kv_server.port, timeout=30.0, prefix="to/")
+        for _ in range(world)
+    ]
+    errs = []
+
+    def run(i):
+        tc = TreeComm(stores[i], i, world, fanout=fanout)
+        try:
+            tc.barrier("b", timeout=0.6)
+        except BarrierTimeout:
+            errs.append(i)
+
+    try:
+        # Leaf 3 never joins: its parent 1 starves on the up edge, the root
+        # starves on 1, and leaves 2/4 starve on the release that never comes.
+        threads = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(world) if i != 3
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10.0)
+    finally:
+        for s in stores:
+            s.close()
+    # Everyone blocked on 3's subtree (or on the release that never came)
+    # timed out; nobody hung.
+    assert sorted(errs) == [0, 1, 2, 4]
+
+
+def test_storecomm_switches_shapes_on_world_floor(kv_server):
+    from tpu_resiliency.checkpoint.comm import StoreComm
+
+    def factory():
+        return CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+
+    # Below the floor: flat path (no TreeComm constructed).
+    st = factory()
+    try:
+        flat = StoreComm(st, 0, [0, 1, 2], tree_min_world=17)
+        assert flat._tree is None
+        forced = StoreComm(st, 0, [0, 1, 2], tree_min_world=2, tree_fanout=2)
+        assert forced._tree is not None
+        assert forced._tree.world == 3
+    finally:
+        st.close()
+
+    # Forced-tree StoreComm produces the flat all_gather's exact result.
+    world = 9
+    results = [None] * world
+    stores = [factory() for _ in range(world)]
+
+    def run(i):
+        comm = StoreComm(stores[i], i, list(range(world)), timeout=30.0,
+                         tree_min_world=2, tree_fanout=2)
+        comm.barrier("b", timeout=30.0)
+        results[i] = comm.all_gather((i, b"x" * i), tag="ag")
+        assert comm.all_reduce_max(i, tag="mx") == world - 1
+
+    try:
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+    finally:
+        for s in stores:
+            s.close()
+    expect = [(i, b"x" * i) for i in range(world)]
+    assert all(r == expect for r in results), results
+
+
+def test_env_knobs_respected(kv_server, monkeypatch):
+    from tpu_resiliency.checkpoint.comm import StoreComm
+
+    monkeypatch.setenv(treecomm.TREE_MIN_ENV, "4")
+    monkeypatch.setenv(treecomm.TREE_FANOUT_ENV, "3")
+    st = CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+    try:
+        comm = StoreComm(st, 0, [0, 1, 2, 3])
+        assert comm._tree is not None
+        assert comm._tree.fanout == 3
+        small = StoreComm(st, 0, [0, 1, 2])
+        assert small._tree is None
+    finally:
+        st.close()

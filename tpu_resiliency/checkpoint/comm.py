@@ -92,6 +92,8 @@ class StoreComm:
         ranks: list[int],
         timeout: float = 300.0,
         generation: int = 0,
+        tree_fanout: Optional[int] = None,
+        tree_min_world: Optional[int] = None,
     ):
         if rank not in ranks:
             raise ValueError(f"rank {rank} not in group {ranks}")
@@ -107,6 +109,33 @@ class StoreComm:
         self.ranks = sorted(ranks)
         self.timeout = timeout
         self._rounds: dict[str, int] = {}
+        # Tree collectives above a world-size floor (platform/treecomm.py):
+        # the flat shapes put O(world) work on one store event loop per round;
+        # the tree's critical path is O(fanout · log_fanout world) and its
+        # edge keys hash across a sharded clique. Small groups stay flat —
+        # fewer round trips, and identical behavior to every pre-tree build.
+        # Every member MUST resolve the same fanout/floor (the env pair is
+        # launcher-exported, same as the store address).
+        from tpu_resiliency.platform import treecomm
+
+        self.tree_fanout = int(
+            tree_fanout
+            if tree_fanout is not None
+            else os.environ.get(treecomm.TREE_FANOUT_ENV, treecomm.DEFAULT_FANOUT)
+        )
+        self.tree_min_world = int(
+            tree_min_world
+            if tree_min_world is not None
+            else os.environ.get(treecomm.TREE_MIN_ENV, treecomm.DEFAULT_TREE_MIN)
+        )
+        self._tree: Optional[treecomm.TreeComm] = None
+        if len(self.ranks) >= self.tree_min_world:
+            self._tree = treecomm.TreeComm(
+                self.store.scoped("tree"),
+                self.ranks.index(rank),
+                len(self.ranks),
+                fanout=self.tree_fanout,
+            )
 
     @property
     def world_size(self) -> int:
@@ -122,19 +151,30 @@ class StoreComm:
         return r
 
     def barrier(self, tag: str = "barrier", timeout: Optional[float] = None) -> None:
+        if self._tree is not None:
+            self._tree.barrier(tag, timeout or self.timeout)
+            return
         self.store.barrier_join(tag, self.rank, self.world_size, timeout or self.timeout)
 
     def all_gather(self, obj: Any, tag: str = "ag", timeout: Optional[float] = None) -> list:
         """Returns ``[obj_from_rank]`` ordered by group rank index.
 
-        Exactly one value-fetch round trip per collective: the entry barrier
-        guarantees every member's value is set, so a single server-side
-        ``prefix_get`` scan replaces N sequential polled ``get``\\ s (whose
-        round-trip latency dominated the collective at any real group size).
-        Two barriers total — entry (values complete) and exit (the leader's
-        batched ``prefix_clear`` only runs after everyone has read).
+        Flat shape (small groups): exactly one value-fetch round trip per
+        collective — the entry barrier guarantees every member's value is
+        set, so a single server-side ``prefix_get`` scan replaces N
+        sequential polled ``get``\\ s (whose round-trip latency dominated the
+        collective at any real group size). Two barriers total — entry
+        (values complete) and exit (the leader's batched ``prefix_clear``
+        only runs after everyone has read).
+
+        Tree shape (world ≥ ``tree_min_world``): fan-in/fan-out through
+        ``platform/treecomm.py`` — O(fanout · log world) critical-path hops,
+        edge keys sharded across a store clique. Same return value, same
+        ordering, same timeout-is-fatal contract.
         """
         t = timeout or self.timeout
+        if self._tree is not None:
+            return self._tree.all_gather(obj, tag=tag, timeout=t)
         r = self._round(tag)
         base = f"{tag}/{r}"
         self.store.set(f"{base}/{self.rank}", obj)

@@ -169,10 +169,10 @@ def _close_fds_except(keep: set[int]) -> None:
 
 def _monitor_loop(cfg: MonitorConfig, sock: socket.socket, main_pid: int) -> None:
     """Watcher body (grandchild process)."""
-    from tpu_resiliency.platform.store import CoordStore
+    from tpu_resiliency.platform.shardstore import connect_store
 
     try:
-        store = CoordStore(
+        store = connect_store(
             cfg.store_host,
             cfg.store_port,
             prefix=cfg.store_prefix,

@@ -171,9 +171,12 @@ class CallWrapper:
             # in-process coupling, reference ``in_job_and_in_process_example``).
             launcher_round = os.environ.get("TPU_FT_RESTART_COUNT", "0")
             prefix = f"{prefix}r{launcher_round}/"
-            from tpu_resiliency.platform.store import CoordStore
+            # Factory, not the constructor: under a launcher-hosted store
+            # CLIQUE ($TPU_RESILIENCY_STORE_SHARDS) every key must route
+            # through the same shard map the launcher's clients use.
+            from tpu_resiliency.platform.shardstore import connect_store
 
-            self.store = CoordStore(host, port, prefix=prefix)
+            self.store = connect_store(host, port, prefix=prefix)
             self.server = None
         else:
             self.store, self.server = host_store(
@@ -358,11 +361,11 @@ class CallWrapper:
         declared dead during a completion round; stand down). ``False`` — server
         reachable, job not done (transient hiccup). ``None`` — coordinator
         unreachable (genuinely lost; surface loudly)."""
-        from tpu_resiliency.platform.store import CoordStore
+        from tpu_resiliency.platform.shardstore import connect_store
 
         host, port = self._store_addr
         try:
-            probe = CoordStore(
+            probe = connect_store(
                 host, port, prefix=self._store_prefix, timeout=2.0, connect_retries=2
             )
             try:

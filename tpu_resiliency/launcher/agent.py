@@ -195,12 +195,15 @@ class ElasticAgent:
 
     def _start_telemetry(self) -> None:
         from tpu_resiliency.launcher.telemetry import PORT_FILE_NAME, TelemetryServer
-        from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore
+        from tpu_resiliency.platform.shardstore import connect_store
+        from tpu_resiliency.platform.store import AUTH_KEY_ENV
         from tpu_resiliency.utils.events import EVENTS_FILE_ENV
 
         # A dedicated store client for the snapshot pull: the server thread
-        # must not share the agent's coordination connection.
-        self._metrics_store = CoordStore(
+        # must not share the agent's coordination connection. Built by the
+        # shard-aware factory so a clique's snapshot keys are found on
+        # whichever shard they hashed to.
+        self._metrics_store = connect_store(
             self.cfg.store_host, self.cfg.store_port,
             prefix=self.cfg.metrics_push_prefix, timeout=10.0,
             auth_key=os.environ.get(AUTH_KEY_ENV) or None,

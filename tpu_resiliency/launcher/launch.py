@@ -77,6 +77,15 @@ def build_parser() -> argparse.ArgumentParser:
         "one store server never see each other's rendezvous (reference --rdzv-id)",
     )
     p.add_argument(
+        "--store-shards", type=int, default=1,
+        help="host the coordination store as a clique of N server processes "
+        "(shard 0 on the endpoint port) with the keyspace hash-partitioned "
+        "client-side (crc32(key) %% N); workers and monitors inherit the "
+        "clique via $TPU_RESILIENCY_STORE_SHARDS, and barriers/watch-parks "
+        "stay shard-local because a name hashes to one shard. 1 (default) "
+        "keeps today's single in-process server",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node convenience: host the store on an ephemeral local port "
@@ -298,20 +307,41 @@ def endpoint_is_local(host: str) -> bool:
 
 
 def host_or_connect_store(
-    endpoint: str, rdzv_id: str = "default"
-) -> tuple[CoordStore, Optional[KVServer], str, int]:
+    endpoint: str, rdzv_id: str = "default", store_shards: int = 1
+):
     """Bind the KVServer on the endpoint port when the endpoint IS this machine and
     the port is free; otherwise connect as a client.
 
     First-local-agent-hosts: deterministic on one machine; in a multi-host job only
     agents on the endpoint host ever try to bind, so remote agents cannot form an
-    isolated second store."""
+    isolated second store.
+
+    ``store_shards > 1`` hosts a **clique** instead of one in-process server:
+    N ``KVServer`` subprocesses (shard 0 on the endpoint port, the rest
+    ephemeral), the spec exported via ``$TPU_RESILIENCY_STORE_SHARDS`` for
+    every descendant and published on shard 0 under the reserved
+    ``store-clique/endpoints`` key so late joiners handed only the classic
+    endpoint reconnect as sharded clients instead of splitting the keyspace.
+    Returns ``(store, server_or_clique_or_None, client_host, port)``; the
+    store is a :class:`CoordStore` or a sharded
+    :class:`~tpu_resiliency.platform.shardstore.CliqueStore` — identical
+    ``StoreView`` surface either way."""
+    from tpu_resiliency.exceptions import StoreError
+    from tpu_resiliency.platform.shardstore import (
+        CLIQUE_KEY,
+        SHARDS_ENV,
+        SpawnedClique,
+        connect_store,
+        probe_clique_spec,
+    )
+
     host, _, port_s = endpoint.partition(":")
     port = int(port_s or "29511")
     auth_key = os.environ.get(AUTH_KEY_ENV) or None
-    server: Optional[KVServer] = None
+    server = None
     client_host = host or "127.0.0.1"
-    if endpoint_is_local(host):
+    clique_spec = os.environ.get(SHARDS_ENV, "").strip()
+    if not clique_spec and endpoint_is_local(host):
         # A live store already answering on the port (another job on this
         # shared endpoint, or an externally hosted server) means connect NOW —
         # entering the bind path would stall in its EADDRINUSE retry window
@@ -332,19 +362,63 @@ def host_or_connect_store(
         if live_host is not None:
             log.info(f"live coordination store on {live_host}:{port}; joining as client")
             client_host = live_host
+            clique_spec = probe_clique_spec(live_host, port, auth_key=auth_key)
         else:
-            try:
-                bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
-                server = KVServer(host=bind_host, port=port, auth_key=auth_key)
-                port = server.port  # resolves port 0 → the ephemeral port actually bound
-                log.info(f"hosting coordination store on :{port}")
-                client_host = "127.0.0.1"
-            except OSError:
-                client_host = "127.0.0.1"
+            if store_shards > 1:
+                try:
+                    bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
+                    adv_host = (
+                        host if host not in ("", "localhost", "0.0.0.0")
+                        else "127.0.0.1"
+                    )
+                    server = SpawnedClique(
+                        store_shards, host=bind_host, first_port=port,
+                        advertise_host=adv_host if auth_key else "127.0.0.1",
+                    )
+                    port = server.port
+                    client_host = "127.0.0.1"
+                    clique_spec = server.spec
+                    log.info(
+                        f"hosting coordination store clique "
+                        f"({store_shards} shards): {clique_spec}"
+                    )
+                except StoreError as e:
+                    log.warning(
+                        f"store clique spawn failed ({e}); falling back to a "
+                        f"single in-process server"
+                    )
+                    server = None
+            if server is None:
+                try:
+                    bind_host = "0.0.0.0" if auth_key else "127.0.0.1"
+                    server = KVServer(host=bind_host, port=port, auth_key=auth_key)
+                    port = server.port  # resolves port 0 → the ephemeral port actually bound
+                    log.info(f"hosting coordination store on :{port}")
+                    client_host = "127.0.0.1"
+                except OSError:
+                    client_host = "127.0.0.1"
+    elif not clique_spec and port != 0:
+        # Remote endpoint: one probe tells us whether it fronts a clique.
+        clique_spec = probe_clique_spec(client_host, port, auth_key=auth_key)
+    if clique_spec:
+        # Every process we spawn (agents are in-process, workers/monitors
+        # inherit the environment) must route through the same shard map.
+        os.environ[SHARDS_ENV] = clique_spec
     # rdzv_id namespaces every launcher key: two jobs sharing one store server
     # never see each other's rendezvous/agent state (reference --rdzv-id).
     prefix = STORE_PREFIX + (f"{rdzv_id}/" if rdzv_id != "default" else "")
-    store = CoordStore(client_host, port, prefix=prefix, auth_key=auth_key)
+    store = connect_store(
+        client_host, port, prefix=prefix, auth_key=auth_key, shards=clique_spec
+    )
+    if isinstance(server, SpawnedClique):
+        # Publish the spec for late joiners (raw key on shard 0 — the clique
+        # client routes CLIQUE_KEY wherever it hashes, so write it through a
+        # direct shard-0 connection).
+        shard0 = CoordStore(client_host, port, auth_key=auth_key)
+        try:
+            shard0.set(CLIQUE_KEY, clique_spec)
+        finally:
+            shard0.close()
     return store, server, client_host, port
 
 
@@ -429,7 +503,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         args.nnodes = "1"
     min_nodes, max_nodes = parse_nnodes(args.nnodes)
     store, server, store_host, store_port = host_or_connect_store(
-        args.rdzv_endpoint, rdzv_id=args.rdzv_id
+        args.rdzv_endpoint, rdzv_id=args.rdzv_id,
+        store_shards=max(1, args.store_shards),
     )
     # Cross-job registry OUTSIDE any rdzv-id namespace: which jobs are on this
     # endpoint. Powers the hosted-store teardown warning (a job-hosted server
@@ -437,7 +512,9 @@ def main(argv: Optional[list[str]] = None) -> int:
     import time as time_mod
     import uuid
 
-    jobs_reg = CoordStore(
+    from tpu_resiliency.platform.shardstore import connect_store as _connect_store
+
+    jobs_reg = _connect_store(
         store_host, store_port, prefix="launcher-jobs/",
         auth_key=os.environ.get(AUTH_KEY_ENV) or None,
     )

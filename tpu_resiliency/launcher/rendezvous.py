@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import json
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -524,15 +525,55 @@ class StoreRendezvous:
         """Active member's confirmation barrier for a fast-reused round. True
         once every active arrived; False after abandoning the round (barrier
         starved or store hiccup) — the caller re-reads state and proceeds
-        down the full ladder."""
+        down the full ladder.
+
+        Large casts confirm through a tree barrier (``platform/treecomm.py``)
+        instead of one flat server-side barrier: at 4096 agents the flat
+        round funnels every arrival and release frame through one store event
+        loop (O(N) on the release critical path); the tree's per-edge keys
+        hash across a sharded clique and cap the critical path at
+        O(fanout · log N). Small casts keep the flat barrier — identical to
+        every pre-tree build, and one op per agent is already optimal there.
+        A tree timeout abandons to the full ladder exactly like a flat one.
+        """
+        from tpu_resiliency.platform import treecomm
+
         me = self.node_id
+        active = cur["active"]
+        tree_min = int(
+            os.environ.get(treecomm.TREE_MIN_ENV, treecomm.DEFAULT_TREE_MIN)
+        )
         try:
-            self.store.barrier_join(
-                f"fastbar/{cur['round']}",
-                cur["active"].index(me),
-                len(cur["active"]),
-                self.s.fast_path_timeout,
-            )
+            if len(active) >= tree_min:
+                fanout = int(
+                    os.environ.get(
+                        treecomm.TREE_FANOUT_ENV, treecomm.DEFAULT_FANOUT
+                    )
+                )
+                tc = treecomm.TreeComm(
+                    self.store.scoped(f"fastbar-tree/{cur['round']}"),
+                    active.index(me),
+                    len(active),
+                    fanout=fanout,
+                )
+                tc.barrier("confirm", timeout=self.s.fast_path_timeout)
+                if active.index(me) == 0:
+                    # GC a LONG-finished round's tree keys (two rounds back:
+                    # clearing the just-confirmed round could delete a deep
+                    # member's release key before it parked on it).
+                    try:
+                        self.store.prefix_clear(
+                            f"fastbar-tree/{cur['round'] - 2}/"
+                        )
+                    except StoreError:
+                        pass
+            else:
+                self.store.barrier_join(
+                    f"fastbar/{cur['round']}",
+                    active.index(me),
+                    len(active),
+                    self.s.fast_path_timeout,
+                )
             return True
         except (BarrierTimeout, StoreError) as e:
             log.warning(
@@ -635,9 +676,11 @@ class RestartWatcher:
         self._thread.start()
 
     def _run(self) -> None:
+        from tpu_resiliency.platform.shardstore import connect_store
+
         store = None
         try:
-            store = CoordStore(
+            store = connect_store(
                 self._host, self._port, prefix=self._prefix,
                 auth_key=self._auth_key, connect_retries=2,
             )

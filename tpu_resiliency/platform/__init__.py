@@ -6,6 +6,12 @@ from tpu_resiliency.platform.store import (
     host_store,
     store_addr_from_env,
 )
+from tpu_resiliency.platform.shardstore import (
+    CliqueStore,
+    ShardedKVClient,
+    connect_store,
+)
+from tpu_resiliency.platform.treecomm import TreeComm
 from tpu_resiliency.platform.device import (
     Topology,
     DeviceInfo,
@@ -26,6 +32,10 @@ __all__ = [
     "KVClient",
     "KVServer",
     "StoreView",
+    "CliqueStore",
+    "ShardedKVClient",
+    "TreeComm",
+    "connect_store",
     "host_store",
     "store_addr_from_env",
     "Topology",

@@ -1,0 +1,522 @@
+"""Sharded coordination-store clique: one keyspace over N server processes.
+
+One :class:`~tpu_resiliency.platform.store.KVServer` is a single-threaded
+event loop — by design (no locks, parked continuations instead of blocked
+threads), and measured flat in *connection* count, but its op throughput is
+one core's dict-op rate. At 4096 ranks every subsystem's traffic (rendezvous
+CAS, barrier storms, heartbeat touches, metrics pushes, reshard
+holder-gathers) funnels through that one loop and queue wait dominates —
+``BENCH_store_baseline.json``'s 37 µs → 3.3 ms p50 curve from 1 → 64 clients
+is that funnel.
+
+This module scales the plane *horizontally* without touching the wire
+protocol or the server: a **clique** of ordinary ``KVServer`` processes plus
+a client-side deterministic key→shard map. :class:`ShardedKVClient` exposes
+the exact :class:`~tpu_resiliency.platform.store.KVClient` surface;
+single-key ops route by ``crc32(key) % nshards`` (stable across processes
+and Python runs — never ``hash()``, which is salted per process), and the
+prefix/scan ops fan out to every shard and merge. Three properties make the
+layering safe with zero server changes:
+
+- **Barriers and parks are shard-local by construction**: a barrier name, a
+  watched key, and a parked ``get`` all hash to exactly one shard, so the
+  server-side wait/notify machinery never spans shards.
+- **The at-most-once dedup ladder is per shard for free**: each shard is
+  served by its own underlying ``KVClient``, whose ``req_id`` nonces and
+  retry budget apply against that shard's dedup LRU; a retry can only replay
+  against the shard that saw the original.
+- **Circuit breakers are per endpoint already** (keyed ``(host, port)`` in
+  ``platform/store.py``), so one dead shard fails fast without poisoning the
+  others' budgets.
+
+A 1-shard clique degenerates to today's layout exactly — same keys, same
+server, one persistent connection — which is the version-skew contract
+``tests/platform/test_store_skew.py`` pins.
+
+Discovery: the launcher exports ``$TPU_RESILIENCY_STORE_SHARDS`` as a
+comma-separated ``host:port`` list (shard order IS the hash order — every
+client must see the identical list); :func:`connect_store` honors it and
+falls back to the classic single-endpoint env pair.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib
+from typing import Any, Iterable, Optional
+
+from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.platform.store import (
+    AUTH_KEY_ENV,
+    KVClient,
+    KVServer,
+    StoreView,
+    store_answers,
+)
+from tpu_resiliency.utils.logging import get_logger
+
+log = get_logger(__name__)
+
+SHARDS_ENV = "TPU_RESILIENCY_STORE_SHARDS"
+
+#: Reserved raw key on shard 0 where a clique's spawner publishes the full
+#: endpoint list. A client handed only the classic ``host:port`` endpoint
+#: (another agent, a diagnostic tool) probes it once and, if present,
+#: reconnects as a sharded client — late joiners cannot split the keyspace
+#: by talking to shard 0 alone.
+CLIQUE_KEY = "store-clique/endpoints"
+
+#: keyspace-hash identity carried in every aggregated stats doc — a client
+#: and a doc reader disagreeing on the hash would mis-attribute per-shard load
+SHARD_HASH = "crc32"
+
+
+def shard_of(key: str, nshards: int) -> int:
+    """Deterministic key→shard index. ``crc32`` of the UTF-8 key: stable
+    across processes, runs, and machines (``hash()`` is per-process salted
+    and would scatter one job's clients across disagreeing maps)."""
+    if nshards <= 1:
+        return 0
+    return zlib.crc32(key.encode("utf-8", "surrogatepass")) % nshards
+
+
+def parse_endpoints(spec: str) -> list[tuple[str, int]]:
+    """``"host:port,host:port"`` → ``[(host, port), ...]`` (shard order)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port_s = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port_s)))
+    if not out:
+        raise ValueError(f"no endpoints in shard spec {spec!r}")
+    return out
+
+
+def format_endpoints(endpoints: Iterable[tuple[str, int]]) -> str:
+    return ",".join(f"{h}:{p}" for h, p in endpoints)
+
+
+class ShardedKVClient:
+    """Drop-in :class:`KVClient` over a store clique.
+
+    Single-key ops route by :func:`shard_of`; prefix/scan/census ops fan out
+    serially to every shard and merge (shard count is small — 2..16 — and a
+    serial fan-out keeps the result deterministic and the error handling the
+    caller already knows: the first shard's transport failure surfaces after
+    ITS OWN retry budget and breaker, not a combined one). Thread-safe to the
+    same degree as ``KVClient`` (each underlying client locks its own
+    persistent socket).
+    """
+
+    def __init__(
+        self,
+        endpoints: list[tuple[str, int]],
+        timeout: float = 300.0,
+        connect_retries: int = 60,
+        auth_key: str | None = None,
+        retry_budget: float = 8.0,
+    ):
+        if not endpoints:
+            raise ValueError("ShardedKVClient needs at least one endpoint")
+        self.endpoints = [tuple(e) for e in endpoints]
+        self.default_timeout = timeout
+        self._connect_retries = connect_retries
+        self._retry_budget = retry_budget
+        # Per-shard clients are built LAZILY on first use: a clique client
+        # must stay constructible while one shard is down (diagnostics
+        # against a degraded clique, ops that never touch the dead shard).
+        # The failure surfaces on the op that actually needs the shard —
+        # after that shard's own connect ladder/breaker — and a later op
+        # retries construction, so a restarted shard is picked up in place.
+        self._shards: list[Optional[KVClient]] = [None] * len(self.endpoints)
+        self._shards_lock = threading.Lock()
+        self._closed = False
+        # Single-endpoint compatibility surface (diagnostics, logs).
+        self.host, self.port = self.endpoints[0]
+        if auth_key is None:
+            auth_key = os.environ.get(AUTH_KEY_ENV) or None
+        self.auth_key = auth_key
+
+    @property
+    def nshards(self) -> int:
+        return len(self._shards)
+
+    def _shard(self, i: int) -> KVClient:
+        s = self._shards[i]
+        if s is not None:
+            return s
+        with self._shards_lock:
+            if self._closed:
+                raise StoreError("store client is closed")
+            s = self._shards[i]
+            if s is None:
+                h, p = self.endpoints[i]
+                s = self._shards[i] = KVClient(
+                    h, p, timeout=self.default_timeout,
+                    connect_retries=self._connect_retries,
+                    auth_key=self.auth_key, retry_budget=self._retry_budget,
+                )
+        return s
+
+    def _for(self, key: str) -> KVClient:
+        return self._shard(shard_of(key, len(self._shards)))
+
+    def _live_shards(self) -> list[KVClient]:
+        return [self._shard(i) for i in range(len(self.endpoints))]
+
+    def close(self) -> None:
+        with self._shards_lock:
+            self._closed = True
+            shards, self._shards = self._shards, [None] * len(self.endpoints)
+        for s in shards:
+            if s is None:
+                continue
+            try:
+                s.close()
+            except Exception:
+                pass
+
+    # -- keyed ops (route by hash) ----------------------------------------
+
+    def set(self, key: str, value: Any) -> None:
+        self._for(key).set(key, value)
+
+    def get(self, key: str, timeout: float | None = None) -> Any:
+        return self._for(key).get(key, timeout)
+
+    def try_get(self, key: str, default: Any = None) -> Any:
+        return self._for(key).try_get(key, default)
+
+    def delete(self, key: str) -> bool:
+        return self._for(key).delete(key)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._for(key).add(key, amount)
+
+    def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
+        return self._for(key).compare_set(key, expected, desired)
+
+    def get_versioned(self, key: str) -> tuple[Any, int]:
+        return self._for(key).get_versioned(key)
+
+    def wait_changed(
+        self, key: str, seen_version: int, timeout: float
+    ) -> tuple[bool, Any, int]:
+        return self._for(key).wait_changed(key, seen_version, timeout)
+
+    def touch(self, key: str) -> None:
+        self._for(key).touch(key)
+
+    def list_append(self, key: str, value: Any) -> None:
+        self._for(key).list_append(key, value)
+
+    def list_get(self, key: str) -> list:
+        return self._for(key).list_get(key)
+
+    def list_clear(self, key: str) -> None:
+        self._for(key).list_clear(key)
+
+    def set_add(self, key: str, values: Iterable) -> int:
+        return self._for(key).set_add(key, values)
+
+    def set_get(self, key: str) -> set:
+        return self._for(key).set_get(key)
+
+    def barrier_join(
+        self,
+        name: str,
+        rank: int,
+        world_size: int,
+        timeout: float,
+        wait: bool = True,
+        on_behalf: bool = False,
+    ) -> Optional[int]:
+        # A barrier name hashes to ONE shard, so arrivals, parks, proxy joins
+        # and the dedup of retried joins all stay on that shard's loop.
+        return self._for(name).barrier_join(
+            name, rank, world_size, timeout, wait, on_behalf
+        )
+
+    def barrier_status(self, name: str) -> Optional[dict]:
+        return self._for(name).barrier_status(name)
+
+    def barrier_del(self, name: str) -> bool:
+        return self._for(name).barrier_del(name)
+
+    # -- fan-out ops (merge across shards) ---------------------------------
+
+    def ping(self) -> bool:
+        return all(s.ping() for s in self._live_shards())
+
+    def check(self, keys: Iterable[str]) -> bool:
+        by_shard: dict[int, list[str]] = {}
+        for k in keys:
+            by_shard.setdefault(shard_of(k, len(self._shards)), []).append(k)
+        return all(
+            self._shard(i).check(ks) for i, ks in sorted(by_shard.items())
+        )
+
+    def prefix_get(self, prefix: str) -> dict[str, Any]:
+        out: dict[str, Any] = {}
+        for s in self._live_shards():
+            out.update(s.prefix_get(prefix))  # shards hold disjoint keys
+        return out
+
+    def prefix_clear(self, prefix: str) -> int:
+        return sum(s.prefix_clear(prefix) for s in self._live_shards())
+
+    def stale_keys(self, prefix: str, max_age: float) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for s in self._live_shards():
+            out.update(s.stale_keys(prefix, max_age))
+        return out
+
+    def num_keys(self) -> int:
+        return sum(s.num_keys() for s in self._live_shards())
+
+    def keys(self, prefix: str = "") -> list[str]:
+        out: list[str] = []
+        for s in self._live_shards():
+            out.extend(s.keys(prefix))
+        return sorted(out)
+
+    def barrier_names(self) -> list[str]:
+        out: list[str] = []
+        for s in self._live_shards():
+            out.extend(s.barrier_names())
+        return sorted(out)
+
+    def barrier_census(self, prefix: str = "") -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for s in self._live_shards():
+            out.update(s.barrier_census(prefix))
+        return out
+
+    def store_stats(self) -> dict:
+        """One aggregated ``tpu-store-stats-1`` document for the whole clique
+        (op/byte/conn totals summed, quantiles worst-shard — see
+        :func:`tpu_resiliency.utils.opstats.merge_stats_docs`), with the shard
+        map and a per-shard summary table folded in. A single-shard clique
+        returns the shard's own document plus the (degenerate) shard map, so
+        readers see one schema either way."""
+        from tpu_resiliency.utils.opstats import merge_stats_docs
+
+        docs = []
+        for i, (h, p) in enumerate(self.endpoints):
+            try:
+                doc = self._shard(i).store_stats()
+            except StoreError as e:
+                # One sick shard degrades its row, never the whole document.
+                doc = {"enabled": False, "error": repr(e)}
+            doc["endpoint"] = f"{h}:{p}"
+            docs.append(doc)
+        merged = merge_stats_docs(docs)
+        merged["shard_map"] = {
+            "nshards": len(self._shards),
+            "hash": SHARD_HASH,
+            "endpoints": [f"{h}:{p}" for h, p in self.endpoints],
+        }
+        return merged
+
+
+class CliqueStore(StoreView):
+    """A :class:`StoreView` that owns a :class:`ShardedKVClient` — the
+    sharded sibling of :class:`~tpu_resiliency.platform.store.CoordStore`."""
+
+    def __init__(
+        self,
+        endpoints: list[tuple[str, int]],
+        prefix: str = "",
+        timeout: float = 300.0,
+        connect_retries: int = 60,
+        auth_key: str | None = None,
+        retry_budget: float = 8.0,
+    ):
+        client = ShardedKVClient(
+            endpoints, timeout=timeout, connect_retries=connect_retries,
+            auth_key=auth_key, retry_budget=retry_budget,
+        )
+        super().__init__(client, prefix)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def endpoints_from_env() -> Optional[list[tuple[str, int]]]:
+    """The clique advertised by ``$TPU_RESILIENCY_STORE_SHARDS`` (the
+    launcher's export), or ``None`` when unset/single-endpoint-classic."""
+    spec = os.environ.get(SHARDS_ENV, "").strip()
+    if not spec:
+        return None
+    return parse_endpoints(spec)
+
+
+def connect_store(
+    host: str,
+    port: int,
+    prefix: str = "",
+    *,
+    shards: str = "",
+    timeout: float = 300.0,
+    connect_retries: int = 60,
+    auth_key: str | None = None,
+    retry_budget: float = 8.0,
+):
+    """Store-client factory every plane shares: a ``shards`` spec (argument,
+    else ``$TPU_RESILIENCY_STORE_SHARDS``) yields a :class:`CliqueStore`;
+    otherwise the classic single-endpoint
+    :class:`~tpu_resiliency.platform.store.CoordStore`. Components that take
+    ``(host, port)`` today migrate by calling this instead of the
+    constructor — no signature churn."""
+    from tpu_resiliency.platform.store import CoordStore
+
+    eps = parse_endpoints(shards) if shards else endpoints_from_env()
+    if eps and len(eps) > 1:
+        return CliqueStore(
+            eps, prefix=prefix, timeout=timeout,
+            connect_retries=connect_retries, auth_key=auth_key,
+            retry_budget=retry_budget,
+        )
+    if eps:  # single-shard clique spec: classic layout at that endpoint
+        host, port = eps[0]
+    return CoordStore(
+        host, port, prefix=prefix, timeout=timeout,
+        connect_retries=connect_retries, auth_key=auth_key,
+        retry_budget=retry_budget,
+    )
+
+
+def probe_clique_spec(
+    host: str, port: int, auth_key: str | None = None, timeout: float = 2.0
+) -> str:
+    """One cheap round trip against a live endpoint: the clique spec its
+    spawner published under :data:`CLIQUE_KEY`, or ``""`` (plain store,
+    pre-shard server, or any failure — callers fall back to classic mode)."""
+    try:
+        c = KVClient(
+            host, port, timeout=timeout, connect_retries=1,
+            auth_key=auth_key, retry_budget=0.0,
+        )
+    except StoreError:
+        return ""
+    try:
+        spec = c.try_get(CLIQUE_KEY, "")
+        return spec if isinstance(spec, str) else ""
+    except StoreError:
+        return ""
+    finally:
+        c.close()
+
+
+class LocalClique:
+    """N in-process :class:`KVServer` loops — the test/chaos harness shape
+    (each server still owns its own selector thread and state; only the
+    bench's subprocess clique buys real per-core parallelism)."""
+
+    def __init__(self, nshards: int, host: str = "127.0.0.1", **server_kw):
+        self.servers = [
+            KVServer(host=host, port=0, **server_kw) for _ in range(nshards)
+        ]
+        self.endpoints = [(host, s.port) for s in self.servers]
+
+    @property
+    def spec(self) -> str:
+        return format_endpoints(self.endpoints)
+
+    def client(self, prefix: str = "", **kw) -> CliqueStore:
+        return CliqueStore(self.endpoints, prefix=prefix, **kw)
+
+    def close(self) -> None:
+        for s in self.servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+
+
+class SpawnedClique:
+    """N ``KVServer`` *processes* (``python -m tpu_resiliency.platform.store``)
+    — the deployment shape: each shard's event loop owns a core. Used by the
+    launcher's ``--store-shards`` and the scale bench. Shard 0 may bind a
+    fixed port (the job's rendezvous endpoint); the rest take ephemeral ports
+    read back from the child's banner line."""
+
+    def __init__(
+        self,
+        nshards: int,
+        host: str = "127.0.0.1",
+        first_port: int = 0,
+        spawn_timeout: float = 20.0,
+        advertise_host: str | None = None,
+    ):
+        # ``host`` is the BIND address (0.0.0.0 for authenticated multi-host
+        # cliques); ``advertise_host`` is what lands in the published spec —
+        # the address peers dial. Liveness probes always go over loopback
+        # (we spawned the children on this machine).
+        self.procs: list[subprocess.Popen] = []
+        self.endpoints: list[tuple[str, int]] = []
+        adv = advertise_host or ("127.0.0.1" if host in ("127.0.0.1", "") else host)
+        if adv == "0.0.0.0":
+            adv = "127.0.0.1"
+        env = dict(os.environ)
+        try:
+            for i in range(nshards):
+                port = first_port if i == 0 else 0
+                p = subprocess.Popen(
+                    [sys.executable, "-m", "tpu_resiliency.platform.store",
+                     f"{host}:{port}"],
+                    stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                    text=True, env=env,
+                )
+                self.procs.append(p)
+                banner = p.stdout.readline().strip()
+                # "store serving on HOST:PORT"
+                try:
+                    bound = int(banner.rsplit(":", 1)[1])
+                except (IndexError, ValueError):
+                    raise StoreError(
+                        f"store shard {i} failed to start (banner {banner!r})"
+                    )
+                self.endpoints.append((adv, bound))
+            deadline = time.monotonic() + spawn_timeout
+            for _, bound in self.endpoints:
+                while not store_answers("127.0.0.1", bound, timeout=1.0):
+                    if time.monotonic() >= deadline:
+                        raise StoreError(
+                            f"store shard 127.0.0.1:{bound} never answered ping"
+                        )
+                    time.sleep(0.05)
+        except BaseException:
+            self.close()
+            raise
+
+    @property
+    def spec(self) -> str:
+        return format_endpoints(self.endpoints)
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[0][1]
+
+    def client(self, prefix: str = "", **kw) -> CliqueStore:
+        return CliqueStore(self.endpoints, prefix=prefix, **kw)
+
+    def close(self, join: bool = True, timeout: float = 5.0) -> None:
+        for p in self.procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        if join:
+            for p in self.procs:
+                try:
+                    p.wait(timeout)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout)

@@ -63,6 +63,11 @@ log = get_logger(__name__)
 
 AUTH_KEY_ENV = "TPU_RESILIENCY_STORE_KEY"
 
+#: Serving-backend identity reported by ``store_stats`` (``backend`` field).
+#: The thread-per-connection ancestor predates the field, so readers map a
+#: missing field to ``"threaded"`` — version-skew stays one `.get()` away.
+BACKEND = "epoll"
+
 # Ops whose server-side wait can exceed this run on a dedicated one-shot connection so
 # they never hold the persistent socket's lock across a long block.
 _BLOCKING_THRESHOLD_S = 5.0
@@ -1136,6 +1141,11 @@ class KVServer:
         instrument the perf work is judged with, so it must answer even when
         it has nothing to say."""
         base = {
+            # Serving-backend identity for skew-aware tooling: this server is
+            # the selectors event loop; a document with NO backend field is a
+            # pre-epoll (thread-per-connection) build — tpu-store-info renders
+            # the absence as "threaded".
+            "backend": BACKEND,
             "conns": len(self._conns),
             "parked": len(self._parked),
             "barriers_open": sum(

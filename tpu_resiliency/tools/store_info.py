@@ -20,8 +20,12 @@ Usage::
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stale hb/ --max-age 10
     # live blocked-collective census: arrived/missing/absent + waiter ages
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --barriers
-    # live op telemetry: per-op latency, hot prefixes, park depth, dedup rate
+    # live op telemetry: serving backend, per-op latency, hot prefixes,
+    # park depth, dedup rate; against a clique: shard map + per-shard totals
     python -m tpu_resiliency.tools.store_info 127.0.0.1:29511 --stats
+    # explicit shard list (or let a single endpoint auto-expand from the
+    # clique's published store-clique/endpoints key)
+    python -m tpu_resiliency.tools.store_info 127.0.0.1:29511,127.0.0.1:29512 --stats
 """
 
 from __future__ import annotations
@@ -127,10 +131,13 @@ def _fmt_bytes(n: float) -> str:
 
 def report_stats(client: KVClient, out=None) -> int:
     """Render the live ``store_stats`` document (``tpu-store-stats-1``): the
-    per-op latency table (queue wait vs handle split), hot key prefixes,
-    connection/park/dedup state. Returns an exit code: 1 when the server
-    predates the op (version skew — the error is one round trip, never a
-    retry budget) or runs with stats disabled."""
+    serving backend (``epoll``; a pre-epoll thread-per-connection server has
+    no field and renders ``threaded``), the shard map and per-shard op totals
+    when the endpoint is a clique (the document is then the AGGREGATE across
+    shards, quantiles worst-shard), the per-op latency table (queue wait vs
+    handle split), hot key prefixes, connection/park/dedup state. Returns an
+    exit code: 1 when the server predates the op (version skew — the error
+    is one round trip, never a retry budget) or runs with stats disabled."""
     out = sys.stdout if out is None else out
     try:
         doc = client.store_stats()
@@ -150,6 +157,16 @@ def report_stats(client: KVClient, out=None) -> int:
         return 1
     b = doc.get("bytes") or {}
     dd = doc.get("dedup") or {}
+    smap = doc.get("shard_map") or {}
+    backend = doc.get("backend", "threaded")
+    if smap:
+        print(
+            f"backend: {backend}   shards: {smap.get('nshards')} "
+            f"({smap.get('hash')} keyspace hash; quantiles are worst-shard)",
+            file=out,
+        )
+    else:
+        print(f"backend: {backend}", file=out)
     print(
         f"store stats (up {doc.get('uptime_s', 0):.0f}s): "
         f"conns {doc.get('conns', 0)} live / {doc.get('conns_peak', 0)} peak "
@@ -157,6 +174,25 @@ def report_stats(client: KVClient, out=None) -> int:
         f"open barriers {doc.get('barriers_open', 0)}   keys {doc.get('keys', 0)}",
         file=out,
     )
+    shards = doc.get("shards") or []
+    if shards:
+        print("per-shard op totals:", file=out)
+        print(
+            f"    {'endpoint':<22} {'backend':<10} {'ops':>10} {'err':>6} "
+            f"{'bytes in':>10} {'bytes out':>10} {'conns':>6} {'keys':>8}",
+            file=out,
+        )
+        for row in shards:
+            print(
+                f"    {row.get('endpoint', '?'):<22} "
+                f"{row.get('backend', '?'):<10} "
+                f"{row.get('ops_total', 0):>10} "
+                f"{row.get('errors_total', 0):>6} "
+                f"{_fmt_bytes(row.get('bytes_in', 0)):>10} "
+                f"{_fmt_bytes(row.get('bytes_out', 0)):>10} "
+                f"{row.get('conns', 0):>6} {row.get('keys', 0):>8}",
+                file=out,
+            )
     print(
         f"bytes: in {_fmt_bytes(b.get('in', 0))}, out {_fmt_bytes(b.get('out', 0))}"
         f"   dedup: {dd.get('hits', 0)}/{dd.get('lookups', 0)} hits "
@@ -197,7 +233,18 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="Introspect a live tpu-resiliency coordination store"
     )
-    ap.add_argument("endpoint", help="HOST:PORT of the KV server")
+    ap.add_argument(
+        "endpoint",
+        help="HOST:PORT of the KV server, or a comma-separated shard list "
+        "HOST:PORT,HOST:PORT (a clique). A single endpoint that fronts a "
+        "clique is auto-expanded from its published shard map unless "
+        "--no-discover",
+    )
+    ap.add_argument(
+        "--no-discover", action="store_true",
+        help="inspect exactly the given endpoint even if it advertises a "
+        "clique (per-shard debugging)",
+    )
     ap.add_argument("--prefix", default="", help="census keys under this prefix")
     ap.add_argument(
         "--stale", metavar="PREFIX",
@@ -217,20 +264,39 @@ def main(argv: Optional[list[str]] = None) -> int:
         "unreachable, predates the op, or runs with stats disabled",
     )
     args = ap.parse_args(argv)
-    host, _, port_s = args.endpoint.partition(":")
+    from tpu_resiliency.platform.shardstore import (
+        ShardedKVClient,
+        parse_endpoints,
+        probe_clique_spec,
+    )
+
     try:
-        port = int(port_s)
+        endpoints = parse_endpoints(args.endpoint)
     except ValueError:
-        ap.error(f"want HOST:PORT, got {args.endpoint!r}")
+        ap.error(f"want HOST:PORT[,HOST:PORT...], got {args.endpoint!r}")
+    auth_key = os.environ.get(AUTH_KEY_ENV) or None
+    if len(endpoints) == 1 and not args.no_discover:
+        # One probe: does this endpoint front a clique? If so, aggregate the
+        # whole thing instead of reporting only the connected shard.
+        spec = probe_clique_spec(*endpoints[0], auth_key=auth_key)
+        if spec:
+            endpoints = parse_endpoints(spec)
+            print(f"endpoint fronts a {len(endpoints)}-shard clique: {spec}",
+                  file=sys.stderr)
     try:
         # Fail fast on a dead endpoint: a diagnostics tool must not sit in
         # the client's default 60-attempt reconnect ladder.
-        client = KVClient(
-            host or "127.0.0.1",
-            port,
-            connect_retries=3,
-            auth_key=os.environ.get(AUTH_KEY_ENV) or None,
-        )
+        if len(endpoints) > 1:
+            client = ShardedKVClient(
+                endpoints, connect_retries=3, auth_key=auth_key,
+            )
+        else:
+            client = KVClient(
+                endpoints[0][0] or "127.0.0.1",
+                endpoints[0][1],
+                connect_retries=3,
+                auth_key=auth_key,
+            )
     except StoreError as e:
         print(str(e), file=sys.stderr)
         return 1

@@ -1134,9 +1134,10 @@ class MetricsPublisher(MetricsSink):
         if self._store is None:
             # Lazy import: metrics must not pull the platform layer in at
             # module load (events -> metrics stays the dependency root path).
-            from tpu_resiliency.platform.store import AUTH_KEY_ENV, CoordStore
+            from tpu_resiliency.platform.shardstore import connect_store
+            from tpu_resiliency.platform.store import AUTH_KEY_ENV
 
-            self._store = CoordStore(
+            self._store = connect_store(
                 self._host, self._port, prefix=self._prefix,
                 timeout=10.0, connect_retries=1, retry_budget=2.0,
                 auth_key=os.environ.get(AUTH_KEY_ENV) or None,

@@ -320,3 +320,99 @@ class OpStats:
         if d_out > 0:
             out["bytes_out"] = d_out
         return out
+
+
+def merge_stats_docs(docs: list[dict]) -> dict:
+    """Fold per-shard ``tpu-store-stats-1`` documents into one clique view
+    (``ShardedKVClient.store_stats`` and ``tpu-store-info --stats`` over a
+    sharded endpoint list).
+
+    Merge algebra mirrors the mergeable metrics registry: counters sum
+    (op counts, errors, bytes, seconds, conns, dedup, keys, parked), gauges
+    take the documented extreme (``uptime_s`` max). Quantiles cannot be
+    re-derived from per-shard summaries, so the aggregate reports the
+    **worst shard** per op (``p50/p95/p99/max`` maxima) — conservative for
+    alerting, and each shard's exact document survives in the per-shard
+    ``shards`` table the callers fold in alongside. ``backend`` merges to the
+    single common value or a comma-joined set when shards disagree
+    (mid-rolling-upgrade cliques render honestly instead of guessing).
+    """
+    enabled = [d for d in docs if d.get("enabled")]
+    backends = sorted({
+        str(d.get("backend", "threaded")) for d in docs if d.get("enabled")
+    })
+    out: dict[str, Any] = {
+        "schema": SCHEMA,
+        "enabled": bool(enabled),
+        "aggregate_of": len(docs),
+        "backend": ",".join(backends) if backends else "unknown",
+        "uptime_s": max((d.get("uptime_s", 0.0) for d in enabled), default=0.0),
+        "sample": max((d.get("sample", 0) for d in enabled), default=0),
+    }
+    for counter in ("conns", "parked", "barriers_open", "keys",
+                    "dedup_entries", "conns_total", "conns_peak"):
+        out[counter] = sum(int(d.get(counter, 0) or 0) for d in docs)
+    out["bytes"] = {
+        "in": sum((d.get("bytes") or {}).get("in", 0) for d in enabled),
+        "out": sum((d.get("bytes") or {}).get("out", 0) for d in enabled),
+    }
+    hits = sum((d.get("dedup") or {}).get("hits", 0) for d in enabled)
+    lookups = sum((d.get("dedup") or {}).get("lookups", 0) for d in enabled)
+    out["dedup"] = {
+        "hits": hits, "lookups": lookups,
+        "hit_rate": round(hits / lookups, 6) if lookups else 0.0,
+    }
+    ops: dict[str, dict] = {}
+    for d in enabled:
+        for op, row in (d.get("ops") or {}).items():
+            agg = ops.setdefault(op, {
+                "count": 0, "errors": 0, "bytes_in": 0, "seconds": 0.0,
+                "handle": {"count": 0, "p50_us": 0.0, "p95_us": 0.0,
+                           "p99_us": 0.0, "max_us": 0.0},
+                "wait": {"count": 0, "p50_us": 0.0, "p95_us": 0.0,
+                         "p99_us": 0.0, "max_us": 0.0},
+            })
+            for k in ("count", "errors", "bytes_in"):
+                agg[k] += row.get(k, 0)
+            agg["seconds"] = round(agg["seconds"] + row.get("seconds", 0.0), 9)
+            for split in ("handle", "wait"):
+                src = row.get(split) or {}
+                dst = agg[split]
+                dst["count"] += src.get("count", 0)
+                for q in ("p50_us", "p95_us", "p99_us", "max_us"):
+                    dst[q] = max(dst[q], src.get(q, 0.0))
+    out["ops"] = {op: ops[op] for op in sorted(ops)}
+    hot = SpaceSaving(32)
+    for d in enabled:
+        for row in d.get("hot_prefixes") or []:
+            try:
+                hot.add(str(row["prefix"]), int(row["count"]))
+            except (KeyError, TypeError, ValueError):
+                continue
+    out["hot_prefixes"] = hot.items(top=16)
+    out["shards"] = [
+        {
+            "endpoint": d.get("endpoint", f"#{i}"),
+            "enabled": bool(d.get("enabled")),
+            # A doc with neither backend nor live conn counts never came from
+            # a server at all (transport failure row); a reachable pre-epoll
+            # server simply lacks the field.
+            "backend": "unreachable"
+            if "backend" not in d and "conns" not in d
+            else str(d.get("backend", "threaded")),
+            "ops_total": sum(
+                r.get("count", 0) for r in (d.get("ops") or {}).values()
+            ),
+            "errors_total": sum(
+                r.get("errors", 0) for r in (d.get("ops") or {}).values()
+            ),
+            "bytes_in": (d.get("bytes") or {}).get("in", 0),
+            "bytes_out": (d.get("bytes") or {}).get("out", 0),
+            "conns": d.get("conns", 0),
+            "parked": d.get("parked", 0),
+            "keys": d.get("keys", 0),
+            **({"error": d["error"]} if d.get("error") else {}),
+        }
+        for i, d in enumerate(docs)
+    ]
+    return out
