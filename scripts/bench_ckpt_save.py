@@ -70,8 +70,30 @@ def make_tree(mb: int, seed: float):
     return tree
 
 
-def bench_clique(world: int, mb: int, rounds: int, pipelined: bool, root: str):
-    """Per-round (foreground_s, e2e_s) as max across ranks; returns medians."""
+def _touch_tree(tree, it):
+    """A steady-state step's worth of mutation: one small slice of the first
+    parameter leaf moves, everything else is byte-identical — the shape the
+    delta chunk-diff exploits."""
+    import jax.numpy as jnp
+
+    params = dict(tree["params"])
+    first = sorted(params)[0]
+    leaf = params[first]
+    params[first] = jnp.concatenate(
+        [jnp.full((64,), float(it), leaf.dtype), leaf[64:]]
+    )
+    return {"params": params, "step": it}
+
+
+def bench_clique(
+    world: int, mb: int, rounds: int, pipelined: bool, root: str,
+    delta_interval: int = 0, mutate: bool = False,
+):
+    """Per-round (foreground_s, e2e_s) as max across ranks; returns medians.
+
+    ``delta_interval`` > 1 turns on chunk-diff replication between keyframes
+    (the steady-state byte-economy leg); ``mutate`` applies a small per-round
+    parameter update so consecutive saves differ realistically."""
     srv = KVServer(host="127.0.0.1", port=0)
     stores = []
 
@@ -91,12 +113,15 @@ def bench_clique(world: int, mb: int, rounds: int, pipelined: bool, root: str):
                 comm, ex, replication_jump=1, replication_factor=world
             )
             mgr = LocalCheckpointManager(
-                root, rank=rank, comm=comm, replication=strat, pipelined=pipelined
+                root, rank=rank, comm=comm, replication=strat,
+                pipelined=pipelined, delta_interval=delta_interval,
             )
             tree = make_tree(mb, float(rank))
             out = []
             for it in range(1, rounds + 1):
-                sd = PyTreeStateDict(dict(tree, step=it))
+                sd = PyTreeStateDict(
+                    _touch_tree(tree, it) if mutate else dict(tree, step=it)
+                )
                 comm.barrier("round-in")
                 t0 = time.perf_counter()
                 mgr.save(it, sd)
@@ -129,6 +154,40 @@ def bench_clique(world: int, mb: int, rounds: int, pipelined: bool, root: str):
         statistics.median(e2e_rounds),
         staging_stats,
     )
+
+
+def bench_delta_leg(world: int, mb: int, rounds: int, root: str) -> dict:
+    """Steady-state byte economy: the same clique save loop with
+    ``delta_interval`` on and a realistic small per-round mutation. Reports
+    the replication bytes a delta round shipped vs the full container a
+    mirror round moves (from the save path's own ``ckpt_delta`` events) plus
+    the e2e save time."""
+    from tpu_resiliency.utils import events as events_mod
+
+    seen = []
+    events_mod.add_sink(seen.append)
+    try:
+        fg, e2e, _ = bench_clique(
+            world, mb, rounds, pipelined=True, root=root,
+            delta_interval=rounds + 2, mutate=True,
+        )
+    finally:
+        events_mod.remove_sink(seen.append)
+    deltas = [e.payload for e in seen if e.kind == "ckpt_delta"]
+    applied = [e.payload for e in seen if e.kind == "ckpt_delta_applied"]
+    frame = statistics.median(d["frame_bytes"] for d in deltas)
+    full = statistics.median(d["full_bytes"] for d in deltas)
+    return {
+        "rounds_delta": len(deltas),
+        "applied_ok": sum(1 for a in applied if a["outcome"] == "ok"),
+        "fg_ms": round(fg * 1e3, 3),
+        "e2e_ms": round(e2e * 1e3, 1),
+        "frame_bytes": int(frame),
+        "full_bytes": int(full),
+        #: the ≥5x-fewer-bytes acceptance reads from here
+        "bytes_ratio": round(frame / full, 4),
+        "bytes_win": round(full / frame, 1),
+    }
 
 
 def bench_checkpointer(mb: int, root: str):
@@ -201,9 +260,16 @@ def run_smoke() -> int:
         ):
             assert metric in prom, f"{metric} missing from aggregated metrics"
         assert staging.get("hits", 0) >= 1, staging
+        # Delta steady-state leg: chunk-diff frames ship, apply cleanly, and
+        # move a fraction of the container.
+        droot = os.path.join(root, "delta")
+        delta = bench_delta_leg(2, LEAF_MB, 2, droot)
+        assert delta["rounds_delta"] >= 1, delta
+        assert delta["applied_ok"] >= 1, delta
+        assert delta["bytes_ratio"] < 0.5, delta
         print(
             f"bench_ckpt_save smoke OK: fg={fg*1e3:.2f} ms, e2e={e2e*1e3:.1f} ms, "
-            f"staging={staging}"
+            f"staging={staging}, delta_ratio={delta['bytes_ratio']}"
         )
         return 0
     finally:
@@ -237,6 +303,8 @@ def main(argv=None) -> int:
             pipe_fg, pipe_e2e, staging = bench_clique(
                 args.world, mb, args.rounds, pipelined=True, root=root_p
             )
+            root_d = os.path.join(workdir, f"delta{mb}")
+            delta = bench_delta_leg(args.world, mb, args.rounds, root_d)
             sizes.append({
                 "mb": mb,
                 "sync_fg_ms": round(sync_fg * 1e3, 3),
@@ -245,9 +313,11 @@ def main(argv=None) -> int:
                 "sync_e2e_ms": round(sync_e2e * 1e3, 1),
                 "pipelined_e2e_ms": round(pipe_e2e * 1e3, 1),
                 "staging": staging,
+                "delta": delta,
             })
             shutil.rmtree(root_s, ignore_errors=True)
             shutil.rmtree(root_p, ignore_errors=True)
+            shutil.rmtree(root_d, ignore_errors=True)
         probe_mb = min(args.mb)
         results = {
             "world": args.world,

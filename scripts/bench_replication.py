@@ -15,8 +15,20 @@ Also measures peak extra allocation of a single send→recv transfer per path
 (``tracemalloc``): the zero-copy claim is ``alloc_ratio_new ≤ 1.25`` (the
 receive buffer itself is the 1.0; everything beyond it is protocol overhead).
 
+**Byte-economy legs** (checkpoint/coding/): the same clique re-run under
+
+- **erasure** — ``ErasureReplicationStrategy`` ships one RS block per peer
+  (k = world-1, parity 1) instead of whole mirrors: the acceptance claim is
+  wire bytes per rank ≤ ``(1 + 1/k)×`` the payload vs the mirror path's
+  ``(world-1)×``;
+- **delta** — steady-state chunk-diff frames between keyframes (a seeded
+  ``--dirty-frac`` fraction of chunks mutated per round): the acceptance
+  claim is frame bytes ≤ the dirty fraction (plus manifest overhead) of a
+  full container, i.e. ≥5× fewer bytes at small dirty fractions.
+
     python scripts/bench_replication.py [--mb 256] [--world 3] [--rounds 3] \
-        [--out BENCH_replication.json]
+        [--dirty-frac 0.05] [--out BENCH_replication.json]
+    python scripts/bench_replication.py --smoke   # tiny run, assert the gates
 """
 
 import argparse
@@ -148,15 +160,192 @@ def bench_alloc(mb: int, streaming: bool) -> float:
         srv.close()
 
 
+def bench_erasure(world: int, mb: int, rounds: int) -> dict:
+    """Erasure replication round: median seconds + wire bytes per rank per
+    round (from the strategy's own ``ckpt_parity`` accounting)."""
+    from tpu_resiliency.checkpoint.coding import ErasureReplicationStrategy
+    from tpu_resiliency.utils import events as tpu_events
+
+    seen = []
+    tpu_events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=120.0)
+        stores.append(s)
+        return s
+
+    def body(rank):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=120.0)
+        ex = PeerExchange(mk(), rank, timeout=120.0)
+        ex.start()
+        try:
+            strat = ErasureReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world,
+                parity=1,
+            )
+            tensors = _payload(mb, rank)
+            times = []
+            for _ in range(rounds):
+                comm.barrier("round-in")
+                t0 = time.perf_counter()
+                prefix, views = ckpt_format.serialize_parts(b"hollow", tensors)
+                held = strat.replicate_parts([prefix, *views])
+                assert len(held) == world - 1
+                comm.barrier("round-out")
+                times.append(time.perf_counter() - t0)
+            return times
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            per_rank = [
+                f.result(timeout=600.0)
+                for f in [pool.submit(body, r) for r in range(world)]
+            ]
+    finally:
+        tpu_events.remove_sink(seen.append)
+        for s in stores:
+            s.close()
+        srv.close()
+    round_times = [max(ts) for ts in zip(*per_rank)]
+    parity = [e.payload for e in seen if e.kind == "ckpt_parity"]
+    payload = max(p["payload_bytes"] for p in parity)
+    sent = max(p["sent_bytes"] for p in parity)
+    k = parity[0]["k"]
+    return {
+        "round_s": round(sorted(round_times)[len(round_times) // 2], 4),
+        "k": k,
+        "m": parity[0]["m"],
+        "payload_bytes": payload,
+        "sent_bytes_per_rank": sent,
+        #: the acceptance ratio: wire bytes per rank / payload (mirror = world-1)
+        "payload_ratio": round(sent / payload, 4),
+        "mirror_payload_ratio": world - 1,
+    }
+
+
+def bench_delta(world: int, mb: int, rounds: int, dirty_frac: float) -> dict:
+    """Steady-state delta replication: keyframe round 0, then ``rounds``
+    chunk-diff rounds with ``dirty_frac`` of each shard's chunks mutated —
+    the exact wire path ``LocalCheckpointManager.save`` ships between
+    keyframes. Reports frame bytes vs the full container bytes a mirror
+    round moves."""
+    from tpu_resiliency.checkpoint.coding import delta as delta_mod
+
+    srv = KVServer(host="127.0.0.1", port=0)
+    stores = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=120.0)
+        stores.append(s)
+        return s
+
+    stats_out: dict = {}
+
+    def body(rank):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=120.0)
+        ex = PeerExchange(mk(), rank, timeout=120.0)
+        ex.start()
+        try:
+            strat = CliqueReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world
+            )
+            tensors = _payload(mb, rank)
+            rng = np.random.default_rng(rank + 99)
+            # Keyframe: full mirror round seeds every peer's base.
+            comm.barrier("kf-in")
+            prefix, views = ckpt_format.serialize_parts(b"hollow", tensors)
+            strat.replicate_parts([prefix, *views])
+            comm.barrier("kf-out")
+            info = ckpt_format.parse_trailer_v3(views[-1])
+            leaf_sizes = [v.nbytes for v in views[:-1]]
+            base = {
+                "iteration": 0,
+                "leaf_sizes": leaf_sizes,
+                "chunk_size": info.chunk_size,
+                "leaf_chunks": info.leaf_chunk_crcs(leaf_sizes),
+                "container_crc": info.container_crc,
+            }
+            times, frames, fulls = [], [], []
+            for it in range(1, rounds + 1):
+                cs = info.chunk_size
+                for t in tensors:  # mutate dirty_frac of each leaf's chunks
+                    nchunks = max(1, t.nbytes // cs)
+                    for c in range(nchunks):
+                        if rng.random() < dirty_frac:
+                            t[c * cs] ^= 0xFF
+                comm.barrier("d-in")
+                t0 = time.perf_counter()
+                prefix, views = ckpt_format.serialize_parts(b"hollow", tensors)
+                frame, st = delta_mod.encode_delta(
+                    rank, it, base, prefix, views[:-1], bytes(views[-1])
+                )
+                strat.replicate_parts([frame])
+                comm.barrier("d-out")
+                times.append(time.perf_counter() - t0)
+                frames.append(st["frame_bytes"])
+                fulls.append(st["full_bytes"])
+                leaf_sizes = [v.nbytes for v in views[:-1]]
+                info2 = ckpt_format.parse_trailer_v3(views[-1])
+                base = {
+                    "iteration": it,
+                    "leaf_sizes": leaf_sizes,
+                    "chunk_size": info2.chunk_size,
+                    "leaf_chunks": info2.leaf_chunk_crcs(leaf_sizes),
+                    "container_crc": info2.container_crc,
+                }
+            if rank == 0:
+                stats_out.update(
+                    frame_bytes=int(np.median(frames)),
+                    full_bytes=int(np.median(fulls)),
+                )
+            return times
+        finally:
+            ex.close()
+
+    try:
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            per_rank = [
+                f.result(timeout=600.0)
+                for f in [pool.submit(body, r) for r in range(world)]
+            ]
+    finally:
+        for s in stores:
+            s.close()
+        srv.close()
+    round_times = [max(ts) for ts in zip(*per_rank)]
+    frame_b, full_b = stats_out["frame_bytes"], stats_out["full_bytes"]
+    return {
+        "round_s": round(sorted(round_times)[len(round_times) // 2], 4),
+        "dirty_frac": dirty_frac,
+        "frame_bytes": frame_b,
+        "full_bytes": full_b,
+        #: the acceptance ratio: delta wire bytes / full-mirror wire bytes
+        "bytes_ratio": round(frame_b / full_b, 4),
+        "bytes_win": round(full_b / frame_b, 1),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mb", type=int, default=256, help="shard size per rank (MiB)")
     ap.add_argument("--world", type=int, default=3, help="clique size")
     ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--dirty-frac", type=float, default=0.05,
+                    help="fraction of chunks mutated per delta round")
     ap.add_argument("--alloc-mb", type=int, default=None,
                     help="payload for the allocation probe (default: --mb)")
     ap.add_argument("--out", default=None, help="write results JSON here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny run asserting the byte-economy gates, exit 0/1")
     args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.mb, args.world, args.rounds = 8, 3, 2
+        args.alloc_mb = 2
 
     # Bytes exchanged per round: every rank sends its shard to world-1 peers.
     exchanged = args.world * (args.world - 1) * args.mb * (1 << 20)
@@ -166,6 +355,8 @@ def main(argv=None) -> int:
     alloc_mb = args.alloc_mb or args.mb
     alloc_old = bench_alloc(alloc_mb, streaming=False)
     alloc_new = bench_alloc(alloc_mb, streaming=True)
+    erasure = bench_erasure(args.world, args.mb, args.rounds)
+    delta = bench_delta(args.world, args.mb, args.rounds, args.dirty_frac)
 
     results = {
         "world": args.world,
@@ -179,6 +370,8 @@ def main(argv=None) -> int:
         "alloc_probe_mb": alloc_mb,
         "alloc_ratio_old": round(alloc_old, 3),
         "alloc_ratio_new": round(alloc_new, 3),
+        "erasure": erasure,
+        "delta": delta,
         "host": platform.node(),
         "python": platform.python_version(),
     }
@@ -187,6 +380,18 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             json.dump(results, f, indent=2)
             f.write("\n")
+    if args.smoke:
+        k = erasure["k"]
+        ok = (
+            erasure["payload_ratio"] <= (1 + 1 / k) + 0.05
+            and erasure["payload_ratio"] < erasure["mirror_payload_ratio"]
+            and delta["bytes_ratio"] < 0.5
+        )
+        print(f"bench_replication smoke: {'PASS' if ok else 'FAIL'} "
+              f"(erasure ratio {erasure['payload_ratio']} vs mirror "
+              f"{erasure['mirror_payload_ratio']}; delta ratio "
+              f"{delta['bytes_ratio']})")
+        return 0 if ok else 1
     return 0
 
 

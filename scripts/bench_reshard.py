@@ -140,9 +140,12 @@ def bench(mb: int) -> dict:
     )
 
     # -- full-mirror baseline ---------------------------------------------
-    # Same target geometry, but every source container a rank cannot serve
-    # locally is fetched WHOLE (all leaves, full ranges) before slicing —
-    # the pre-reshard shape of recovery.
+    # Same END STATE as the ranged path (the target-local tree assembled in
+    # host memory), but bytes move the way pre-reshard recovery forced:
+    # every source container a rank cannot serve locally is fetched WHOLE
+    # (all leaves, full ranges) from a holder, local sources are read WHOLE
+    # off disk, and the target blocks are then sliced out of the complete
+    # containers in memory.
     source = layout
     target = source.retarget(SURVIVORS)
     plan = R.build_plan(source, target)
@@ -159,12 +162,16 @@ def bench(mb: int) -> dict:
             held = {i.owner for i in mgr.local_ids() if i.iteration == 1}
             all_held = comm.all_gather((rank, sorted(held)), tag="bench-held")
             holders = {r: set(h) for r, h in all_held}
+            rp = plan.for_rank(rank)
             needed = set()
-            for seg in plan.for_rank(rank).segments:
+            for seg in rp.segments:
                 if not (set(seg.owners) & held):
                     needed.add(sorted(seg.owners)[0])
             t0 = time.perf_counter()
             moved = 0
+            # Whole-container sources: peer mirrors over the wire, held
+            # containers off disk (leaf payloads via full-range reads).
+            sources: dict[int, list] = {}
             for owner in sorted(needed):
                 holder = min(r for r, h in holders.items() if owner in h and r != rank)
                 full = [
@@ -176,6 +183,31 @@ def bench(mb: int) -> dict:
                     {"session": 0, "iteration": 1, "owner": owner, "ranges": full},
                 )
                 moved += sum(memoryview(p).nbytes for p in parts)
+                sources[owner] = parts
+            for seg in rp.segments:
+                owner = min(set(seg.owners) & held, default=None)
+                if owner is not None and owner not in sources:
+                    full = [
+                        [i, 0, source.local_nbytes(i, owner)]
+                        for i in range(len(source.leaves))
+                    ]
+                    sources[owner] = mgr._read_ranges(1, owner, full)
+            # Assemble the same target-local leaves the ranged path built.
+            buffers = [
+                np.empty(shape, dtype=np.float32)
+                for shape in rp.local_shapes
+            ]
+            flats = [b.reshape(-1).view(np.uint8) for b in buffers]
+            for seg in rp.segments:
+                owner = min(o for o in seg.owners if o in sources)
+                for rg in seg.ranges:
+                    leaf_buf = memoryview(sources[owner][seg.leaf])
+                    flats[seg.leaf][rg.dst_off : rg.dst_off + rg.nbytes] = (
+                        np.frombuffer(
+                            leaf_buf[rg.src_off : rg.src_off + rg.nbytes],
+                            dtype=np.uint8,
+                        )
+                    )
             dt = time.perf_counter() - t0
             comm.barrier(tag="bench-full-done")
             mgr.close()

@@ -1,6 +1,6 @@
 """Chaos soak: drive the coordination and storage planes through seeded fault plans.
 
-Eight scenarios, each asserting the job converges to a CORRECT final state
+Each scenario asserts the job converges to a CORRECT final state
 despite injected faults (`tpu_resiliency/platform/chaos.py`):
 
 - **store**: N client threads hammer one ``KVServer`` (sets, shared counter
@@ -19,6 +19,13 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   variant): every rank agrees on and loads the older iteration. Both variants
   assert ``ckpt_quarantined`` events and ``tpu_ckpt_integrity_failures_total``
   in the aggregated metrics.
+- **coding**: the byte-economy campaign — a 4-rank erasure clique saves under
+  network pressure, then a victim death + a holder death + a seeded parity
+  bitflip force the recovery ladder to ATTEMPT reconstruction, fail CLOSED
+  (never a false-positive container), and agree the keyframe fallback, which
+  reconstructs byte-identically; a 2-rank delta chain then breaks its base
+  and must drop exactly one mirror (``ckpt_delta_applied{broken}``) while
+  saves/loads stay healthy. The full seeded fault-identity tuple reproduces.
 - **elastic**: the shrink-and-continue chain — a 4-rank dp world checkpoints
   with layout meta, the seed-chosen victim is preempted (disk gone), the
   survivors resume resharded (``load_resharded``) and save at the shrunken
@@ -447,6 +454,221 @@ def scenario_disk(seed: int, fallback: bool = False, spec: str | None = None):
         srv.close()
         shutil.rmtree(root, ignore_errors=True)
     return plan.schedule()
+
+
+# -- scenario: checkpoint byte-economy (erasure + delta) ----------------------
+
+#: Transient network pressure rides along (sender-retried, MUST converge);
+#: the coding-specific faults (holder death, parity bitflip, chain break)
+#: are seeded below with identities derived from the same seed.
+CODING_SPEC = "{seed}:p2p.send.reset@at=2;store.send.reset@at=9"
+
+
+def scenario_coding(seed: int, spec: str | None = None):
+    """The byte-economy plane's fault campaign, three chained phases:
+
+    1. a 4-rank erasure clique (k=2, parity 2) saves two iterations under a
+       seeded network plan (sender-retried — the saves must converge);
+    2. the seed picks a victim rank (death: disk wiped), one of its block
+       HOLDERS loses the victim's newest block (holder died mid-save), and
+       another holder's block takes a seeded BITFLIP — the surviving block
+       census still reads reconstructible (2 of k=2 listed), so the ladder
+       ATTEMPTS the reconstruction and must fail CLOSED on the corrupt
+       block (no false-positive container), then the group agrees the
+       fallback to the previous iteration, which reconstructs from ITS
+       (intact) parity blocks byte-identically;
+    3. a 2-rank delta chain (keyframe + chunk-diff rounds) where the seeded
+       rank misses the base container — the next delta apply must drop that
+       mirror with ``ckpt_delta_applied{broken}`` while the save and a
+       subsequent load stay healthy.
+
+    Returns ``(injection_schedule, victim, dead_holder, flip_holder,
+    flip_offset, chain_breaker, fallback_iteration)`` — the whole tuple must
+    reproduce run-to-run per seed."""
+    import shutil
+
+    import numpy as np
+
+    from tpu_resiliency.checkpoint.coding import ErasureReplicationStrategy
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils.metrics import aggregate
+
+    world = 4
+    plan = chaos.ChaosPlan.parse((spec or CODING_SPEC).format(seed=seed))
+    chaos.install_plan(plan)
+    rng = np.random.default_rng(seed)
+    victim = int(rng.integers(world))
+    others = [r for r in range(world) if r != victim]
+    dead_holder = others[int(rng.integers(len(others)))]
+    flip_holder = [r for r in others if r != dead_holder][
+        int(rng.integers(len(others) - 1))
+    ]
+    seen: list = []
+    tpu_events.add_sink(seen.append)
+    srv = KVServer(host="127.0.0.1", port=0)
+    root = tempfile.mkdtemp(prefix="chaos_coding.")
+    droot = tempfile.mkdtemp(prefix="chaos_coding_delta.")
+    stores: list = []
+
+    def mk():
+        s = CoordStore("127.0.0.1", srv.port, timeout=30.0)
+        stores.append(s)
+        return s
+
+    def tree(rank: int, it: int):
+        return {"w": np.full((65536,), rank * 100.0 + it, np.float32),
+                "step": it}
+
+    def ec_body(rank: int, gen: int, do_save: bool, wipe: bool):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=60.0,
+                         generation=gen)
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        try:
+            strat = ErasureReplicationStrategy(
+                comm, ex, replication_jump=1, replication_factor=world,
+                parity=2,
+            )
+            mgr = LocalCheckpointManager(
+                root, rank=rank, comm=comm, replication=strat, keep=2
+            )
+            if wipe:
+                mgr.wipe()
+            if do_save:
+                mgr.save(1, PyTreeStateDict(tree(rank, 1)), is_async=False)
+                mgr.save(2, PyTreeStateDict(tree(rank, 2)), is_async=False)
+                mgr.close()
+                return None
+            hollow, tensors, meta = mgr.load()
+            it = meta["iteration"]
+            w = np.asarray(tensors[0]).copy()
+            mgr.close()
+            return it, w
+        finally:
+            ex.close()
+
+    flip_offset = None
+    chain_breaker = int(rng.integers(2))
+    try:
+        # Phase 1: erasure saves under the network plan.
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(ec_body, r, 0, True, False)
+                      for r in range(world)]:
+                f.result(timeout=120)
+        # Phase 2: victim dies; one of its iter-2 block holders died
+        # mid-save (block file gone), the other's block takes a bitflip.
+        def block_path(holder: int, it: int):
+            d = os.path.join(root, "s0", f"r{holder}")
+            names = [
+                n for n in os.listdir(d)
+                if n.startswith(f"iter_{it:07d}_{victim}_b")
+                and n.endswith(".ecblk")
+            ]
+            assert len(names) == 1, names
+            return os.path.join(d, names[0])
+
+        os.unlink(block_path(dead_holder, 2))
+        fpath = block_path(flip_holder, 2)
+        blob = bytearray(open(fpath, "rb").read())
+        flip_offset = int(rng.integers(len(blob) - 64, len(blob)))
+        blob[flip_offset] ^= 0x40
+        open(fpath, "wb").write(bytes(blob))
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            loaded = [
+                f.result(timeout=120)
+                for f in [pool.submit(ec_body, r, 1, False, r == victim)
+                          for r in range(world)]
+            ]
+        for rank, (it, w) in enumerate(loaded):
+            assert it == 1, (
+                f"rank {rank} resumed from {it}, wanted the agreed fallback 1"
+            )
+            expect = np.full((65536,), rank * 100.0 + 1, np.float32)
+            assert np.array_equal(w, expect), (
+                f"rank {rank}: fallback tree not byte-identical"
+            )
+        recon = [e for e in seen if e.kind == "ckpt_parity_reconstruct"]
+        outcomes = [e.payload["outcome"] for e in recon]
+        assert "failed" in outcomes and outcomes[-1] == "ok", (
+            f"want a failed iter-2 reconstruction then an ok iter-1 one, "
+            f"got {outcomes}"
+        )
+        assert any(e.kind == "ckpt_fallback" for e in seen), (
+            "group never agreed the fallback"
+        )
+        # Phase 3: delta-chain break on a 2-rank mirror clique.
+        def delta_body(rank: int):
+            comm = StoreComm(mk(), rank, [0, 1], timeout=60.0, generation=9)
+            ex = PeerExchange(mk(), rank, timeout=30.0)
+            ex.start()
+            try:
+                strat = CliqueReplicationStrategy(
+                    comm, ex, replication_jump=1, replication_factor=2
+                )
+                mgr = LocalCheckpointManager(
+                    droot, rank=rank, comm=comm, replication=strat,
+                    keep=2, delta_interval=4,
+                )
+                mgr.save(1, PyTreeStateDict(tree(rank, 1)), is_async=False)
+                comm.barrier("kf")
+                if rank == chain_breaker:
+                    # This rank missed the keyframe base of its peer.
+                    peer = 1 - rank
+                    p = os.path.join(
+                        droot, "s0", f"r{rank}",
+                        f"iter_{1:07d}_{peer}_local.ckpt",
+                    )
+                    os.unlink(p)
+                comm.barrier("broke")
+                mgr.save(2, PyTreeStateDict(tree(rank, 2)), is_async=False)
+                hollow, tensors, meta = mgr.load()
+                it = meta["iteration"]
+                mgr.close()
+                return it
+            finally:
+                ex.close()
+
+        with cf.ThreadPoolExecutor(max_workers=2) as pool:
+            its = [
+                f.result(timeout=120)
+                for f in [pool.submit(delta_body, r) for r in range(2)]
+            ]
+        assert its == [2, 2], its
+        broken = [
+            e for e in seen
+            if e.kind == "ckpt_delta_applied"
+            and e.payload["outcome"] == "broken"
+        ]
+        assert broken and broken[0].payload["owner"] == 1 - chain_breaker, (
+            f"want exactly the chain-breaker's peer mirror dropped, got "
+            f"{[e.payload for e in broken]}"
+        )
+        assert any(
+            e.kind == "ckpt_delta_applied" and e.payload["outcome"] == "ok"
+            for e in seen
+        ), "the intact side of the delta round never applied"
+        # Acceptance surface: the same aggregation metrics_dump runs.
+        reg = aggregate([{"kind": e.kind, **e.payload} for e in seen])
+        prom = reg.to_prometheus()
+        assert "tpu_ckpt_parity_reconstructions_total" in prom, prom[:2000]
+        assert 'outcome="failed"' in prom, prom[:2000]
+        assert "tpu_ckpt_delta_applied_total" in prom, prom[:2000]
+        assert "tpu_ckpt_parity_bytes_total" in prom, prom[:2000]
+        _assert_byteflow_accounts(seen)
+    finally:
+        chaos.clear_plan()
+        tpu_events.remove_sink(seen.append)
+        for s in stores:
+            s.close()
+        srv.close()
+        shutil.rmtree(root, ignore_errors=True)
+        shutil.rmtree(droot, ignore_errors=True)
+    return (
+        plan.schedule(), victim, dead_holder, flip_holder, flip_offset,
+        chain_breaker, 1,
+    )
 
 
 # -- scenario: elastic shrink / resharded resume / re-expand ------------------
@@ -1450,6 +1672,15 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert f1 == f2, f"disk-fallback schedule not reproducible:\n{f1}\n{f2}"
     out["disk_injections"] = [list(i) for i in d1]
     out["disk_fallback_injections"] = [list(i) for i in f1]
+    # Byte-economy campaign (erasure holder death + parity bitflip + delta
+    # chain break), twice per seed: the whole composite tuple — injection
+    # schedule AND every seeded fault identity — must reproduce.
+    c1 = scenario_coding(seed)
+    c2 = scenario_coding(seed)
+    assert c1 == c2, f"coding schedule not reproducible:\n{c1}\n{c2}"
+    out["coding_injections"] = [list(i) for i in c1[0]]
+    out["coding_victim"] = c1[1]
+    out["coding_faults"] = list(c1[2:6])
     # Elastic shrink → resharded resume → re-expand, twice per seed: the
     # (injection schedule, victim, per-rank byte splits) must reproduce.
     e1 = scenario_elastic(seed)

@@ -93,6 +93,16 @@ if python -m tpu_resiliency.tools.ckpt_info "$WORKDIR/ckpt_root" --verify; then
 else
     echo "integrity OK: --verify caught the flipped bit (exit 1 as designed)"
 fi
+# The chunk-manifest view must LOCATE the flip (exact leaf/chunk coordinates).
+if python -m tpu_resiliency.tools.ckpt_info "$WORKDIR/ckpt_root" --chunks > "$WORKDIR/chunks.out" 2>&1; then
+    echo "FAIL: ckpt_info --chunks missed the injected bit flip"; exit 1
+fi
+sed 's/^/    /' "$WORKDIR/chunks.out"
+grep -q "chunk" "$WORKDIR/chunks.out" || { echo "FAIL: --chunks named no chunk"; exit 1; }
+echo "chunk-manifest OK: --chunks located the corrupt chunk (exit 1 as designed)"
+
+echo "== smoke: checkpoint byte economy (erasure k-of-n + delta chunk-diff)"
+python scripts/bench_replication.py --smoke
 
 echo "== smoke: goodput plane (live /metrics + /goodput on the launcher vs offline --goodput)"
 GP="$WORKDIR/goodput"

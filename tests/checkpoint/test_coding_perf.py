@@ -1,0 +1,53 @@
+"""Perf acceptance for the checkpoint byte-economy plane (slow; tier-1
+deselects ``-m slow``).
+
+Runs ``scripts/bench_replication.py`` at a CI-sized payload and asserts the
+two ACCEPTANCE byte claims against the same arithmetic the committed
+``BENCH_replication.json`` records:
+
+- **erasure**: wire bytes per rank per save ≤ ``(1 + 1/k)×`` the payload
+  (full mirrors move ``(world-1)×``);
+- **delta** (steady state, small dirty fraction): ≥5× fewer replication
+  bytes than a full-mirror round.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+@pytest.mark.slow
+def test_erasure_and_delta_byte_economy(tmp_path):
+    out = tmp_path / "bench.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_replication.py"),
+            "--mb", "48", "--world", "3", "--rounds", "2",
+            "--dirty-frac", "0.05", "--alloc-mb", "2",
+            "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    res = json.loads(out.read_text())
+    er = res["erasure"]
+    # k-of-n: one block per peer, owner's block implicit — the wire moves
+    # ~payload, never (world-1)x payload. Small slack for artifact headers.
+    assert er["payload_ratio"] <= (1 + 1 / er["k"]) + 0.05, er
+    assert er["payload_ratio"] < er["mirror_payload_ratio"] / 1.5, er
+    # Delta at 5% dirty chunks: ≥5x fewer bytes than the full mirror round
+    # (48 MB / 1 MiB chunks = 48 chunks; ~5% dirty ships a handful).
+    de = res["delta"]
+    assert de["full_bytes"] >= 5 * de["frame_bytes"], de
+    assert de["bytes_ratio"] <= 0.2, de

@@ -668,8 +668,11 @@ class TestReshardE2E:
         for rank, tensors in zip([0, 1], out):
             want = R.slice_local([GLOBAL[:8, :4].copy()], src, rank)[0]
             assert np.array_equal(tensors[0], want), rank
+        # Chunked (TPURES03) containers verify lazily per touched chunk, so
+        # the corruption surfaces at the chunk-verify stage; a pre-chunk
+        # container would have been caught by the whole-file reshard-verify.
         assert any(
             e.kind == "ckpt_quarantined"
-            and e.payload.get("stage") == "reshard-verify"
+            and e.payload.get("stage") in ("reshard-verify", "chunk-verify")
             for e in sink
         )

@@ -23,8 +23,11 @@ def test_ranged_fetch_moves_strictly_fewer_bytes(tmp_path):
     r = subprocess.run(
         [
             sys.executable,
+            # 64 MB is the ROADMAP item-4 gate point: smaller payloads are
+            # dominated by fixed costs (collectives, plan build) and the
+            # wall-clock comparison stops measuring the serve path.
             os.path.join(REPO_ROOT, "scripts", "bench_reshard.py"),
-            "--mb", "8", "--out", str(out),
+            "--mb", "64", "--out", str(out),
         ],
         capture_output=True,
         text=True,
@@ -41,3 +44,8 @@ def test_ranged_fetch_moves_strictly_fewer_bytes(tmp_path):
     assert res["bytes_ratio"] < 0.9, res
     # And the local-slice path did real work (mirrors served in place).
     assert res["ranged_local_bytes"] > 0, res
+    # ROADMAP item 4 gate (flipped by the TPURES03 chunk manifest): with
+    # range serving verifying only touched chunks — no serve-side
+    # whole-container CRC pass — elastic resume must beat the full-mirror
+    # retrieve-and-slice recovery on wall clock, not just bytes.
+    assert res["speedup"] > 1.0, res

@@ -223,3 +223,38 @@ def test_dead_shard_fails_fast_not_silently(clique):
             late.close()
     finally:
         c.close()
+
+
+def test_parallel_fanout_merge_is_order_independent(clique, client):
+    """The prefix/scan/census fan-out runs shards CONCURRENTLY now: whatever
+    order shards answer in, the merged result must be identical to the
+    serial-era merge (disjoint keyspaces make this structural — this test
+    pins it against regressions in the merge code)."""
+    import random
+
+    keys = [f"fan/{i}" for i in range(96)]
+    for k in keys:
+        client.set(k, k.upper())
+
+    # Reference: per-shard serial merges in every shard permutation.
+    per_shard = [
+        clique.client().client._shard(i).prefix_get("fan/")
+        for i in range(len(clique.endpoints))
+    ]
+    for perm in ([0, 1, 2], [2, 1, 0], [1, 2, 0]):
+        merged = {}
+        for i in perm:
+            merged.update(per_shard[i])
+        assert merged == client.prefix_get("fan/")
+
+    # keys()/num_keys() agree with the merged view.
+    assert client.keys("fan/") == sorted(merged)
+    assert client.num_keys() >= len(keys)
+    # Repeated concurrent fan-outs are stable (no racy partial merges).
+    snap = client.prefix_get("fan/")
+    for _ in range(8):
+        assert client.prefix_get("fan/") == snap
+    # And a keyed op mid-fan-out cannot corrupt the merge: clear returns the
+    # exact number of keys the merged view showed.
+    assert client.prefix_clear("fan/") == len(merged)
+    assert client.prefix_get("fan/") == {}

@@ -218,3 +218,64 @@ def test_env_knobs_respected(kv_server, monkeypatch):
         assert small._tree is None
     finally:
         st.close()
+
+
+@pytest.mark.parametrize("src", [0, 4, 8])
+def test_tree_broadcast_matches_flat_contract(kv_server, src):
+    """broadcast fans the source's value down per-child keys: every index
+    (root, mid-tree, leaf source) returns the same object, round keys are
+    GC'd, and repeated rounds stay isolated."""
+    world, fanout = 9, 2
+
+    def factory():
+        return CoordStore("127.0.0.1", kv_server.port, timeout=30.0,
+                          prefix=f"bc{src}/")
+
+    def body(tc, i):
+        a = tc.broadcast(
+            {"from": i} if i == src else None, src, tag="bc", timeout=30.0
+        )
+        b = tc.broadcast(
+            ("second", i) if i == src else None, src, tag="bc", timeout=30.0
+        )
+        return (a, b)
+
+    out = _run_world(factory, world, fanout, body)
+    assert all(o == ({"from": src}, ("second", src)) for o in out), out
+    probe = CoordStore("127.0.0.1", kv_server.port, timeout=5.0)
+    try:
+        assert probe.client.keys(f"bc{src}/bc/") == []
+    finally:
+        probe.close()
+
+
+def test_storecomm_broadcast_goes_tree_above_floor(kv_server):
+    """StoreComm.broadcast rides the tree above the world floor and returns
+    the flat path's exact result either way (PR-14 headroom closed)."""
+    from tpu_resiliency.checkpoint.comm import StoreComm
+
+    world = 9
+    results = [None] * world
+    stores = [
+        CoordStore("127.0.0.1", kv_server.port, timeout=30.0)
+        for _ in range(world)
+    ]
+
+    def run(i):
+        comm = StoreComm(stores[i], i, list(range(world)), timeout=30.0,
+                         tree_min_world=2, tree_fanout=2)
+        assert comm._tree is not None
+        results[i] = comm.broadcast(
+            {"layout": "x" * 64} if i == 3 else None, src=3, tag="hdr"
+        )
+
+    try:
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60.0)
+    finally:
+        for s in stores:
+            s.close()
+    assert all(r == {"layout": "x" * 64} for r in results), results

@@ -15,6 +15,9 @@ TPU-native re-design of the reference's ``checkpointing/`` package (SURVEY §2.6
   with coverage-based ``find_latest``.
 - :mod:`~tpu_resiliency.checkpoint.reshard` — elastic resharding: repartition
   plans mapping any saved world's shards onto any target world/topology.
+- :mod:`~tpu_resiliency.checkpoint.coding` — byte economy: Reed-Solomon
+  erasure replication (k-of-n blocks instead of full mirrors) and delta
+  checkpoints (chunk-diff frames between keyframes).
 """
 
 from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer
@@ -24,6 +27,11 @@ from tpu_resiliency.checkpoint.async_core import (
     ForkAsyncCaller,
     ProcessAsyncCaller,
     ThreadAsyncCaller,
+)
+from tpu_resiliency.checkpoint.coding import (
+    DeltaTracker,
+    ErasureReplicationStrategy,
+    replication_from_env,
 )
 from tpu_resiliency.checkpoint.comm import PeerExchange, StoreComm
 from tpu_resiliency.checkpoint.local_manager import CkptID, LocalCheckpointManager
@@ -60,6 +68,9 @@ __all__ = [
     "LocalCheckpointManager",
     "CliqueReplicationStrategy",
     "LazyCliqueReplicationStrategy",
+    "ErasureReplicationStrategy",
+    "DeltaTracker",
+    "replication_from_env",
     "ExchangePlan",
     "group_sequence_for",
     "parse_group_sequence",

@@ -196,7 +196,19 @@ class StoreComm:
         return out
 
     def broadcast(self, obj: Any, src: int, tag: str = "bc", timeout: Optional[float] = None) -> Any:
+        """One value from ``src`` to every member.
+
+        Flat shape (small groups): one source ``set``, everyone parks on the
+        same key, one exit barrier. Tree shape (world ≥ ``tree_min_world``):
+        the value fans out parent→child on per-child keys
+        (``treecomm.broadcast``) so a reshard header broadcast at 4096 ranks
+        is O(fanout · log N) hops instead of N waiters parked on one shard's
+        event loop."""
         t = timeout or self.timeout
+        if self._tree is not None:
+            return self._tree.broadcast(
+                obj, self.ranks.index(src), tag=tag, timeout=t
+            )
         r = self._round(tag)
         base = f"{tag}/{r}"
         if self.rank == src:

@@ -58,10 +58,35 @@ write and every ``.dirty``→visible commit funnels through a patchable IO shim
 truncation, torn renames, ENOSPC, slow IO), so corruption scenarios reproduce
 from a seed exactly like network fault plans.
 
-Layout (v2)::
+**Chunk manifest (format v3, ``TPURES03``).** v2's unit of verification is the
+*leaf* — fine for whole-container reads, hostile to ranged ones: serving a
+4 KB reshard range out of a 256 MB leaf forced a CRC pass over the entire
+container (BENCH_reshard.json's 0.42 speedup was exactly that stall). v3
+additionally records a **per-chunk CRC manifest** in the trailer: every leaf's
+payload is cut into fixed-size, leaf-aligned chunks (``chunk_size`` rides in
+the trailer; chunks never span leaves, the last chunk of a leaf is short) and
+each chunk is individually signed. Any byte range now verifies in O(range):
+read the covering chunks, check their CRCs, done — :func:`chunk_spans` names
+the covering chunks, the local manager's ranged-read server and the reshard
+load path verify exactly those. The chunk manifest is also what the
+byte-economy planes are built on: delta checkpoints diff per-chunk CRCs to
+ship only changed chunks (``checkpoint/coding/delta.py``), and erasure blocks
+verify without whole-container scans (``checkpoint/coding/strategy.py``).
+
+``TPURES02`` containers still load fully verified (whole-leaf CRCs + digest);
+they simply cannot serve chunk-granular verification, so ranged readers fall
+back to the one-time whole-file pass. ``TPURES01`` loads unverified with a
+``ckpt_unverified`` event, as before.
+
+Layout (v3)::
 
     MAGIC(8) | header_len(8 LE) | header pickle | leaf 0 bytes | ... |
-    TRAILER_MAGIC(8) | algo(4) | nleaves(4 LE) | leaf_crc32c(4 LE)*n | container_crc(4 LE)
+    TRAILER_MAGIC_V3(8) | algo(4) | chunk_size(4 LE) | nleaves(4 LE) |
+    nchunks(4 LE) | leaf_crc32c(4 LE)*nleaves | chunk_crc32c(4 LE)*nchunks |
+    container_crc(4 LE)
+
+(v2 trailer, still read: ``TPURES02`` head + ``TRAILER_MAGIC(8) | algo(4) |
+nleaves(4 LE) | leaf_crc32c(4 LE)*n | container_crc(4 LE)``.)
 
 Header: ``{"hollow": bytes, "leaves": [{"shape", "dtype", "nbytes"[, "crc32c"]},
 ...], "meta": {}}``.
@@ -69,6 +94,7 @@ Header: ``{"hollow": bytes, "leaves": [{"shape", "dtype", "nbytes"[, "crc32c"]},
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import pickle
 import struct
@@ -80,12 +106,17 @@ from tpu_resiliency.exceptions import CheckpointError
 from tpu_resiliency.platform import chaos
 from tpu_resiliency.utils.events import record as record_event
 
-#: Current container version: v2 adds per-leaf CRCs + the integrity trailer.
-MAGIC = b"TPURES02"
+#: Current container version: v3 adds the per-chunk CRC manifest (O(range)
+#: verification for ranged reads, the chunk-diff substrate for delta saves).
+MAGIC = b"TPURES03"
+#: v2 containers (leaf CRCs + trailer digest, no chunk manifest) still load
+#: fully verified — ranged readers fall back to whole-file verification.
+MAGIC_V2 = b"TPURES02"
 #: v1 containers (pre-integrity) still load, unverified (``ckpt_unverified``).
 MAGIC_V1 = b"TPURES01"
-_MAGICS = (MAGIC, MAGIC_V1)
+_MAGICS = (MAGIC, MAGIC_V2, MAGIC_V1)
 TRAILER_MAGIC = b"TPURESCK"
+TRAILER_MAGIC_V3 = b"TPURESC3"
 _LEN = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
 DIRTY_SUFFIX = ".dirty"
@@ -104,8 +135,10 @@ try:
     CRC_ALGO = "crc32c"
     _ALGO_TAG = b"c32c"
     #: google_crc32c's C binding only accepts ``bytes``; chunk the copy so the
-    #: transient allocation stays bounded at any payload size.
-    _CRC_CHUNK = 4 << 20
+    #: transient allocation stays bounded at any payload size. 256 KiB keeps
+    #: the steady-state pipelined save's peak transient under the <1 MB
+    #: alloc gate even though the v3 manifest CRCs one whole chunk at a time.
+    _CRC_CHUNK = 1 << 18
 
     def crc32c(data, crc: int = 0) -> int:
         """Streaming checksum update over any bytes-like (CRC32C here; the
@@ -156,14 +189,77 @@ def _effective_stripes(stripes: Optional[int]) -> int:
     return max(1, int(stripes))
 
 
+# -- chunk geometry -----------------------------------------------------------
+#
+# Chunks are LEAF-ALIGNED: each leaf's payload is independently cut into
+# ``chunk_size`` pieces (the last one short), so a chunk never spans two
+# leaves and leaf-relative range math never crosses a leaf boundary. The
+# manifest orders chunks leaf-major (leaf 0's chunks, then leaf 1's, ...).
+
+#: Default chunk size (1 MiB): a 1 GB container carries a 4 KB manifest, and
+#: a 4 KB ranged read verifies at most two 1 MiB chunks instead of the file.
+DEFAULT_CHUNK = 1 << 20
+#: Storage-class override (bytes); floor 4 KiB so manifests stay bounded.
+CHUNK_ENV = "TPU_RESILIENCY_CKPT_CHUNK"
+
+
+def _effective_chunk(chunk_size: Optional[int]) -> int:
+    if chunk_size is None:
+        try:
+            chunk_size = int(os.environ.get(CHUNK_ENV, str(DEFAULT_CHUNK)))
+        except ValueError:
+            chunk_size = DEFAULT_CHUNK
+    return max(1 << 12, int(chunk_size))
+
+
+def leaf_chunk_count(nbytes: int, chunk_size: int) -> int:
+    """Chunks in one leaf's payload (0 for an empty leaf)."""
+    return (int(nbytes) + chunk_size - 1) // chunk_size
+
+
+def total_chunks(leaf_sizes: Sequence[int], chunk_size: int) -> int:
+    return sum(leaf_chunk_count(n, chunk_size) for n in leaf_sizes)
+
+
+def chunk_spans(
+    nbytes: int, chunk_size: int, off: int, length: int
+) -> tuple[int, int]:
+    """Covering chunk index range ``[first, last)`` of a leaf-relative byte
+    range ``[off, off+length)`` inside a leaf of ``nbytes`` bytes."""
+    if length <= 0:
+        return 0, 0
+    first = off // chunk_size
+    last = min((off + length - 1) // chunk_size + 1,
+               leaf_chunk_count(nbytes, chunk_size))
+    return first, last
+
+
 # -- integrity trailer --------------------------------------------------------
 
 
 def trailer_size(nleaves: int) -> int:
-    """On-disk size of a v2 integrity trailer for ``nleaves`` leaves — fixed
-    given the leaf count, which is what lets the pipelined save declare its
-    total container size before any payload byte exists."""
+    """On-disk size of a v2 integrity trailer for ``nleaves`` leaves (kept for
+    reading ``TPURES02`` containers; v3 writers use :func:`trailer_size_v3`)."""
     return len(TRAILER_MAGIC) + 4 + _U32.size * (nleaves + 2)
+
+
+#: v3 trailer fixed head: magic | algo | chunk_size | nleaves | nchunks.
+_V3_FIXED = len(TRAILER_MAGIC_V3) + 4 + 3 * _U32.size
+
+
+def trailer_size_v3(nleaves: int, nchunks: int) -> int:
+    """On-disk size of a v3 trailer — fixed given leaf count + chunk count,
+    which the leaf specs and chunk size determine, so the pipelined save can
+    still declare its total container size before any payload byte exists."""
+    return _V3_FIXED + _U32.size * (nleaves + nchunks + 1)
+
+
+def trailer_size_for(
+    leaf_sizes: Sequence[int], chunk_size: Optional[int] = None
+) -> int:
+    """v3 trailer size straight from leaf byte sizes (spec-only, no payload)."""
+    cs = _effective_chunk(chunk_size)
+    return trailer_size_v3(len(leaf_sizes), total_chunks(leaf_sizes, cs))
 
 
 def build_trailer(leaf_crcs: Sequence[int], container_crc: int) -> bytes:
@@ -181,9 +277,9 @@ def build_trailer(leaf_crcs: Sequence[int], container_crc: int) -> bytes:
 
 
 def parse_trailer(buf, source: str = "container") -> tuple[bytes, list[int], int]:
-    """Parse a trailer blob → ``(algo_tag, leaf_crcs, container_crc)``; raises
-    :class:`CheckpointError` naming ``source`` when the trailer is missing or
-    structurally damaged (the usual signature of tail truncation)."""
+    """Parse a v2 trailer blob → ``(algo_tag, leaf_crcs, container_crc)``;
+    raises :class:`CheckpointError` naming ``source`` when the trailer is
+    missing or structurally damaged (the usual signature of tail truncation)."""
     mv = memoryview(buf)
     if mv.ndim != 1 or mv.itemsize != 1:
         mv = mv.cast("B")
@@ -206,9 +302,123 @@ def parse_trailer(buf, source: str = "container") -> tuple[bytes, list[int], int
     return algo, crcs, container_crc
 
 
+def build_trailer_v3(
+    leaf_crcs: Sequence[int],
+    chunk_crcs: Sequence[int],
+    chunk_size: int,
+    container_crc: int,
+) -> bytes:
+    """Serialize a v3 trailer: the v2 record plus the chunk manifest
+    (chunk size + leaf-major per-chunk CRCs)."""
+    return b"".join(
+        [
+            TRAILER_MAGIC_V3,
+            _ALGO_TAG,
+            _U32.pack(chunk_size),
+            _U32.pack(len(leaf_crcs)),
+            _U32.pack(len(chunk_crcs)),
+            *(_U32.pack(c) for c in leaf_crcs),
+            *(_U32.pack(c) for c in chunk_crcs),
+            _U32.pack(container_crc),
+        ]
+    )
+
+
+@dataclasses.dataclass
+class TrailerInfo:
+    """Version-neutral view of a container's integrity record. ``chunk_size``
+    / ``chunk_crcs`` are ``None`` for v2 containers (no manifest — whole-leaf
+    verification only)."""
+
+    algo: bytes
+    leaf_crcs: list[int]
+    container_crc: int
+    chunk_size: Optional[int] = None
+    chunk_crcs: Optional[list[int]] = None
+
+    @property
+    def verifiable(self) -> bool:
+        return self.algo in _VERIFIABLE_TAGS
+
+    def leaf_chunk_crcs(self, leaf_sizes: Sequence[int]) -> list[list[int]]:
+        """The manifest re-grouped per leaf (leaf-major flat order → lists)."""
+        if self.chunk_crcs is None or self.chunk_size is None:
+            raise CheckpointError("container carries no chunk manifest (v2)")
+        out, pos = [], 0
+        for n in leaf_sizes:
+            cnt = leaf_chunk_count(int(n), self.chunk_size)
+            out.append(self.chunk_crcs[pos : pos + cnt])
+            pos += cnt
+        return out
+
+
+def parse_trailer_v3(buf, source: str = "container") -> TrailerInfo:
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    if mv.nbytes < _V3_FIXED or bytes(
+        mv[: len(TRAILER_MAGIC_V3)]
+    ) != TRAILER_MAGIC_V3:
+        raise CheckpointError(
+            f"{source}: v3 integrity trailer missing or corrupt "
+            f"(truncated file?)"
+        )
+    off = len(TRAILER_MAGIC_V3)
+    algo = bytes(mv[off : off + 4])
+    off += 4
+    chunk_size, nleaves, nchunks = struct.unpack(
+        "<3I", mv[off : off + 3 * _U32.size]
+    )
+    if chunk_size < 1 or mv.nbytes != trailer_size_v3(nleaves, nchunks):
+        raise CheckpointError(
+            f"{source}: trailer size mismatch ({mv.nbytes} bytes for "
+            f"{nleaves} leaves / {nchunks} chunks) — truncated or torn file"
+        )
+    off = _V3_FIXED
+    leaf_crcs = list(
+        struct.unpack(f"<{nleaves}I", mv[off : off + 4 * nleaves])
+    ) if nleaves else []
+    off += 4 * nleaves
+    chunk_crcs = list(
+        struct.unpack(f"<{nchunks}I", mv[off : off + 4 * nchunks])
+    ) if nchunks else []
+    off += 4 * nchunks
+    (container_crc,) = _U32.unpack(mv[off:])
+    return TrailerInfo(
+        algo=algo, leaf_crcs=leaf_crcs, container_crc=container_crc,
+        chunk_size=chunk_size, chunk_crcs=chunk_crcs,
+    )
+
+
+def parse_trailer_any(
+    buf, magic: bytes, leaf_sizes: Sequence[int], source: str = "container"
+) -> TrailerInfo:
+    """Parse whichever trailer ``magic``'s container version carries, with
+    structural cross-checks against the header's leaf sizes."""
+    if magic == MAGIC_V2:
+        algo, leaf_crcs, container_crc = parse_trailer(buf, source)
+        if len(leaf_crcs) != len(leaf_sizes):
+            raise CheckpointError(
+                f"{source}: trailer records {len(leaf_crcs)} leaves, header "
+                f"declares {len(leaf_sizes)}"
+            )
+        return TrailerInfo(algo=algo, leaf_crcs=leaf_crcs,
+                           container_crc=container_crc)
+    info = parse_trailer_v3(buf, source)
+    if len(info.leaf_crcs) != len(leaf_sizes) or len(
+        info.chunk_crcs
+    ) != total_chunks(leaf_sizes, info.chunk_size):
+        raise CheckpointError(
+            f"{source}: trailer manifest disagrees with header leaf sizes "
+            f"({len(info.leaf_crcs)} leaves / {len(info.chunk_crcs)} chunks "
+            f"@ {info.chunk_size} B chunk)"
+        )
+    return info
+
+
 def _container_crc(prefix, leaf_crcs: Sequence[int]) -> int:
-    """The whole-file digest: CRC over the container head (magic + header len
-    + header pickle) extended with each leaf's packed CRC — a digest of
+    """The v2 whole-file digest: CRC over the container head (magic + header
+    len + header pickle) extended with each leaf's packed CRC — a digest of
     digests, so the entire file is covered by ONE streaming pass over the
     payload (the leaf CRCs double as the file digest's input)."""
     crc = crc32c(prefix)
@@ -217,24 +427,65 @@ def _container_crc(prefix, leaf_crcs: Sequence[int]) -> int:
     return crc
 
 
+def _container_crc_v3(
+    prefix, leaf_crcs: Sequence[int], chunk_crcs: Sequence[int]
+) -> int:
+    """v3 digest: the v2 digest-of-digests extended with the packed chunk
+    manifest, so a flipped bit in ANY trailer entry (leaf or chunk CRC) is
+    caught by the digest check."""
+    crc = _container_crc(prefix, leaf_crcs)
+    for c in chunk_crcs:
+        crc = crc32c(_U32.pack(c), crc)
+    return crc
+
+
+def _expected_digest(info: TrailerInfo, prefix) -> int:
+    if info.chunk_crcs is None:
+        return _container_crc(prefix, info.leaf_crcs)
+    return _container_crc_v3(prefix, info.leaf_crcs, info.chunk_crcs)
+
+
 class Checksummer:
-    """Streaming v2 integrity state for writers that see the container as
+    """Streaming v3 integrity state for writers that see the container as
     prefix-then-leaves (the pipelined save, the durable stream writer): feed
     the header prefix at construction and each leaf view exactly once as it
-    resolves, then emit the trailer chunk. One pass, no buffering."""
+    resolves, then emit the trailer chunk. One IO pass, no buffering — each
+    leaf's bytes are CRC'd per chunk (manifest) and across the leaf (leaf
+    record) as they stream through."""
 
-    def __init__(self, prefix: bytes):
+    def __init__(self, prefix: bytes, chunk_size: Optional[int] = None):
+        self.chunk_size = _effective_chunk(chunk_size)
         self.leaf_crcs: list[int] = []
-        self._crc = crc32c(prefix)
+        #: leaf-major flat manifest (the trailer's chunk section)
+        self.chunk_crcs: list[int] = []
+        #: per-leaf manifest slices — the delta tracker's diff input
+        self.leaf_chunks: list[list[int]] = []
+        self._prefix_crc = crc32c(prefix)
 
     def add_leaf(self, view) -> int:
-        c = crc32c(view)
-        self.leaf_crcs.append(c)
-        self._crc = crc32c(_U32.pack(c), self._crc)
-        return c
+        mv = memoryview(view)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        leaf_crc = 0
+        chunks: list[int] = []
+        for off in range(0, mv.nbytes, self.chunk_size):
+            window = mv[off : off + self.chunk_size]
+            chunks.append(crc32c(window))
+            leaf_crc = crc32c(window, leaf_crc)
+        self.leaf_crcs.append(leaf_crc)
+        self.chunk_crcs.extend(chunks)
+        self.leaf_chunks.append(chunks)
+        return leaf_crc
 
     def trailer(self) -> bytes:
-        return build_trailer(self.leaf_crcs, self._crc)
+        crc = self._prefix_crc
+        for c in self.leaf_crcs:
+            crc = crc32c(_U32.pack(c), crc)
+        for c in self.chunk_crcs:
+            crc = crc32c(_U32.pack(c), crc)
+        return build_trailer_v3(
+            self.leaf_crcs, self.chunk_crcs, self.chunk_size, crc
+        )
 
 
 def _record_unverified(source: str, reason: str) -> None:
@@ -356,10 +607,14 @@ def write_payload(
     """
     stripes = _effective_stripes(stripes)
     arrays = [_leaf_to_numpy(t) for t in tensors]
-    # Per-leaf CRCs computed from the source buffers BEFORE anything touches
-    # disk: the checksums sign what the caller handed us, so corruption
-    # anywhere downstream (the write path itself included) is detectable.
-    leaf_crcs = [crc32c(_raw_view(a)) for a in arrays]
+    # Per-leaf + per-chunk CRCs computed from the source buffers BEFORE
+    # anything touches disk: the checksums sign what the caller handed us, so
+    # corruption anywhere downstream (the write path itself included) is
+    # detectable.
+    ck = Checksummer(b"")
+    for a in arrays:
+        ck.add_leaf(_raw_view(a))
+    leaf_crcs = ck.leaf_crcs
     header = {
         "hollow": hollow_bytes,
         "leaves": [
@@ -375,7 +630,10 @@ def write_payload(
     }
     header_bytes = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
     prefix = MAGIC + _LEN.pack(len(header_bytes)) + header_bytes
-    trailer = build_trailer(leaf_crcs, _container_crc(prefix, leaf_crcs))
+    trailer = build_trailer_v3(
+        leaf_crcs, ck.chunk_crcs, ck.chunk_size,
+        _container_crc_v3(prefix, leaf_crcs, ck.chunk_crcs),
+    )
     tmp = path + DIRTY_SUFFIX
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     base = len(prefix)
@@ -492,26 +750,18 @@ def read_payload(path: str, verify: bool = True) -> tuple[bytes, list[np.ndarray
         magic, header, prefix = _read_prefix(f, path)
         specs = header["leaves"]
         payload = sum(int(s["nbytes"]) for s in specs)
-        leaf_crcs = None
-        if magic == MAGIC:
-            tsize = trailer_size(len(specs))
-            expected = len(prefix) + payload + tsize
-            size = os.fstat(f.fileno()).st_size
-            if size != expected:
-                raise CheckpointError(
-                    f"{path}: container size mismatch (want {expected} bytes, "
-                    f"found {size}) — truncated or torn file"
-                )
-            f.seek(len(prefix) + payload)
-            algo, leaf_crcs, container_crc = parse_trailer(f.read(tsize), path)
+        info = None
+        if magic != MAGIC_V1:
+            info = _read_file_trailer(f, magic, specs, len(prefix), path)
             f.seek(len(prefix))
-            if verify and algo not in _VERIFIABLE_TAGS:
-                _record_unverified(path, reason=f"algo:{algo!r}")
-                leaf_crcs = None
+            if verify and not info.verifiable:
+                _record_unverified(path, reason=f"algo:{info.algo!r}")
+                info = None
             elif not verify:
-                leaf_crcs = None
+                info = None
         elif verify:
             _record_unverified(path, reason="format-v1")
+        leaf_crcs = info.leaf_crcs if info is not None else None
         tensors = []
         for i, spec in enumerate(specs):
             buf = f.read(spec["nbytes"])
@@ -524,11 +774,41 @@ def read_payload(path: str, verify: bool = True) -> tuple[bytes, list[np.ndarray
             tensors.append(
                 np.frombuffer(buf, dtype=resolve_dtype(spec["dtype"])).reshape(spec["shape"])
             )
-        if leaf_crcs is not None and _container_crc(prefix, leaf_crcs) != container_crc:
+        if info is not None and _expected_digest(info, prefix) != info.container_crc:
             raise CheckpointError(
                 f"{path}: container digest mismatch (header or trailer corrupted)"
             )
     return header["hollow"], tensors, header.get("meta", {})
+
+
+def _read_file_trailer(
+    f, magic: bytes, specs: Sequence[dict], prefix_len: int, source: str
+) -> TrailerInfo:
+    """Seek-and-parse a v2/v3 file trailer with the size cross-check (the
+    truncation/torn-file detector); leaves the file position at the trailer."""
+    leaf_sizes = [int(s["nbytes"]) for s in specs]
+    payload = sum(leaf_sizes)
+    size = os.fstat(f.fileno()).st_size
+    tsize = size - prefix_len - payload
+    want = (
+        trailer_size(len(specs)) if magic == MAGIC_V2
+        else None  # v3 trailer size depends on the recorded chunk size
+    )
+    if tsize <= 0 or (want is not None and tsize != want):
+        raise CheckpointError(
+            f"{source}: container size mismatch ({size} bytes for "
+            f"{prefix_len + payload} of head+payload) — truncated or torn file"
+        )
+    f.seek(prefix_len + payload)
+    info = parse_trailer_any(f.read(tsize), magic, leaf_sizes, source)
+    if magic == MAGIC and tsize != trailer_size_v3(
+        len(leaf_sizes), len(info.chunk_crcs)
+    ):
+        raise CheckpointError(
+            f"{source}: container size mismatch (trailer region {tsize} B "
+            f"disagrees with manifest) — truncated or torn file"
+        )
+    return info
 
 
 def header_prefix(
@@ -580,7 +860,10 @@ def serialize_parts(
     """
     arrays = [_leaf_to_numpy(t) for t in tensors]
     views = [_raw_view(a) for a in arrays]
-    leaf_crcs = [crc32c(v) for v in views]
+    ck = Checksummer(b"")
+    for v in views:
+        ck.add_leaf(v)
+    leaf_crcs = ck.leaf_crcs
     prefix = header_prefix(
         hollow_bytes,
         [
@@ -594,7 +877,10 @@ def serialize_parts(
         ],
         meta,
     )
-    trailer = build_trailer(leaf_crcs, _container_crc(prefix, leaf_crcs))
+    trailer = build_trailer_v3(
+        leaf_crcs, ck.chunk_crcs, ck.chunk_size,
+        _container_crc_v3(prefix, leaf_crcs, ck.chunk_crcs),
+    )
     return prefix, [*views, trailer]
 
 
@@ -695,22 +981,18 @@ def deserialize_from_buffer(
     mv = memoryview(buf).cast("B")
     magic, header, off = _parse_buffer_prefix(mv, source)
     prefix = mv[:off]
-    leaf_crcs = None
-    if magic == MAGIC:
+    info = None
+    if magic != MAGIC_V1:
         payload = sum(int(s["nbytes"]) for s in header["leaves"])
-        tsize = trailer_size(len(header["leaves"]))
-        if off + payload + tsize > mv.nbytes:
-            raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
-        algo, leaf_crcs, container_crc = parse_trailer(
-            mv[off + payload : off + payload + tsize], source
-        )
-        if verify and algo not in _VERIFIABLE_TAGS:
-            _record_unverified(source, reason=f"algo:{algo!r}")
-            leaf_crcs = None
+        info = _buffer_trailer(mv, magic, header["leaves"], off, payload, source)
+        if verify and not info.verifiable:
+            _record_unverified(source, reason=f"algo:{info.algo!r}")
+            info = None
         elif not verify:
-            leaf_crcs = None
+            info = None
     elif verify:
         _record_unverified(source, reason="format-v1")
+    leaf_crcs = info.leaf_crcs if info is not None else None
     tensors = []
     for i, spec in enumerate(header["leaves"]):
         n = spec["nbytes"]
@@ -727,11 +1009,40 @@ def deserialize_from_buffer(
             )
         )
         off += n
-    if leaf_crcs is not None and _container_crc(prefix, leaf_crcs) != container_crc:
+    if info is not None and _expected_digest(info, prefix) != info.container_crc:
         raise CheckpointError(
             f"{source}: container digest mismatch (header or trailer corrupted)"
         )
     return header["hollow"], tensors, header.get("meta", {})
+
+
+def _buffer_trailer(
+    mv: memoryview, magic: bytes, specs: Sequence[dict], off: int,
+    payload: int, source: str,
+) -> TrailerInfo:
+    """Locate and parse the trailer inside a serialized blob (the blob may
+    carry a surplus tail — an oversized registered receive buffer)."""
+    leaf_sizes = [int(s["nbytes"]) for s in specs]
+    start = off + payload
+    if magic == MAGIC_V2:
+        tsize = trailer_size(len(specs))
+    else:
+        if start + _V3_FIXED > mv.nbytes:
+            raise CheckpointError(
+                f"{source}: truncated serialized checkpoint blob"
+            )
+        head = mv[start : start + _V3_FIXED]
+        if bytes(head[: len(TRAILER_MAGIC_V3)]) != TRAILER_MAGIC_V3:
+            raise CheckpointError(
+                f"{source}: v3 integrity trailer missing or corrupt"
+            )
+        _, nleaves, nchunks = struct.unpack(
+            "<3I", head[len(TRAILER_MAGIC_V3) + 4 :]
+        )
+        tsize = trailer_size_v3(nleaves, nchunks)
+    if start + tsize > mv.nbytes:
+        raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
+    return parse_trailer_any(mv[start : start + tsize], magic, leaf_sizes, source)
 
 
 def deserialize_from_bytes(blob) -> tuple[bytes, list[np.ndarray], dict]:
@@ -764,24 +1075,19 @@ def verify_container(buf, source: str = "frame") -> bool:
         return False
     specs = header["leaves"]
     payload = sum(int(s["nbytes"]) for s in specs)
-    tsize = trailer_size(len(specs))
-    if off + payload + tsize > mv.nbytes:
-        raise CheckpointError(f"{source}: truncated serialized checkpoint blob")
-    algo, leaf_crcs, container_crc = parse_trailer(
-        mv[off + payload : off + payload + tsize], source
-    )
-    if algo not in _VERIFIABLE_TAGS:
-        _record_unverified(source, reason=f"algo:{algo!r}")
+    info = _buffer_trailer(mv, magic, specs, off, payload, source)
+    if not info.verifiable:
+        _record_unverified(source, reason=f"algo:{info.algo!r}")
         return False
     pos = off
     for i, spec in enumerate(specs):
         n = int(spec["nbytes"])
-        if crc32c(mv[pos : pos + n]) != leaf_crcs[i]:
+        if crc32c(mv[pos : pos + n]) != info.leaf_crcs[i]:
             raise CheckpointError(
                 f"{source}: leaf {i} checksum mismatch (payload corrupted)"
             )
         pos += n
-    if _container_crc(mv[:off], leaf_crcs) != container_crc:
+    if _expected_digest(info, mv[:off]) != info.container_crc:
         raise CheckpointError(
             f"{source}: container digest mismatch (header or trailer corrupted)"
         )
@@ -810,35 +1116,109 @@ def verify_file(path: str, chunk: int = 4 << 20) -> tuple[str, str]:
                         f"{len(prefix) + payload})"
                     )
                 return "unverified", "format v1 (no checksums recorded)"
-            tsize = trailer_size(len(specs))
-            expected = len(prefix) + payload + tsize
-            if size != expected:
-                return "corrupt", (
-                    f"container size mismatch (want {expected} bytes, found {size})"
-                )
-            f.seek(len(prefix) + payload)
-            algo, leaf_crcs, container_crc = parse_trailer(f.read(tsize), path)
-            if algo not in _VERIFIABLE_TAGS:
+            info = _read_file_trailer(f, magic, specs, len(prefix), path)
+            if not info.verifiable:
                 return "unverified", (
-                    f"signed with algorithm tag {algo!r}; this host verifies "
-                    f"{_ALGO_TAG!r} ({CRC_ALGO})"
+                    f"signed with algorithm tag {info.algo!r}; this host "
+                    f"verifies {_ALGO_TAG!r} ({CRC_ALGO})"
                 )
             f.seek(len(prefix))
-            for i, spec in enumerate(specs):
-                remaining = int(spec["nbytes"])
-                crc = 0
-                while remaining:
-                    buf = f.read(min(chunk, remaining))
-                    if not buf:
-                        return "corrupt", f"leaf {i}: short read"
-                    crc = crc32c(buf, crc)
-                    remaining -= len(buf)
-                if crc != leaf_crcs[i]:
-                    return "corrupt", f"leaf {i} checksum mismatch"
-            if _container_crc(prefix, leaf_crcs) != container_crc:
+            if info.chunk_crcs is not None:
+                # v3: one streaming pass checks the chunk manifest AND the
+                # leaf records (a chunk-aligned read feeds both).
+                flat = 0
+                for i, spec in enumerate(specs):
+                    remaining = int(spec["nbytes"])
+                    crc = 0
+                    while remaining:
+                        buf = f.read(min(info.chunk_size, remaining))
+                        if not buf:
+                            return "corrupt", f"leaf {i}: short read"
+                        if crc32c(buf) != info.chunk_crcs[flat]:
+                            return "corrupt", (
+                                f"leaf {i} chunk {flat} checksum mismatch"
+                            )
+                        flat += 1
+                        crc = crc32c(buf, crc)
+                        remaining -= len(buf)
+                    if crc != info.leaf_crcs[i]:
+                        return "corrupt", f"leaf {i} checksum mismatch"
+            else:
+                for i, spec in enumerate(specs):
+                    remaining = int(spec["nbytes"])
+                    crc = 0
+                    while remaining:
+                        buf = f.read(min(chunk, remaining))
+                        if not buf:
+                            return "corrupt", f"leaf {i}: short read"
+                        crc = crc32c(buf, crc)
+                        remaining -= len(buf)
+                    if crc != info.leaf_crcs[i]:
+                        return "corrupt", f"leaf {i} checksum mismatch"
+            if _expected_digest(info, prefix) != info.container_crc:
                 return "corrupt", "container digest mismatch (header/trailer)"
-            return "ok", f"{len(specs)} leaves, {payload} payload bytes ({CRC_ALGO})"
+            detail = f"{len(specs)} leaves, {payload} payload bytes ({CRC_ALGO})"
+            if info.chunk_crcs is not None:
+                detail += (
+                    f", {len(info.chunk_crcs)} chunks @ {info.chunk_size} B"
+                )
+            return "ok", detail
     except CheckpointError as e:
         return "corrupt", str(e)
     except OSError as e:
         return "corrupt", f"unreadable: {e}"
+
+
+def read_trailer(path: str) -> tuple[dict, int, Optional[TrailerInfo]]:
+    """Parse a container's header AND trailer without touching the payload:
+    ``(header, prefix_len, TrailerInfo-or-None)`` — two small reads. This is
+    the chunk-granular serve path's geometry source: a v3 container's chunk
+    manifest loads in O(trailer) so ranged reads can verify O(range) instead
+    of paying a whole-file pass. ``None`` trailer = a v1 container."""
+    with open(path, "rb") as f:
+        magic, header, prefix = _read_prefix(f, path)
+        if magic == MAGIC_V1:
+            return header, len(prefix), None
+        info = _read_file_trailer(f, magic, header["leaves"], len(prefix), path)
+        # The digest covers the trailer entries themselves: recompute it from
+        # the parsed records so a bit-flipped manifest can't vouch for chunks.
+        if info.verifiable and _expected_digest(info, prefix) != info.container_crc:
+            raise CheckpointError(
+                f"{path}: container digest mismatch (header or trailer corrupted)"
+            )
+        return header, len(prefix), info
+
+
+def chunk_report(path: str) -> dict:
+    """Per-chunk verification report (the ``ckpt_info --chunks`` engine):
+    ``{"status", "chunk_size", "leaves": [{"nbytes", "chunks", "bad": [...]}]}``
+    — v2/v1 containers report ``chunk_size: None`` (no manifest)."""
+    status, detail = verify_file(path)
+    out: dict = {"status": status, "detail": detail, "chunk_size": None,
+                 "leaves": []}
+    try:
+        header, prefix_len, info = read_trailer(path)
+    except (CheckpointError, OSError):
+        return out
+    if info is None or info.chunk_crcs is None or not info.verifiable:
+        return out
+    out["chunk_size"] = info.chunk_size
+    with open(path, "rb") as f:
+        f.seek(prefix_len)
+        flat = 0
+        for spec in header["leaves"]:
+            remaining = int(spec["nbytes"])
+            nchunks = leaf_chunk_count(remaining, info.chunk_size)
+            bad: list[int] = []
+            for c in range(nchunks):
+                buf = f.read(min(info.chunk_size, remaining))
+                if len(buf) != min(info.chunk_size, remaining) or crc32c(
+                    buf
+                ) != info.chunk_crcs[flat]:
+                    bad.append(c)
+                flat += 1
+                remaining -= len(buf)
+            out["leaves"].append(
+                {"nbytes": int(spec["nbytes"]), "chunks": nchunks, "bad": bad}
+            )
+    return out

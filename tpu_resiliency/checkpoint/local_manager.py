@@ -47,6 +47,8 @@ from typing import Any, Optional
 from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint import reshard as reshard_mod
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
+from tpu_resiliency.checkpoint.coding import delta as ckpt_delta
+from tpu_resiliency.checkpoint.coding import strategy as ckpt_coding
 from tpu_resiliency.checkpoint.comm import StoreComm
 from tpu_resiliency.checkpoint.replication import CliqueReplicationStrategy
 from tpu_resiliency.checkpoint.staging import HostStagingPool
@@ -62,11 +64,21 @@ import pickle
 log = get_logger(__name__)
 
 _FILE_RE = re.compile(r"^iter_(\d{7})_(\d+)_local\.ckpt$")
+#: Erasure block artifact (``checkpoint/coding/strategy.py``): the filename
+#: self-describes ``(iteration, owner, index, k, m)`` so coverage math and
+#: retention never parse artifact headers.
+_BLOCK_RE = re.compile(
+    r"^iter_(\d{7})_(\d+)_b(\d+)k(\d+)m(\d+)_local\.ecblk$"
+)
 #: Quarantined container: ``<container-name>.corrupt-<hex-ts>`` (the suffix
 #: orders same-id quarantines; cleanup keeps the newest per container name).
 _CORRUPT_RE = re.compile(
     r"^(iter_\d{7}_\d+_local\.ckpt)\.corrupt(?:-[0-9a-f]+)?$"
 )
+
+
+def block_filename(iteration: int, owner: int, index: int, k: int, m: int) -> str:
+    return f"iter_{iteration:07d}_{owner}_b{index}k{k}m{m}_local.ecblk"
 
 
 @dataclasses.dataclass(frozen=True, order=True)
@@ -117,6 +129,52 @@ def _write_blobs(paths_and_blobs: list[tuple[str, Any]]) -> None:
     )
 
 
+def _persist_artifacts(items: list[tuple]) -> None:
+    """Async-part worker for byte-economy payloads (module-level: picklable).
+
+    ``items`` mix three shapes: ``("blob", path, payload)`` — a container or
+    erasure-block artifact written verbatim; ``("parts", path, parts)`` — a
+    ``serialize_parts`` result streamed with no join; ``("delta", out_path,
+    frame, base_path, owner, iteration)`` — a delta frame applied against the
+    held base container. A broken delta chain (missing/stale base) drops
+    THAT mirror with a ``ckpt_delta_applied{outcome=broken}`` event instead
+    of failing the save — the shard simply has fewer mirrors until the next
+    keyframe re-bases the clique."""
+    plain: list[tuple[str, Any]] = []
+    for item in items:
+        if item[0] == "delta":
+            _, out_path, frame, base_path, owner, iteration = item
+            try:
+                written = ckpt_delta.apply_delta(frame, base_path, out_path)
+                ckpt_delta.record_applied(
+                    owner, iteration, "ok", bytes=written,
+                    frame_bytes=memoryview(frame).nbytes,
+                )
+            except CheckpointError as e:
+                log.warning(
+                    f"delta mirror for owner {owner} @ iteration {iteration} "
+                    f"dropped: {e}"
+                )
+                ckpt_delta.record_applied(
+                    owner, iteration, "broken", error=repr(e)
+                )
+        else:
+            plain.append((item[1], item[2]))
+    if plain:
+        _write_blobs(plain)
+
+
+def _items_nbytes(items: list[tuple]) -> int:
+    total = 0
+    for item in items:
+        payload = item[2]
+        if isinstance(payload, list):
+            total += sum(memoryview(p).cast("B").nbytes for p in payload)
+        else:
+            total += memoryview(payload).cast("B").nbytes
+    return total
+
+
 class LocalCheckpointManager:
     """Per-rank local checkpoint manager.
 
@@ -136,6 +194,7 @@ class LocalCheckpointManager:
         pipelined: Optional[bool] = None,
         staging: Optional[HostStagingPool] = None,
         keep: int = 1,
+        delta_interval: Optional[int] = None,
     ):
         self.root = root
         self.rank = rank
@@ -143,6 +202,22 @@ class LocalCheckpointManager:
         self.comm = comm
         self.replication = replication
         self._caller_kind = caller
+        #: Delta-checkpoint chain state (``checkpoint/coding/delta.py``):
+        #: ``delta_interval`` N > 1 ships up to N-1 chunk-diff replication
+        #: rounds between full keyframes (default: ``$TPU_RESILIENCY_CKPT_DELTA``,
+        #: off). Mutually exclusive with erasure replication — parity blocks
+        #: already move ``payload/k`` per peer and the chain semantics don't
+        #: compose.
+        self._delta = ckpt_delta.DeltaTracker(delta_interval)
+        if (
+            self._delta.enabled
+            and replication is not None
+            and getattr(replication, "coded", False)
+        ):
+            raise CheckpointError(
+                "delta_interval and erasure replication are mutually "
+                "exclusive (chunk-diff frames have no defined parity blocks)"
+            )
         #: Covered iterations retained after a successful save. 1 = the
         #: reference's newest-only recovery buffer; >=2 additionally keeps
         #: older rungs for the recovery ladder to fall back to when the newest
@@ -246,6 +321,41 @@ class LocalCheckpointManager:
                 out.add(CkptID(int(m.group(1)), int(m.group(2)), self.session))
         return out
 
+    def block_ids(self) -> set[tuple[int, int, int, int, int]]:
+        """Erasure block artifacts on this rank's disk:
+        ``(iteration, owner, index, k, m)`` — the filenames self-describe."""
+        out = set()
+        for name in os.listdir(self._dir):
+            m = _BLOCK_RE.match(name)
+            if m:
+                out.add(tuple(int(g) for g in m.groups()))
+        return out
+
+    def _block_path(
+        self, iteration: int, owner: int, index: int, k: int, m: int
+    ) -> str:
+        return os.path.join(
+            self._dir, block_filename(iteration, owner, index, k, m)
+        )
+
+    def _read_block(self, iteration: int, owner: int, index: int) -> bytes:
+        """Load one held block artifact (code geometry resolved from the
+        filename inventory)."""
+        for it, o, idx, k, m in self.block_ids():
+            if (it, o, idx) == (iteration, owner, index):
+                path = self._block_path(it, o, idx, k, m)
+                try:
+                    with open(path, "rb") as f:
+                        return f.read()
+                except OSError as e:
+                    raise CheckpointError(
+                        f"{path}: unreadable block artifact ({e!r})"
+                    ) from e
+        raise CheckpointError(
+            f"rank {self.rank} holds no block (owner {owner}, index {index}) "
+            f"@ iteration {iteration}"
+        )
+
     def _path(self, ckpt_id: CkptID) -> str:
         return os.path.join(self._dir, ckpt_id.filename())
 
@@ -326,26 +436,50 @@ class LocalCheckpointManager:
                 meta={"iteration": iteration, **(meta or {})},
             )
             # Total container size includes the integrity trailer — its size
-            # is fixed by the leaf count, so the stream can declare it before
-            # any D2H byte lands (the CRCs themselves resolve leaf by leaf).
+            # is fixed by the leaf specs + chunk size, so the stream can
+            # declare it before any D2H byte lands (the CRCs themselves
+            # resolve leaf by leaf).
             total = (
                 len(prefix) + snapshot.nbytes
-                + ckpt_format.trailer_size(len(snapshot))
+                + ckpt_format.trailer_size_for(
+                    [s["nbytes"] for s in snapshot.specs]
+                )
             )
             # Round tag minted HERE, in save-call order, so concurrent
-            # background rounds stay aligned across ranks.
-            stream = (
-                self.replication.start_stream(total)
+            # background rounds stay aligned across ranks — whether the round
+            # is a leaf-streaming mirror fan-out (stream), an erasure block
+            # exchange, or a delta frame (pending): all three consume the
+            # same per-strategy round counter in foreground order.
+            repl = (
+                self.replication
                 if self.replication is not None and self.replication.enabled
                 else None
             )
+            stream = pending = delta_base = None
+            if repl is not None:
+                if repl.coded:
+                    pending = repl.start_round()
+                    pending.iteration = iteration
+                else:
+                    if self._delta.enabled and not self.queue.unfinalized_indices:
+                        delta_base = self._delta.eligible(
+                            [int(s["nbytes"]) for s in snapshot.specs]
+                        )
+                    if delta_base is not None:
+                        pending = repl.start_round()
+                        pending.iteration = iteration
+                    else:
+                        stream = repl.start_stream(total)
             own_path = self._path(CkptID(iteration, self.rank, self.session))
             # The worker fills in the final on-disk volume (own shard +
             # received mirrors); finalize reads it after the async part is done.
             sizes: dict = {}
             req = AsyncRequest(
                 async_fn=self._pipelined_worker,
-                async_fn_args=(own_path, prefix, snapshot, stream, iteration, sizes),
+                async_fn_args=(
+                    own_path, prefix, snapshot, stream, pending, delta_base,
+                    iteration, sizes,
+                ),
                 cleanup_fns=(snapshot.release,),
                 finalize_fns=(
                     lambda: self._finalize_save(iteration, sizes.get("bytes")),
@@ -366,26 +500,31 @@ class LocalCheckpointManager:
         return req
 
     def _pipelined_worker(
-        self, own_path: str, prefix: bytes, snapshot, stream, iteration: int,
-        sizes: dict,
+        self, own_path: str, prefix: bytes, snapshot, stream, pending,
+        delta_base, iteration: int, sizes: dict,
     ) -> None:
         """Background half of a pipelined save: one pass over the leaves in
-        D2H order, each resolved leaf going to the local shard file and every
-        clique peer before the next is touched. The same pass feeds the
+        D2H order, each resolved leaf going to the local shard file (and, in
+        mirror-stream mode, every clique peer) before the next is touched.
+        The same pass feeds the
         :class:`~tpu_resiliency.checkpoint.format.Checksummer`, so the
-        integrity trailer costs zero extra reads and both the local file and
-        every peer receive a complete, verifiable v2 container."""
+        integrity trailer (leaf CRCs + chunk manifest) costs zero extra
+        reads. ``pending`` rounds (erasure blocks / delta frames) run their
+        exchange AFTER the local write, off the already-resolved staged
+        views — the byte-economy payloads need the full manifest first."""
         t0 = time.perf_counter()
         total = (
             len(prefix) + snapshot.nbytes
-            + ckpt_format.trailer_size(len(snapshot))
+            + ckpt_format.trailer_size_for([s["nbytes"] for s in snapshot.specs])
         )
         try:
             if stream is not None:
                 stream.open()
+            state: dict = {}
 
             def chunks():
                 ck = ckpt_format.Checksummer(prefix)
+                state["ck"] = ck
                 if stream is not None:
                     stream.send_chunk(prefix)
                 yield prefix
@@ -396,18 +535,58 @@ class LocalCheckpointManager:
                         stream.send_chunk(view)
                     yield view
                 trailer = ck.trailer()
+                state["trailer"] = trailer
                 if stream is not None:
                     stream.send_chunk(trailer)
                 yield trailer
 
             ckpt_format.write_stream(own_path, chunks())
-            received = stream.finish() if stream is not None else {}
-            mirror_writes = [
-                (self._path(CkptID(iteration, owner, self.session)), blob)
-                for owner, blob in received.items()
-            ]
-            if mirror_writes:
-                _write_blobs(mirror_writes)
+            sent_delta = False
+            if stream is not None:
+                received = stream.finish()
+            elif pending is not None:
+                views = [
+                    snapshot.resolve_view(i) for i in range(len(snapshot))
+                ]
+                trailer = state["trailer"]
+                payload: list = [prefix, *views, trailer]
+                if delta_base is not None:
+                    try:
+                        frame, stats = ckpt_delta.encode_delta(
+                            self.rank, iteration, delta_base, prefix, views,
+                            trailer,
+                        )
+                        record_event(
+                            "checkpoint", "ckpt_delta",
+                            iteration=iteration, rank=self.rank,
+                            base_iteration=delta_base["iteration"], **stats,
+                        )
+                        payload = [frame]
+                        sent_delta = True
+                    except CheckpointError as e:
+                        log.warning(
+                            f"rank {self.rank}: delta encode @ iteration "
+                            f"{iteration} fell back to keyframe: {e}"
+                        )
+                received = self.replication.exchange_round(pending, payload)
+            else:
+                received = {}
+            if (
+                self._delta.enabled
+                and self.replication is not None
+                and not self.replication.coded
+            ):
+                ck = state["ck"]
+                self._delta.note_saved(
+                    iteration,
+                    [int(s["nbytes"]) for s in snapshot.specs],
+                    ck.chunk_size, ck.leaf_chunks,
+                    ckpt_format._U32.unpack(state["trailer"][-4:])[0],
+                    keyframe=not sent_delta,
+                )
+            items = self._received_items(iteration, received)
+            if items:
+                _persist_artifacts(items)
         except BaseException as e:
             if stream is not None:
                 stream.abort()
@@ -462,27 +641,35 @@ class LocalCheckpointManager:
                 # Process/fork callers pickle the async args; materialize the
                 # views (thread caller — the default — stays zero-copy).
                 parts = [prefix] + [bytes(v) for v in views]
-        with debug_time("ckpt.save.replicate", source="checkpoint"):
-            received = (
-                self.replication.replicate_parts(parts)
-                if self.replication is not None and self.replication.enabled
-                else {}
-            )
-        writes: list[tuple[str, Any]] = [
-            (self._path(CkptID(iteration, self.rank, self.session)), parts)
-        ]
-        writes += [
-            (self._path(CkptID(iteration, owner, self.session)),
-             bytes(b) if self._caller_kind != "thread" and not isinstance(b, bytes) else b)
-            for owner, b in received.items()
-        ]
-        total_bytes = sum(
-            sum(len(p) for p in b) if isinstance(b, list) else len(b)
-            for _, b in writes
+        repl = (
+            self.replication
+            if self.replication is not None and self.replication.enabled
+            else None
         )
+        frame = None
+        with debug_time("ckpt.save.replicate", source="checkpoint"):
+            if repl is None:
+                received = {}
+            else:
+                pending = repl.start_round()
+                pending.iteration = iteration
+                payload: list[Any] = parts
+                frame = self._maybe_delta_frame(
+                    iteration, prefix, views, coded=repl.coded
+                )
+                if frame is not None:
+                    payload = [frame]
+                received = repl.exchange_round(pending, payload)
+        self._note_delta_base(iteration, views, repl, keyframe=frame is None)
+        items: list[tuple] = [
+            ("parts", self._path(CkptID(iteration, self.rank, self.session)),
+             parts)
+        ]
+        items += self._received_items(iteration, received)
+        total_bytes = _items_nbytes(items)
         req = AsyncRequest(
-            async_fn=_write_blobs,
-            async_fn_args=(writes,),
+            async_fn=_persist_artifacts,
+            async_fn_args=(items,),
             finalize_fns=(lambda: self._finalize_save(iteration, total_bytes),),
         )
         if is_async:
@@ -490,6 +677,106 @@ class LocalCheckpointManager:
             return req
         req.execute_sync()
         return None
+
+    def _maybe_delta_frame(
+        self, iteration: int, prefix: bytes, views: list, coded: bool
+    ) -> Optional[bytes]:
+        """Encode this save's replication payload as a delta frame when the
+        chain allows (delta enabled, mirror strategy, base manifest matches,
+        previous save fully finalized — overlapping in-flight saves keyframe
+        so a peer can never be asked to apply against a base it hasn't
+        persisted). ``views`` is a ``serialize_parts`` view list (leaves then
+        trailer)."""
+        if not self._delta.enabled or coded:
+            return None
+        if self.queue.unfinalized_indices:
+            return None
+        leaf_sizes = [memoryview(v).cast("B").nbytes for v in views[:-1]]
+        base = self._delta.eligible(leaf_sizes)
+        if base is None:
+            return None
+        try:
+            frame, stats = ckpt_delta.encode_delta(
+                self.rank, iteration, base, prefix, views[:-1],
+                bytes(memoryview(views[-1]).cast("B")),
+            )
+        except CheckpointError as e:
+            log.warning(
+                f"rank {self.rank}: delta encode @ iteration {iteration} "
+                f"fell back to keyframe: {e}"
+            )
+            return None
+        record_event(
+            "checkpoint", "ckpt_delta",
+            iteration=iteration, rank=self.rank,
+            base_iteration=base["iteration"], **stats,
+        )
+        return frame
+
+    def _note_delta_base(
+        self, iteration: int, views: list, repl, keyframe: bool
+    ) -> None:
+        """Record this save's chunk manifest as the next delta's base (the
+        trailer part already carries it — pure metadata)."""
+        if not self._delta.enabled or repl is None or repl.coded:
+            return
+        try:
+            info = ckpt_format.parse_trailer_v3(
+                memoryview(views[-1]).cast("B"), source="delta-base"
+            )
+        except CheckpointError:
+            self._delta.reset()
+            return
+        leaf_sizes = [memoryview(v).cast("B").nbytes for v in views[:-1]]
+        self._delta.note_saved(
+            iteration, leaf_sizes, info.chunk_size,
+            info.leaf_chunk_crcs(leaf_sizes), info.container_crc,
+            keyframe=keyframe,
+        )
+
+    def _received_items(self, iteration: int, received: dict) -> list[tuple]:
+        """Route a replication round's received payloads to persistence ops:
+        mirrors by (iteration, owner) path, erasure blocks by their
+        self-described identity, delta frames to an apply against the held
+        base container."""
+        items: list[tuple] = []
+        for owner, blob in received.items():
+            if self._caller_kind != "thread" and not isinstance(blob, bytes):
+                blob = bytes(blob)
+            if ckpt_coding.is_block(blob):
+                try:
+                    it, o, idx, k, m = ckpt_coding.block_identity(blob)
+                except CheckpointError as e:
+                    log.warning(
+                        f"dropping malformed block artifact from owner "
+                        f"{owner}: {e}"
+                    )
+                    continue
+                items.append(("blob", self._block_path(it, o, idx, k, m), blob))
+            elif ckpt_delta.is_delta(blob):
+                try:
+                    header, _ = ckpt_delta.parse_delta(blob)
+                except CheckpointError as e:
+                    log.warning(
+                        f"dropping malformed delta frame from owner "
+                        f"{owner}: {e}"
+                    )
+                    continue
+                base_path = self._path(
+                    CkptID(int(header["base_iteration"]), owner, self.session)
+                )
+                items.append((
+                    "delta",
+                    self._path(CkptID(iteration, owner, self.session)),
+                    blob, base_path, owner, iteration,
+                ))
+            else:
+                items.append((
+                    "blob",
+                    self._path(CkptID(iteration, owner, self.session)),
+                    blob,
+                ))
+        return items
 
     def _finalize_save(self, iteration: int, total_bytes: Optional[int] = None) -> None:
         """Verify coverage of ``iteration`` across ranks, then prune older iterations."""
@@ -523,19 +810,42 @@ class LocalCheckpointManager:
                     os.unlink(self._path(ckpt_id))
                 except OSError:
                     pass
+        # Erasure block artifacts follow the same retention horizon.
+        for it, owner, index, k, m in self.block_ids():
+            if it < iteration and it not in retained:
+                try:
+                    os.unlink(self._block_path(it, owner, index, k, m))
+                except OSError:
+                    pass
 
     # -- coverage / find_latest -------------------------------------------
 
     def _covered_iterations(self) -> set[int]:
-        """Iterations for which the union of all ranks' holdings covers every rank."""
+        """Iterations for which the union of all ranks' holdings covers every
+        rank — where "covers" means a full container somewhere OR enough
+        erasure blocks (≥ k distinct indices of one generation) to
+        reconstruct one, so a k-of-n clique's coverage math matches what the
+        recovery ladder can actually deliver."""
         if self.comm is None:
             return {i.iteration for i in self.local_ids() if i.owner == self.rank}
         gathered = self.comm.all_gather(
-            sorted((i.iteration, i.owner) for i in self.local_ids()), tag="coverage"
+            (
+                sorted((i.iteration, i.owner) for i in self.local_ids()),
+                sorted(self.block_ids()),
+            ),
+            tag="coverage",
         )
         by_iter: dict[int, set[int]] = {}
-        for holdings in gathered:
+        blocks: dict[tuple[int, int], set[int]] = {}
+        kof: dict[tuple[int, int], int] = {}
+        for holdings, block_holdings in gathered:
             for it, owner in holdings:
+                by_iter.setdefault(it, set()).add(owner)
+            for it, owner, index, k, m in (tuple(b) for b in block_holdings):
+                blocks.setdefault((it, owner), set()).add(index)
+                kof[(it, owner)] = k
+        for (it, owner), indices in blocks.items():
+            if len(indices) >= kof[(it, owner)]:
                 by_iter.setdefault(it, set()).add(owner)
         world = set(self.comm.ranks)  # the group's actual rank ids, not range(world)
         return {it for it, owners in by_iter.items() if world <= owners}
@@ -562,6 +872,9 @@ class LocalCheckpointManager:
         self.queue.abandon()
         self.comm = comm
         self.queue.set_sync_fn(comm.make_sync_fn() if comm is not None else None)
+        # The delta chain is clique-scoped: new membership means peers whose
+        # base inventory this rank cannot reason about — next save keyframes.
+        self._delta.reset()
         if self.replication is None:
             return
         self.replication.rebuild(comm)
@@ -569,19 +882,38 @@ class LocalCheckpointManager:
             return
         own = [i.iteration for i in self.local_ids() if i.owner == self.rank]
         newest = max(own) if own else None
+        kwargs = {}
+        if getattr(self.replication, "coded", False):
+            kwargs = dict(
+                held_blocks={
+                    (o, it, idx, k, m)
+                    for it, o, idx, k, m in self.block_ids()
+                },
+                get_block=lambda o, it, idx: self._read_block(it, o, idx),
+            )
         received = self.replication.remirror(
             newest,
             lambda owner, it: self._read_blob(it, owner),
             held={(i.owner, i.iteration) for i in self.local_ids()},
             # On-disk shards stream file→socket via sendfile (no userspace copy).
             get_path=lambda owner, it: self._path(CkptID(it, owner, self.session)),
+            **kwargs,
         )
-        writes = [
-            (self._path(CkptID(it, owner, self.session)), blob)
-            for owner, (it, blob) in received.items()
-        ]
-        if writes:
-            _write_blobs(writes)
+        items: list[tuple] = []
+        for owner, (it, blob) in received.items():
+            if ckpt_coding.is_block(blob):
+                try:
+                    bit, o, idx, k, m = ckpt_coding.block_identity(blob)
+                except CheckpointError as e:
+                    log.warning(f"remirror: dropping malformed block ({e})")
+                    continue
+                items.append(("blob", self._block_path(bit, o, idx, k, m), blob))
+            else:
+                items.append(
+                    ("blob", self._path(CkptID(it, owner, self.session)), blob)
+                )
+        if items:
+            _persist_artifacts(items)
         record_event(
             "checkpoint", "ckpt_group_rebuilt", rank=self.rank,
             group=self.replication.my_group, remirrored=sorted(received),
@@ -678,9 +1010,24 @@ class LocalCheckpointManager:
             # agreement round in _load, so ranks fall back in lockstep).
             return result, result is not None
         try:
+            # The coded strategy's retrieve runs the reconstruct-from-parity
+            # rung first (quarantine → reconstruct → peer retrieve →
+            # fallback); feed it this rank's block inventory for the
+            # iteration. The mirror strategy keeps its classic signature.
+            kwargs = {}
+            if getattr(self.replication, "coded", False):
+                kwargs = dict(
+                    my_held_blocks={
+                        (o, idx, k, m)
+                        for it, o, idx, k, m in self.block_ids()
+                        if it == iteration
+                    },
+                    get_block=lambda o, idx: self._read_block(iteration, o, idx),
+                )
             blob = self.replication.retrieve(
                 needed, self._held_owners(iteration),
                 lambda o: self._read_blob(iteration, o), get_path=get_path,
+                **kwargs,
             )
         except CheckpointError as e:
             # "No live holder" (raised on every rank, deterministically) or a
@@ -834,10 +1181,16 @@ class LocalCheckpointManager:
 
     def _container_geometry(self, iteration: int, owner: int) -> dict:
         """Parse (once per file version) a held container's geometry: header
-        prefix length, per-leaf payload offsets/specs, hollow bytes and meta —
-        plus a full streaming integrity pass (the PR-5 checksummer), so every
-        byte a reshard serves or slices locally comes from a verified file.
-        A corrupt container is quarantined and surfaces as CheckpointError."""
+        prefix length, per-leaf payload offsets/specs, hollow bytes and meta.
+
+        Integrity is version-aware: a ``TPURES03`` container's chunk manifest
+        loads here in O(trailer) — two small reads — and every byte the
+        reshard path later serves or slices is verified CHUNK-GRANULAR on
+        first touch (``_read_ranges``), so serving a 4 KB range never pays a
+        whole-container CRC scan (the serve-side stall BENCH_reshard.json
+        measured). Pre-chunk containers (``TPURES02``/v1/foreign algo) keep
+        the one-time full streaming pass. A corrupt container is quarantined
+        and surfaces as CheckpointError either way."""
         path = self._path(CkptID(iteration, owner, self.session))
         try:
             st = os.stat(path)
@@ -847,30 +1200,55 @@ class LocalCheckpointManager:
         cached = self._reshard_cache.get(path)
         if cached is not None and cached[0] == key:
             return cached[1]
-        status, detail = ckpt_format.verify_file(path)
-        if status == "corrupt":
+        header = info = None
+        try:
+            header, prefix_len, info = ckpt_format.read_trailer(path)
+        except CheckpointError as e:
             self._quarantine(
                 path, stage="reshard-verify", iteration=iteration, owner=owner,
-                error=detail,
+                error=e,
             )
             self._reshard_cache.pop(path, None)
-            raise CheckpointError(f"{path}: corrupt container ({detail})")
-        try:
-            with open(path, "rb") as f:
-                _, header, prefix = ckpt_format._read_prefix(f, path)
+            raise CheckpointError(f"{path}: corrupt container ({e})") from e
         except OSError as e:
             raise CheckpointError(f"{path}: unreadable shard ({e!r})") from e
-        offs, pos = [], len(prefix)
+        chunked = (
+            info is not None and info.chunk_crcs is not None and info.verifiable
+        )
+        if not chunked:
+            # No chunk manifest to verify ranges against: fall back to the
+            # one-time whole-file pass (old behavior, cached per file version).
+            status, detail = ckpt_format.verify_file(path)
+            if status == "corrupt":
+                self._quarantine(
+                    path, stage="reshard-verify", iteration=iteration,
+                    owner=owner, error=detail,
+                )
+                self._reshard_cache.pop(path, None)
+                raise CheckpointError(f"{path}: corrupt container ({detail})")
+        offs, pos = [], prefix_len
         for spec in header["leaves"]:
             offs.append(pos)
             pos += int(spec["nbytes"])
         geom = {
             "path": path,
+            "iteration": iteration,
+            "owner": owner,
             "leaf_offsets": offs,
             "leaf_specs": header["leaves"],
             "hollow": header["hollow"],
             "meta": header.get("meta", {}),
-            "verified": status == "ok",
+            "verified": not chunked,
+            "chunk_size": info.chunk_size if chunked else None,
+            "chunk_crcs": (
+                info.leaf_chunk_crcs(
+                    [int(s["nbytes"]) for s in header["leaves"]]
+                )
+                if chunked else None
+            ),
+            #: (leaf, chunk) pairs that passed their CRC — chunk-granular
+            #: verification state, grows as ranges are touched.
+            "verified_chunks": set(),
         }
         self._reshard_cache[path] = (key, geom)
         return geom
@@ -878,8 +1256,15 @@ class LocalCheckpointManager:
     def _read_ranges(
         self, iteration: int, owner: int, ranges: list
     ) -> list[bytes]:
-        """pread leaf-relative byte ranges out of a locally-held (verified)
-        container; ``ranges`` items are ``(leaf, src_off, nbytes)``."""
+        """pread leaf-relative byte ranges out of a locally-held container;
+        ``ranges`` items are ``(leaf, src_off, nbytes)``.
+
+        Verification is O(range) on chunked (``TPURES03``) containers: only
+        the chunks covering each requested range are CRC-checked, on first
+        touch (verdicts cached per file version). Pre-chunk containers were
+        verified whole by ``_container_geometry``. A chunk that fails its CRC
+        quarantines the container and raises — the caller's degraded-holder /
+        recovery machinery owns the retry."""
         geom = self._container_geometry(iteration, owner)
         out: list[bytes] = []
         with open(geom["path"], "rb") as f:
@@ -897,6 +1282,11 @@ class LocalCheckpointManager:
                         f"{geom['path']}: range [{off}, {off + nbytes}) outside "
                         f"leaf {leaf} payload of {limit} bytes"
                     )
+                if geom["chunk_size"] is not None:
+                    out.append(
+                        self._pread_chunk_verified(fd, geom, leaf, off, nbytes)
+                    )
+                    continue
                 buf = os.pread(fd, nbytes, geom["leaf_offsets"][leaf] + off)
                 if len(buf) != nbytes:
                     raise CheckpointError(
@@ -905,6 +1295,56 @@ class LocalCheckpointManager:
                     )
                 out.append(buf)
         return out
+
+    def _pread_chunk_verified(
+        self, fd: int, geom: dict, leaf: int, off: int, nbytes: int
+    ) -> bytes:
+        """One leaf-relative range off a chunked container: pread the covering
+        chunk span, CRC any not-yet-verified covering chunk against the
+        manifest, slice the requested bytes out. Already-verified spans pread
+        exactly the requested range."""
+        if nbytes == 0:
+            return b""
+        cs = geom["chunk_size"]
+        leaf_nbytes = int(geom["leaf_specs"][leaf]["nbytes"])
+        base = geom["leaf_offsets"][leaf]
+        first, last = ckpt_format.chunk_spans(leaf_nbytes, cs, off, nbytes)
+        vset = geom["verified_chunks"]
+        if all((leaf, c) in vset for c in range(first, last)):
+            buf = os.pread(fd, nbytes, base + off)
+            if len(buf) != nbytes:
+                raise CheckpointError(
+                    f"{geom['path']}: short read in leaf {leaf} "
+                    f"({len(buf)} of {nbytes} bytes)"
+                )
+            return buf
+        span_start = first * cs
+        span_end = min(last * cs, leaf_nbytes)
+        blob = os.pread(fd, span_end - span_start, base + span_start)
+        if len(blob) != span_end - span_start:
+            raise CheckpointError(
+                f"{geom['path']}: short read in leaf {leaf} chunk span "
+                f"({len(blob)} of {span_end - span_start} bytes)"
+            )
+        mv = memoryview(blob)
+        crcs = geom["chunk_crcs"][leaf]
+        for c in range(first, last):
+            if (leaf, c) in vset:
+                continue
+            w = mv[c * cs - span_start : min((c + 1) * cs, leaf_nbytes) - span_start]
+            if ckpt_format.crc32c(w) != crcs[c]:
+                self._quarantine(
+                    geom["path"], stage="chunk-verify",
+                    iteration=geom["iteration"], owner=geom["owner"],
+                    error=f"leaf {leaf} chunk {c} checksum mismatch",
+                )
+                self._reshard_cache.pop(geom["path"], None)
+                raise CheckpointError(
+                    f"{geom['path']}: leaf {leaf} chunk {c} checksum mismatch "
+                    f"(payload corrupted)"
+                )
+            vset.add((leaf, c))
+        return bytes(mv[off - span_start : off - span_start + nbytes])
 
     def _serve_ranges(self, request: dict) -> tuple[dict, list]:
         """``PeerExchange.serve_ranges`` handler: answer a peer's ranged read

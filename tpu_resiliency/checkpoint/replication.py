@@ -190,6 +190,24 @@ class ExchangePlan:
         return ExchangePlan(sends=sends, recvs=recvs)
 
 
+@dataclasses.dataclass
+class PendingRound:
+    """A minted-but-not-yet-run replication round (tag agreement done on the
+    caller thread, transfer deferred — see ``start_round``). Inert when the
+    strategy is disabled or the clique has no peers. ``iteration`` is stamped
+    by the caller when the payload must self-describe (erasure block
+    artifacts carry it); the mirror strategy ignores it."""
+
+    tag: Optional[str]
+    peers: list[int]
+    round: int
+    iteration: int = -1
+
+    @property
+    def active(self) -> bool:
+        return self.tag is not None and bool(self.peers)
+
+
 class CliqueReplicationStrategy:
     """Mirror each rank's shard across its clique; route shards back after rank loss.
 
@@ -371,12 +389,31 @@ class CliqueReplicationStrategy:
     def enabled(self) -> bool:
         return self.factor > 1
 
+    #: Erasure subclass flips this: callers that must route block/section
+    #: callbacks (the local manager's ladder) gate on it.
+    coded = False
+
     def replicate(self, blob: bytes) -> dict[int, bytes]:
         """Exchange shard blobs within the clique. Returns {owner_rank: blob}."""
         self._ensure_groups()
         held = {self.comm.rank: blob}
         held.update(self.replicate_parts([blob]))
         return held
+
+    def start_round(self) -> "PendingRound":
+        """Mint a replication round WITHOUT moving bytes — the tag-agreement
+        half of a round, split out so a background worker can run the
+        transfer later while tags keep getting minted in save-call order on
+        the caller thread (the same ordering contract as
+        :meth:`start_stream`). Pair with :meth:`exchange_round`."""
+        self._ensure_groups()
+        if not self.enabled:
+            return PendingRound(None, [], -1)
+        tag = f"repl/{self._round}"
+        rnd = self._round
+        self._round += 1
+        peers = [p for p in self.my_group if p != self.comm.rank]
+        return PendingRound(tag, peers, rnd)
 
     def replicate_parts(self, parts: Sequence[Any]) -> dict[int, Any]:
         """Exchange this rank's shard (as its constituent buffers) within the
@@ -399,16 +436,18 @@ class CliqueReplicationStrategy:
         receive waits share ONE round deadline (``exchange.timeout``), so k
         degraded peers cost one timeout, not k.
         """
-        self._ensure_groups()
-        rank = self.comm.rank
-        if not self.enabled:
+        return self.exchange_round(self.start_round(), parts)
+
+    def exchange_round(
+        self, pending: "PendingRound", parts: Sequence[Any]
+    ) -> dict[int, Any]:
+        """The transfer half of a replication round minted by
+        :meth:`start_round` — same semantics as :meth:`replicate_parts`
+        (symmetric clique exchange, degraded peers dropped not fatal), but
+        runnable on a background thread after the foreground agreed the tag."""
+        if not pending.active:
             return {}
-        tag = f"repl/{self._round}"
-        rnd = self._round
-        self._round += 1
-        peers = [p for p in self.my_group if p != rank]
-        if not peers:
-            return {}
+        tag, rnd, peers = pending.tag, pending.round, pending.peers
         nbytes = sum(memoryview(p).cast("B").nbytes for p in parts)
         received: dict[int, Any] = {}
         degraded: set[int] = set()

@@ -150,6 +150,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable restart fast-path rendezvous (round reuse); replacement "
         "rounds always take the full open/join/close ladder",
     )
+    p.add_argument(
+        "--ckpt-coding",
+        default=None,
+        metavar="mirror|erasure[:parity]",
+        help="checkpoint replication byte-economy (exports "
+        "$TPU_RESILIENCY_CKPT_CODING; workers building their replication "
+        "strategy via checkpoint.coding.replication_from_env pick it up): "
+        "'mirror' full-mirrors every shard across the clique (default), "
+        "'erasure' stores one Reed-Solomon block per peer instead — "
+        "~(1+(m-1)/k)x the payload on the wire per save vs (n-1)x",
+    )
+    p.add_argument(
+        "--ckpt-delta-interval",
+        type=int,
+        default=None,
+        metavar="N",
+        help="delta-checkpoint cycle (exports $TPU_RESILIENCY_CKPT_DELTA): "
+        "between full keyframes, up to N-1 replication rounds ship only the "
+        "chunks whose manifest CRCs changed since the previous save; 0/1 "
+        "disables (mirror strategy only)",
+    )
     p.add_argument("--term-grace", type=float, default=15.0)
     p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
     p.add_argument(
@@ -473,6 +494,14 @@ def main(argv: Optional[list[str]] = None) -> int:
         os.environ[JOB_ENV] = args.rdzv_id
     if args.metrics_file:
         os.environ[METRICS_FILE_ENV] = os.path.abspath(args.metrics_file)
+    if args.ckpt_coding:
+        from tpu_resiliency.checkpoint.coding import CODING_ENV
+
+        os.environ[CODING_ENV] = args.ckpt_coding
+    if args.ckpt_delta_interval is not None:
+        from tpu_resiliency.checkpoint.coding.delta import DELTA_ENV
+
+        os.environ[DELTA_ENV] = str(args.ckpt_delta_interval)
     if args.compile_cache_dir:
         from tpu_resiliency.platform import compile_cache
 

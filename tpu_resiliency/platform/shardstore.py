@@ -106,12 +106,15 @@ class ShardedKVClient:
     """Drop-in :class:`KVClient` over a store clique.
 
     Single-key ops route by :func:`shard_of`; prefix/scan/census ops fan out
-    serially to every shard and merge (shard count is small — 2..16 — and a
-    serial fan-out keeps the result deterministic and the error handling the
-    caller already knows: the first shard's transport failure surfaces after
-    ITS OWN retry budget and breaker, not a combined one). Thread-safe to the
-    same degree as ``KVClient`` (each underlying client locks its own
-    persistent socket).
+    to every shard CONCURRENTLY (a small persistent pool, one worker per
+    shard) and merge — shards hold disjoint keys, so the merged result is
+    identical whichever shard answers first, and a serial fan-out was paying
+    ``nshards`` sequential round trips on every reshard holder-gather and
+    census (the PR-14 headroom note). Determinism is preserved: results
+    merge in shard order, and when several shards fail the FIRST shard's
+    error (by shard index) surfaces, after that shard's own retry budget and
+    breaker — exactly the serial contract. Thread-safe to the same degree as
+    ``KVClient`` (each underlying client locks its own persistent socket).
     """
 
     def __init__(
@@ -136,6 +139,7 @@ class ShardedKVClient:
         # retries construction, so a restarted shard is picked up in place.
         self._shards: list[Optional[KVClient]] = [None] * len(self.endpoints)
         self._shards_lock = threading.Lock()
+        self._fan_pool = None  # lazy; one worker per shard
         self._closed = False
         # Single-endpoint compatibility surface (diagnostics, logs).
         self.host, self.port = self.endpoints[0]
@@ -170,10 +174,60 @@ class ShardedKVClient:
     def _live_shards(self) -> list[KVClient]:
         return [self._shard(i) for i in range(len(self.endpoints))]
 
+    def _fan_out(self, fn, contain: bool = False) -> list:
+        """Run ``fn(shard_client)`` on every shard concurrently; results in
+        shard order. With ``contain=False`` the lowest-indexed shard's
+        exception propagates (the serial-era contract); ``contain=True``
+        returns the exception object in that shard's slot instead (the
+        stats path degrades rows, never the document)."""
+        def run(i: int):
+            # Shard construction happens INSIDE the task: a dead shard's
+            # connect ladder neither blocks the other shards' ops nor (when
+            # contained) escapes its own slot.
+            return fn(self._shard(i))
+
+        if len(self.endpoints) == 1:
+            try:
+                return [run(0)]
+            except Exception as e:
+                if contain:
+                    return [e]
+                raise
+        with self._shards_lock:
+            if self._fan_pool is None:
+                if self._closed:
+                    raise StoreError("store client is closed")
+                import concurrent.futures as cf
+
+                self._fan_pool = cf.ThreadPoolExecutor(
+                    max_workers=len(self.endpoints),
+                    thread_name_prefix="store-fan",
+                )
+            pool = self._fan_pool
+        futs = [pool.submit(run, i) for i in range(len(self.endpoints))]
+        results: list = []
+        first_err: Optional[BaseException] = None
+        for f in futs:
+            try:
+                results.append(f.result())
+            except Exception as e:
+                if contain:
+                    results.append(e)
+                else:
+                    results.append(None)
+                    if first_err is None:
+                        first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
+
     def close(self) -> None:
         with self._shards_lock:
             self._closed = True
             shards, self._shards = self._shards, [None] * len(self.endpoints)
+            pool, self._fan_pool = self._fan_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         for s in shards:
             if s is None:
                 continue
@@ -252,50 +306,60 @@ class ShardedKVClient:
     # -- fan-out ops (merge across shards) ---------------------------------
 
     def ping(self) -> bool:
-        return all(s.ping() for s in self._live_shards())
+        return all(self._fan_out(lambda s: s.ping()))
 
     def check(self, keys: Iterable[str]) -> bool:
         by_shard: dict[int, list[str]] = {}
         for k in keys:
             by_shard.setdefault(shard_of(k, len(self._shards)), []).append(k)
-        return all(
-            self._shard(i).check(ks) for i, ks in sorted(by_shard.items())
-        )
+        if not by_shard:
+            return True
+        import concurrent.futures as cf
+
+        if len(by_shard) == 1:
+            ((i, ks),) = by_shard.items()
+            return self._shard(i).check(ks)
+        with cf.ThreadPoolExecutor(max_workers=len(by_shard)) as pool:
+            futs = [
+                pool.submit(self._shard(i).check, ks)
+                for i, ks in sorted(by_shard.items())
+            ]
+            return all(f.result() for f in futs)
 
     def prefix_get(self, prefix: str) -> dict[str, Any]:
         out: dict[str, Any] = {}
-        for s in self._live_shards():
-            out.update(s.prefix_get(prefix))  # shards hold disjoint keys
+        for part in self._fan_out(lambda s: s.prefix_get(prefix)):
+            out.update(part)  # shards hold disjoint keys
         return out
 
     def prefix_clear(self, prefix: str) -> int:
-        return sum(s.prefix_clear(prefix) for s in self._live_shards())
+        return sum(self._fan_out(lambda s: s.prefix_clear(prefix)))
 
     def stale_keys(self, prefix: str, max_age: float) -> dict[str, float]:
         out: dict[str, float] = {}
-        for s in self._live_shards():
-            out.update(s.stale_keys(prefix, max_age))
+        for part in self._fan_out(lambda s: s.stale_keys(prefix, max_age)):
+            out.update(part)
         return out
 
     def num_keys(self) -> int:
-        return sum(s.num_keys() for s in self._live_shards())
+        return sum(self._fan_out(lambda s: s.num_keys()))
 
     def keys(self, prefix: str = "") -> list[str]:
         out: list[str] = []
-        for s in self._live_shards():
-            out.extend(s.keys(prefix))
+        for part in self._fan_out(lambda s: s.keys(prefix)):
+            out.extend(part)
         return sorted(out)
 
     def barrier_names(self) -> list[str]:
         out: list[str] = []
-        for s in self._live_shards():
-            out.extend(s.barrier_names())
+        for part in self._fan_out(lambda s: s.barrier_names()):
+            out.extend(part)
         return sorted(out)
 
     def barrier_census(self, prefix: str = "") -> dict[str, dict]:
         out: dict[str, dict] = {}
-        for s in self._live_shards():
-            out.update(s.barrier_census(prefix))
+        for part in self._fan_out(lambda s: s.barrier_census(prefix)):
+            out.update(part)
         return out
 
     def store_stats(self) -> dict:
@@ -307,13 +371,17 @@ class ShardedKVClient:
         readers see one schema either way."""
         from tpu_resiliency.utils.opstats import merge_stats_docs
 
-        docs = []
-        for i, (h, p) in enumerate(self.endpoints):
+        def one(s: KVClient) -> dict:
             try:
-                doc = self._shard(i).store_stats()
+                return s.store_stats()
             except StoreError as e:
                 # One sick shard degrades its row, never the whole document.
-                doc = {"enabled": False, "error": repr(e)}
+                return {"enabled": False, "error": repr(e)}
+
+        docs = []
+        for (h, p), doc in zip(self.endpoints, self._fan_out(one, contain=True)):
+            if isinstance(doc, BaseException):
+                doc = {"enabled": False, "error": repr(doc)}
             doc["endpoint"] = f"{h}:{p}"
             docs.append(doc)
         merged = merge_stats_docs(docs)

@@ -112,6 +112,7 @@ class TreeComm:
         self._kids = children(index, world, self.fanout)
         self._brounds: dict[str, int] = {}
         self._grounds: dict[str, int] = {}
+        self._bcrounds: dict[str, int] = {}
         #: last-seen mutation versions of the reentrant barrier edge keys,
         #: so each wait_changed parks from where the previous round left off
         #: instead of re-reading history.
@@ -226,6 +227,10 @@ class TreeComm:
         # Read-complete ack up the tree, then the root GCs the round. An ack
         # means "me and my whole subtree have read", so when the root's ack
         # waits drain, nobody can still be parked under this round's keys.
+        self._ack_and_gc(base, deadline, tag)
+        return [result[i] for i in range(self.world)]
+
+    def _ack_and_gc(self, base: str, deadline: float, tag: str) -> None:
         for c in self._kids:
             self._get(f"{base}/a/{c}", deadline - time.monotonic(), tag)
         if self.index != 0:
@@ -233,4 +238,39 @@ class TreeComm:
         else:
             self.ops += 1
             self.store.prefix_clear(f"{base}/")
-        return [result[i] for i in range(self.world)]
+
+    # -- broadcast ----------------------------------------------------------
+
+    def broadcast(
+        self, obj: Any, src_index: int, tag: str = "bc", timeout: float = 300.0
+    ) -> Any:
+        """One value, source → everyone, through the tree.
+
+        The source publishes under one round-scoped key (one hop — unless it
+        IS the root); the root fans the value out parent→child on per-child
+        keys exactly like :meth:`all_gather`'s result phase, so no single
+        store loop serves N waiters and the critical path stays
+        O(fanout · log N). Same ack fan-in + root GC as ``all_gather``.
+        The flat broadcast parked the whole world on ONE key — the wake was
+        N frames from one event loop, the shape this module exists to kill.
+        """
+        r = self._bcrounds.get(tag, 0)
+        self._bcrounds[tag] = r + 1
+        deadline = time.monotonic() + timeout
+        base = f"{tag}/r{r}"
+        if self.index == src_index:
+            result = obj
+            if self.index != 0:
+                self._set(f"{base}/v", obj)
+        if self.index == 0:
+            result = obj if src_index == 0 else self._get(
+                f"{base}/v", deadline - time.monotonic(), tag
+            )
+        elif self.index != src_index:
+            result = self._get(
+                f"{base}/res/{self.index}", deadline - time.monotonic(), tag
+            )
+        for c in self._kids:
+            self._set(f"{base}/res/{c}", result)
+        self._ack_and_gc(base, deadline, tag)
+        return result

@@ -39,7 +39,11 @@ import re
 import sys
 from typing import Optional
 
-from tpu_resiliency.checkpoint.local_manager import _CORRUPT_RE, _FILE_RE
+from tpu_resiliency.checkpoint.local_manager import (
+    _BLOCK_RE,
+    _CORRUPT_RE,
+    _FILE_RE,
+)
 from tpu_resiliency.tools import SIGPIPE_EXIT, pipe_safe
 
 _SESSION_RE = re.compile(r"^s(\d+)$")
@@ -62,17 +66,36 @@ class SessionInfo:
     quarantined: list = dataclasses.field(default_factory=list)
     #: container files eligible for --verify: [(path, holder, iter, owner)]
     files: list = dataclasses.field(default_factory=list)
+    #: erasure block artifacts: iteration -> owner -> {index: set(holders)}
+    #: plus the code's k per (iteration, owner) — k-of-n coverage input
+    blocks: dict = dataclasses.field(default_factory=dict)
+    block_k: dict = dataclasses.field(default_factory=dict)
+    #: block artifact files: [(path, holder, iter, owner, index)]
+    block_files: list = dataclasses.field(default_factory=list)
 
     @property
     def owners(self) -> set:
         out = set()
         for by_owner in self.holdings.values():
             out |= set(by_owner)
+        for by_owner in self.blocks.values():
+            out |= set(by_owner)
+        return out
+
+    def reconstructible(self, it: int) -> set:
+        """Owners whose shard k-of-n erasure blocks can reassemble at ``it``
+        (≥ k distinct surviving block indices)."""
+        out = set()
+        for owner, by_index in self.blocks.get(it, {}).items():
+            if len(by_index) >= self.block_k.get((it, owner), 1 << 30):
+                out.add(owner)
         return out
 
     def covered_iterations(self, world: Optional[set] = None) -> list:
         """Iterations where every rank of ``world`` finds its shard held
-        somewhere (the offline analogue of ``_covered_iterations``).
+        somewhere — a full container on some holder, or enough erasure
+        blocks to reconstruct one (the offline analogue of
+        ``_covered_iterations``).
 
         Coverage is **group-relative**: a restarted group resumes from the
         newest iteration whose owner set covers *that group* — after an
@@ -81,10 +104,11 @@ class SessionInfo:
         filesystem shows (rank dirs plus every owner ever named), i.e. the
         original full world."""
         world = (self.ranks | self.owners) if world is None else set(world)
+        its = set(self.holdings) | set(self.blocks)
         return sorted(
             it
-            for it, by_owner in self.holdings.items()
-            if world <= set(by_owner)
+            for it in its
+            if world <= (set(self.holdings.get(it, ())) | self.reconstructible(it))
         )
 
 
@@ -125,6 +149,21 @@ def scan(root: str, session: Optional[int] = None) -> list[SessionInfo]:
                 if _CORRUPT_RE.match(fname):
                     info.quarantined.append(os.path.join(rdir, fname))
                     continue
+                bm = _BLOCK_RE.match(fname)
+                if bm:
+                    it, owner, index, k, m = (int(g) for g in bm.groups())
+                    fpath = os.path.join(rdir, fname)
+                    try:
+                        size = os.path.getsize(fpath)
+                    except OSError:
+                        continue
+                    info.blocks.setdefault(it, {}).setdefault(
+                        owner, {}
+                    ).setdefault(index, set()).add(holder)
+                    info.block_k[(it, owner)] = k
+                    info.bytes_by_iter[it] = info.bytes_by_iter.get(it, 0) + size
+                    info.block_files.append((fpath, holder, it, owner, index))
+                    continue
                 fm = _FILE_RE.match(fname)
                 if not fm:
                     continue
@@ -150,16 +189,26 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
         f"({len(info.holdings)} iterations on disk)",
         file=out,
     )
-    for it in sorted(info.holdings):
-        by_owner = info.holdings[it]
-        missing = sorted(set(audit_world) - set(by_owner))
+    for it in sorted(set(info.holdings) | set(info.blocks)):
+        by_owner = info.holdings.get(it, {})
+        recon = info.reconstructible(it)
+        missing = sorted(set(audit_world) - set(by_owner) - recon)
         copies = sum(len(h) for h in by_owner.values())
         mb = info.bytes_by_iter.get(it, 0) / 1e6
         status = "COVERED" if it in covered else f"missing owners {missing}"
         mirrors = copies - len(by_owner)
+        nblocks = sum(
+            len(holders)
+            for by_index in info.blocks.get(it, {}).values()
+            for holders in by_index.values()
+        )
+        ec = (
+            f", {nblocks} erasure blocks"
+            f" (reconstructible: {sorted(recon)})" if nblocks else ""
+        )
         print(
             f"  iter {it:7d}: owners {sorted(by_owner)}, "
-            f"{mirrors} mirror copies, {mb:.1f} MB  [{status}]",
+            f"{mirrors} mirror copies{ec}, {mb:.1f} MB  [{status}]",
             file=out,
         )
     if covered:
@@ -191,16 +240,37 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
 
 
 def verify(sessions: list[SessionInfo], out=None) -> int:
-    """Stream-verify every container in ``sessions`` (bounded memory, one
-    line per file); returns the number of corrupt files."""
+    """Stream-verify every container (and erasure block artifact) in
+    ``sessions`` (bounded memory, one line per file); returns the number of
+    corrupt files. v3 container verdicts are chunk-granular: a corrupt file
+    names the exact ``leaf/chunk`` that failed, an intact one reports its
+    manifest geometry."""
     from tpu_resiliency.checkpoint import format as ckpt_format
+    from tpu_resiliency.checkpoint.coding import strategy as ckpt_coding
+    from tpu_resiliency.exceptions import CheckpointError
 
     out = sys.stdout if out is None else out
     counts = {"ok": 0, "unverified": 0, "corrupt": 0}
     for info in sessions:
-        print(f"session {info.session}: verifying {len(info.files)} container(s)", file=out)
+        print(
+            f"session {info.session}: verifying {len(info.files)} "
+            f"container(s), {len(info.block_files)} erasure block(s)",
+            file=out,
+        )
         for path, holder, it, owner in sorted(info.files):
             status, detail = ckpt_format.verify_file(path)
+            counts[status] += 1
+            print(f"  [{status.upper():10s}] {path}: {detail}", file=out)
+        for path, holder, it, owner, index in sorted(info.block_files):
+            try:
+                with open(path, "rb") as f:
+                    header, block = ckpt_coding.parse_block(f.read(), source=path)
+                status, detail = "ok", (
+                    f"block {header['index']} of k={header['k']} m={header['m']} "
+                    f"(owner {header['owner']}, {block.nbytes} bytes)"
+                )
+            except (CheckpointError, OSError) as e:
+                status, detail = "corrupt", str(e)
             counts[status] += 1
             print(f"  [{status.upper():10s}] {path}: {detail}", file=out)
     print(
@@ -209,6 +279,59 @@ def verify(sessions: list[SessionInfo], out=None) -> int:
         file=out,
     )
     return counts["corrupt"]
+
+
+def render_chunks(sessions: list[SessionInfo], out=None) -> int:
+    """The ``--chunks`` view: per container, the chunk manifest geometry and
+    every failing chunk's (leaf, chunk) coordinates — what an operator reads
+    before deciding whether a damaged shard is worth a ranged repair. Exit 1
+    on any bad chunk or manifest-less corrupt file."""
+    from tpu_resiliency.checkpoint import format as ckpt_format
+
+    out = sys.stdout if out is None else out
+    bad_files = 0
+    for info in sessions:
+        print(
+            f"session {info.session}: chunk manifests for {len(info.files)} "
+            f"container(s)",
+            file=out,
+        )
+        for path, holder, it, owner in sorted(info.files):
+            rep = ckpt_format.chunk_report(path)
+            if rep["chunk_size"] is None:
+                tag = "NO-MANIFEST"
+                if rep["status"] == "corrupt":
+                    bad_files += 1
+                    tag = "CORRUPT"
+                print(
+                    f"  [{tag}] {path}: {rep['detail']} "
+                    f"(pre-chunk container — whole-file verdict only)",
+                    file=out,
+                )
+                continue
+            nchunks = sum(leaf["chunks"] for leaf in rep["leaves"])
+            bad = [
+                (li, c)
+                for li, leaf in enumerate(rep["leaves"])
+                for c in leaf["bad"]
+            ]
+            if bad:
+                bad_files += 1
+                print(
+                    f"  [CORRUPT] {path}: {len(bad)}/{nchunks} chunk(s) bad "
+                    f"@ {rep['chunk_size']} B: "
+                    + ", ".join(f"leaf {li} chunk {c}" for li, c in bad[:8])
+                    + (" ..." if len(bad) > 8 else ""),
+                    file=out,
+                )
+            else:
+                print(
+                    f"  [OK] {path}: {nchunks} chunk(s) @ "
+                    f"{rep['chunk_size']} B across {len(rep['leaves'])} "
+                    f"leaves, all verified",
+                    file=out,
+                )
+    return 1 if bad_files else 0
 
 
 def render_plan(
@@ -347,8 +470,16 @@ def main(argv: Optional[list[str]] = None) -> int:
     ap.add_argument(
         "--verify",
         action="store_true",
-        help="stream-verify every container's checksums (per-leaf CRCs + "
-        "trailer digest); print per-file verdicts; exit 1 on any mismatch",
+        help="stream-verify every container's checksums (per-leaf CRCs, v3 "
+        "chunk manifests, trailer digest) and every erasure block artifact; "
+        "print per-file verdicts; exit 1 on any mismatch",
+    )
+    ap.add_argument(
+        "--chunks",
+        action="store_true",
+        help="render per-container chunk-manifest verdicts (chunk size, "
+        "chunk count, exact (leaf, chunk) coordinates of any corruption); "
+        "exit 1 on any bad chunk",
     )
 
     def axes_spec(text: str) -> dict:
@@ -421,6 +552,15 @@ def main(argv: Optional[list[str]] = None) -> int:
         if pipe_safe(emit_verify):
             return SIGPIPE_EXIT
         return 1 if corrupt[0] else 0
+    if args.chunks:
+        rc_c = [0]
+
+        def emit_chunks():
+            rc_c[0] = render_chunks(sessions)
+
+        if pipe_safe(emit_chunks):
+            return SIGPIPE_EXIT
+        return rc_c[0]
 
     def emit():
         for info in sessions:

@@ -712,6 +712,61 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "tpu_ckpt_fallback_total",
             "recovery-ladder fallbacks to an older checkpoint iteration",
         ).inc()
+    elif kind == "ckpt_parity":
+        # One event per erasure replication round on the sending rank.
+        if isinstance(rec.get("received"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_parity_blocks_total",
+                "erasure blocks exchanged, by direction",
+                direction="received",
+            ).inc(rec["received"])
+        if isinstance(rec.get("sent_blocks"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_parity_blocks_total",
+                "erasure blocks exchanged, by direction",
+                direction="sent",
+            ).inc(rec["sent_blocks"])
+        if isinstance(rec.get("sent_bytes"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_parity_bytes_total",
+                "erasure block bytes shipped to clique peers (the wire cost "
+                "that replaces (n-1)x full mirrors)",
+            ).inc(rec["sent_bytes"])
+    elif kind == "ckpt_parity_reconstruct":
+        reg.counter(
+            "tpu_ckpt_parity_reconstructions_total",
+            "k-of-n shard reconstructions from erasure blocks, by outcome "
+            "(a 'failed' outcome degraded to peer retrieve, never a "
+            "false-positive container)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "ckpt_delta":
+        # One event per delta replication round on the sending rank.
+        reg.counter(
+            "tpu_ckpt_delta_saves_total",
+            "replication rounds shipped as chunk-diff delta frames",
+        ).inc()
+        for label, key in (("shipped", "frame_bytes"), ("full", "full_bytes")):
+            if isinstance(rec.get(key), (int, float)):
+                reg.counter(
+                    "tpu_ckpt_delta_bytes_total",
+                    "delta replication byte economy: frame bytes shipped vs "
+                    "the full container bytes a mirror round would have moved",
+                    kind=label,
+                ).inc(rec[key])
+        if isinstance(rec.get("chunks_changed"), (int, float)):
+            reg.counter(
+                "tpu_ckpt_delta_chunks_total",
+                "chunks shipped by delta rounds (the dirty set)",
+            ).inc(rec["chunks_changed"])
+    elif kind == "ckpt_delta_applied":
+        reg.counter(
+            "tpu_ckpt_delta_applied_total",
+            "received delta frames applied against held base containers, by "
+            "outcome ('broken' = chain mismatch, mirror dropped for the "
+            "round)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
     elif kind == "world_resized":
         reg.counter(
             "tpu_world_resized_total",
