@@ -1619,6 +1619,144 @@ def scenario_autoscale(seed: int, workdir: str):
     )
 
 
+def _alerts_campaign(seed: int):
+    """One synthetic run of the watchtower campaign: a fully seeded stream
+    (synthetic timestamps — the watchtower runs on stream time, so the whole
+    campaign is wall-clock-free) through a live-wired engine whose emitted
+    alert events are appended back into the stream, exactly as a real run's
+    telemetry tail sees its own ``alert_fired`` records. Returns
+    ``(records, sequence, hang_ts)``."""
+    import random
+
+    from tpu_resiliency.telemetry.watchtower import Watchtower, default_rules
+
+    rng = random.Random(seed)
+    recs: list = []
+    sequence: list = []
+    tower = Watchtower(
+        rules=default_rules(),
+        emit=lambda kind, payload: sequence.append({"kind": kind, **payload}),
+    )
+    t = [1_000_000.0 + (seed % 997)]
+
+    def emit(source, kind, **payload):
+        rec = {"ts": t[0], "source": source, "kind": kind, "pid": 0,
+               "rank": None, **payload}
+        recs.append(rec)
+        n = len(sequence)
+        tower.observe(rec)
+        # The engine's own transitions ride the stream too (a live run's
+        # events tail feeds them back); stamped at their boundary ts they
+        # never cross a boundary themselves — inert on replay, by design.
+        for tr in sequence[n:]:
+            recs.append({
+                "ts": tr.get("resolve_ts") or tr.get("fire_ts") or t[0],
+                "source": "watchtower", "pid": 0, "rank": None, **tr,
+            })
+
+    it = [0]
+
+    def steps(n, step_s):
+        for _ in range(n):
+            t[0] += step_s * (1.0 + 0.1 * rng.random())
+            it[0] += 1
+            emit("inprocess", "iteration_start", iteration=it[0], pid=1000)
+
+    # -- phase 0: healthy baseline (jittered so MAD is honest) --------------
+    steps(20, 0.1)
+    # -- phase 1: seeded straggler — the pre-hang early warning -------------
+    steps(8, 3.0)
+    fired_rules = [s["rule"] for s in sequence if s["kind"] == "alert_fired"]
+    assert "step_anomaly" in fired_rules, (
+        f"straggler ramp never fired step_anomaly: {sequence}"
+    )
+    # ... and only THEN does the monitor's verdict land: the whole point.
+    t[0] += 1.0
+    hang_ts = t[0]
+    emit("monitor", "hang_detected", rank=seed % 4, detail="seeded straggler")
+    steps(20, 0.1)  # replacement rank: step time recovers, alert resolves
+    # -- phase 2: injected restart burns the goodput SLO fast window --------
+    for _ in range(30):
+        t[0] += 2.0
+        emit("telemetry", "goodput_update", ratio=0.2)
+    for _ in range(40):  # recovery refills the fast window, burn resolves
+        t[0] += 2.0
+        emit("telemetry", "goodput_update", ratio=1.0)
+    steps(5, 0.1)  # trailing boundary crossings flush pending resolves
+    return recs, sequence, hang_ts
+
+
+def scenario_alerts(seed: int, workdir: str):
+    """The watchtower acceptance: the seeded straggler's ``step_anomaly``
+    alert fires STRICTLY BEFORE the monitor's hang verdict (the early-warning
+    lead), the injected restart burns the goodput SLO fast window and
+    resolves after recovery, two same-seed runs produce identical
+    (rule, fire_ts, resolve) sequences, and an offline replay of the saved
+    events JSONL reproduces the live sequence byte-identically. Leaves
+    ``events.jsonl`` / ``sequence.jsonl`` in ``workdir`` for the smoke leg's
+    ``tpu-alerts`` check."""
+    from tpu_resiliency.telemetry.watchtower import replay
+    from tpu_resiliency.utils.metrics import aggregate
+
+    os.makedirs(workdir, exist_ok=True)
+    recs, seq, hang_ts = _alerts_campaign(seed)
+    recs2, seq2, hang_ts2 = _alerts_campaign(seed)
+    assert (seq, hang_ts) == (seq2, hang_ts2), (
+        f"alert sequence not reproducible:\n{seq}\n{seq2}"
+    )
+
+    # The early-warning inequality: fired before the verdict, strictly.
+    anomaly_fire = next(
+        s for s in seq
+        if s["kind"] == "alert_fired" and s["rule"] == "step_anomaly"
+    )
+    assert anomaly_fire["fire_ts"] < hang_ts, (
+        f"step_anomaly fired at {anomaly_fire['fire_ts']}, NOT before the "
+        f"hang verdict at {hang_ts}"
+    )
+    anomaly_resolve = next(
+        s for s in seq
+        if s["kind"] == "alert_resolved" and s["rule"] == "step_anomaly"
+    )
+    assert anomaly_resolve["resolve_ts"] > hang_ts
+
+    # The SLO burn fires on the injected restart and resolves on recovery.
+    burn = [s for s in seq if s["rule"] == "goodput_burn"]
+    assert [s["kind"] for s in burn] == ["alert_fired", "alert_resolved"], burn
+
+    # Offline replay of the saved stream reproduces the live sequence
+    # byte-identically (the recorded alert events in the file are inert).
+    events_path = os.path.join(workdir, "events.jsonl")
+    with open(events_path, "w") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+    with open(events_path) as f:
+        loaded = [json.loads(line) for line in f if line.strip()]
+    _, replayed = replay(loaded)
+    live_bytes = [json.dumps(s, sort_keys=True) for s in seq]
+    replay_bytes = [json.dumps(s, sort_keys=True) for s in replayed]
+    assert live_bytes == replay_bytes, (
+        f"offline replay diverged from the live sequence:\n"
+        f"{live_bytes}\n{replay_bytes}"
+    )
+    with open(os.path.join(workdir, "sequence.jsonl"), "w") as f:
+        for line in live_bytes:
+            f.write(line + "\n")
+
+    # The metrics surface: alert events aggregate like any other stream.
+    prom = aggregate(recs).to_prometheus()
+    for want in (
+        "tpu_alerts_total", 'rule="step_anomaly"', 'rule="goodput_burn"',
+        'severity="page"', "tpu_alerts_active 0",
+    ):
+        assert want in prom, f"{want} missing:\n{prom[:2000]}"
+
+    ordinals = [
+        (s["kind"], s["rule"], i) for i, s in enumerate(seq)
+    ]
+    return ordinals, round(hang_ts - anomaly_fire["fire_ts"], 3)
+
+
 # -- driver ------------------------------------------------------------------
 
 
@@ -1715,6 +1853,14 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     out["autoscale_goodput"] = {"controlled": a_ratios[0],
                                 "baseline": a_ratios[1]}
     out["autoscale_workdir"] = autoscale_dir
+    # Watchtower campaign: scenario_alerts internally runs the synthetic
+    # stream twice (identical fire/resolve sequences) and byte-compares the
+    # offline replay of its saved events JSONL against the live sequence.
+    alerts_dir = os.path.join(workdir, f"alerts_{seed}")
+    al_seq, al_lead = scenario_alerts(seed, alerts_dir)
+    out["alerts_sequence"] = [list(s) for s in al_seq]
+    out["alerts_early_warning_lead_s"] = al_lead
+    out["alerts_workdir"] = alerts_dir
     if with_launcher:
         counts = scenario_launcher(seed, os.path.join(workdir, f"launcher_{seed}"))
         out["launcher_injections"] = {f"{c}.{k}": n for (c, k), n in counts.items()}
@@ -1754,6 +1900,7 @@ def main(argv=None) -> int:
                   f"repl={len(res['replication_injections'])} "
                   f"mixed={len(res['mixed_injections'])} "
                   f"autoscale={res.get('autoscale_goodput')} "
+                  f"alerts_lead={res.get('alerts_early_warning_lead_s')}s "
                   f"launcher={res.get('launcher_injections')} "
                   f"({res['elapsed_s']}s)")
         base = int.from_bytes(os.urandom(4), "big")

@@ -417,6 +417,109 @@ grep -q '"kind": *"autoscale_decision"' "$AD/events.jsonl" \
 grep -q '"kind": *"autoscale_outcome"' "$AD/events.jsonl" \
     || { echo "FAIL: advise run never settled a realized outcome"; exit 1; }
 
+echo "== smoke: watchtower (seeded straggler -> /alerts fires then resolves; offline replay reproduces the live record)"
+WT="$WORKDIR/watchtower"
+mkdir -p "$WT"
+cat > "$WT/worker.py" <<'PY'
+import os, sys, time
+from tpu_resiliency.utils.events import record
+
+stop = sys.argv[1]
+rank = int(os.environ.get("RANK", "0"))
+i = 0
+deadline = time.time() + 120
+while not os.path.exists(stop) and time.time() < deadline:
+    if rank == 0:
+        record("inprocess", "iteration_start", iteration=i)
+    i += 1
+    # Seeded straggler: steps 30..37 run ~25x slower, then recover — the
+    # step_anomaly early warning must fire on /alerts, then resolve.
+    time.sleep(1.2 if 30 <= i < 38 else 0.05)
+PY
+python -m tpu_resiliency.launcher.launch \
+    --standalone --nproc-per-node 2 --max-restarts 1 --no-ft-monitors \
+    --rdzv-last-call 0.2 --monitor-interval 0.1 --telemetry-port 0 \
+    --alerts on \
+    --events-file "$WT/events.jsonl" --run-dir "$WT/run" \
+    "$WT/worker.py" "$WT/stop" &
+WT_PID=$!
+python - "$WT" <<'PY'
+import json, os, sys, time, urllib.request
+
+wt = sys.argv[1]
+port_file = os.path.join(wt, "run", "telemetry.port")
+deadline = time.time() + 90
+while not os.path.exists(port_file):
+    assert time.time() < deadline, "telemetry.port never appeared"
+    time.sleep(0.2)
+port = int(open(port_file).read().strip())
+doc = None
+seen = set()
+while time.time() < deadline:
+    try:
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts", timeout=5).read())
+    except OSError:
+        time.sleep(0.3)
+        continue
+    seen = {(h.get("kind"), h.get("rule")) for h in doc.get("history", [])}
+    if {("alert_fired", "step_anomaly"),
+        ("alert_resolved", "step_anomaly")} <= seen:
+        break
+    time.sleep(0.3)
+assert doc is not None and doc["schema"] == "tpu-alerts-1", doc
+assert ("alert_fired", "step_anomaly") in seen, (
+    f"straggler never fired step_anomaly: {doc}")
+assert ("alert_resolved", "step_anomaly") in seen, (
+    f"step_anomaly never resolved after recovery: {doc}")
+snap = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/snapshot", timeout=5).read())
+assert snap.get("alerts", {}).get("schema") == "tpu-alerts-1", (
+    "alerts section missing from /snapshot")
+with open(os.path.join(wt, "alerts_live.json"), "w") as f:
+    json.dump(doc, f)
+fired = next(h for h in doc["history"]
+             if (h["kind"], h["rule"]) == ("alert_fired", "step_anomaly"))
+print(f"watchtower live OK: step_anomaly fired at {fired['fire_ts']:.3f} "
+      f"and resolved; {len(doc['history'])} transition(s) recorded")
+PY
+touch "$WT/stop"
+wait "$WT_PID"
+# The live run's alert record must fall out of a cold offline replay of its
+# events JSONL — same engine, stream clock, so the live history is a
+# byte-exact prefix of the replayed sequence (the doc froze mid-run).
+python - "$WT" <<'PY'
+import json, os, sys
+
+from tpu_resiliency.telemetry.watchtower import replay
+
+wt = sys.argv[1]
+doc = json.load(open(os.path.join(wt, "alerts_live.json")))
+recs = []
+for line in open(os.path.join(wt, "events.jsonl")):
+    line = line.strip()
+    if line:
+        try:
+            recs.append(json.loads(line))
+        except ValueError:
+            pass
+_, seq = replay(recs)
+hist = doc["history"]
+enc = lambda rows: [json.dumps(r, sort_keys=True) for r in rows]
+assert enc(seq[:len(hist)]) == enc(hist), (
+    f"offline replay diverged from the live /alerts history:\n"
+    f"{enc(seq[:len(hist)])}\n{enc(hist)}")
+print(f"watchtower replay OK: live history ({len(hist)} transition(s)) is a "
+      f"byte-exact prefix of the {len(seq)}-transition offline replay")
+PY
+python -m tpu_resiliency.tools.alerts_cli "$WT/events.jsonl" | sed 's/^/    /'
+python -m tpu_resiliency.tools.alerts_cli --rules | sed 's/^/    /'
+# The chaos campaign's saved stream replays byte-identically through the CLI.
+AL_DIR="$WORKDIR/chaos/alerts_1234"
+python -m tpu_resiliency.tools.alerts_cli "$AL_DIR/events.jsonl" --json \
+    | diff - "$AL_DIR/sequence.jsonl" \
+    || { echo "FAIL: tpu-alerts replay diverged from the campaign sequence"; exit 1; }
+
 echo "== smoke: fleet federation (2 concurrent jobs -> fleetd scoreboard; SIGKILL one, fleet endpoints stay up)"
 FL="$WORKDIR/fleet"
 mkdir -p "$FL"
@@ -492,9 +595,18 @@ while time.time() < deadline:
 assert rows.get("job-alpha") == "unreachable", rows
 assert rows.get("job-beta") == "ok", rows
 for ep in ("/fleet/metrics", "/fleet/goodput", "/fleet/slo",
-           "/fleet/incidents", "/fleet/hangz", "/fleet/snapshot"):
+           "/fleet/incidents", "/fleet/hangz", "/fleet/alerts",
+           "/fleet/snapshot"):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}{ep}", timeout=10) as r:
         assert r.status == 200, (ep, r.status)
+# The cross-job alert feed degrades the dead job to a row, never a non-200.
+al = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/fleet/alerts", timeout=10).read())
+assert al["schema"] == "tpu-fleet-alerts-1", al
+al_rows = {r["job"]: r["status"] for r in al.get("jobs", [])}
+assert al_rows.get("job-alpha") == "unreachable", al_rows
+assert al_rows.get("job-beta") == "ok", al_rows
+assert "job-alpha" in (al.get("unreachable") or []), al
 prom = urllib.request.urlopen(
     f"http://127.0.0.1:{port}/fleet/metrics", timeout=10).read().decode()
 assert 'job="job-beta"' in prom, prom[:2000]

@@ -256,3 +256,78 @@ def test_bucket_quantile_edges():
     # first bucket interpolates from 0 (or the bound itself when negative)
     assert 0.0 < bucket_quantile((1.0, 2.0), [2, 0, 0], 0.5) <= 1.0
     assert bucket_quantile((-1.0, 1.0), [2, 0, 0], 0.99) <= -0.0
+
+
+# -- /fleet/alerts fold -------------------------------------------------------
+
+
+def _low_ratio_rule(name, severity):
+    from tpu_resiliency.telemetry.watchtower import AlertRule
+
+    return AlertRule(
+        name=name,
+        check=lambda store, now, p: (
+            "ratio low"
+            if any(v < 0.5 for _, v in store.query("tpu_goodput_ratio"))
+            else None
+        ),
+        severity=severity,
+    )
+
+
+def test_fleet_alerts_feed_ranks_and_degrades(tmp_path):
+    """The cross-job alert feed: pages lead, firing jobs are counted, and an
+    unreachable job degrades to its row instead of vanishing."""
+    from tpu_resiliency.telemetry.watchtower import Watchtower
+
+    a = start_job(tmp_path, "job-a")
+    b = start_job(tmp_path, "job-b")
+    a.watchtower = Watchtower(
+        [_low_ratio_rule("hot", "page"), _low_ratio_rule("warm", "warn")],
+        job="job-a",
+    )
+    b.watchtower = Watchtower([_low_ratio_rule("hot", "page")], job="job-b")
+    for job, ratio in (("job-a", 0.2), ("job-b", 1.0)):
+        with open(tmp_path / f"{job}.jsonl", "w") as f:
+            for ts in (100.0, 120.0):
+                f.write(json.dumps({
+                    "kind": "goodput_update", "ts": ts, "ratio": ratio,
+                    "pid": 1,
+                }) + "\n")
+    agg = FleetAggregator(str(tmp_path / "fleet"), timeout=1.0)
+    try:
+        doc = agg.scrape().alerts_doc()
+        assert doc["schema"] == "tpu-fleet-alerts-1"
+        # Severity-ranked: the page leads the warn even within one job.
+        assert [(r["job"], r["rule"], r["severity"]) for r in doc["active"]] \
+            == [("job-a", "hot", "page"), ("job-a", "warm", "warn")]
+        assert doc["firing_jobs"] == {"job-a": 2}
+        rows = {r["job"]: r for r in doc["jobs"]}
+        assert rows["job-a"]["active"] == 2 and rows["job-a"]["rules"] == 2
+        assert rows["job-b"]["active"] == 0 and rows["job-b"]["rules"] == 1
+        assert doc["unreachable"] == []
+        # SIGKILL semantics: endpoint gone, lease behind — the job keeps its
+        # row (status unreachable) and lands in the unreachable census.
+        a._lease_stop.set()
+        a._lease_thread.join(timeout=5)
+        a._httpd.shutdown()
+        a._httpd.server_close()
+        agg.close()
+        doc = agg.scrape().alerts_doc()
+        rows = {r["job"]: r for r in doc["jobs"]}
+        assert rows["job-a"]["status"] == "unreachable"
+        assert rows["job-a"]["error"]
+        assert "active" not in rows["job-a"]  # no doc, no counts to fake
+        assert doc["unreachable"] == ["job-a"]
+        assert [(r["job"], r["rule"]) for r in doc["active"]] == []
+        assert doc["firing_jobs"] == {}
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_fleet_alerts_feed_empty_fleet(tmp_path):
+    doc = FleetAggregator(str(tmp_path / "fleet")).scrape().alerts_doc()
+    assert doc["schema"] == "tpu-fleet-alerts-1"
+    assert doc["active"] == [] and doc["jobs"] == []
+    assert doc["firing_jobs"] == {} and doc["unreachable"] == []

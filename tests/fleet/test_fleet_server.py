@@ -19,7 +19,7 @@ from tpu_resiliency.utils import events
 
 FLEET_ENDPOINTS = (
     "/fleet/metrics", "/fleet/goodput", "/fleet/slo", "/fleet/incidents",
-    "/fleet/hangz", "/fleet/snapshot",
+    "/fleet/hangz", "/fleet/alerts", "/fleet/snapshot",
 )
 
 
@@ -104,6 +104,9 @@ def test_all_endpoints_answer_and_carry_both_jobs(fleet):
     assert inc["schema"] == "tpu-fleet-incidents-1"
     hz = json.loads(_get(srv.port, "/fleet/hangz")[1])
     assert hz["schema"] == "tpu-fleet-hangz-1" and len(hz["jobs"]) == 2
+    al = json.loads(_get(srv.port, "/fleet/alerts")[1])
+    assert al["schema"] == "tpu-fleet-alerts-1" and len(al["jobs"]) == 2
+    assert al["active"] == [] and al["unreachable"] == []
     snap = json.loads(_get(srv.port, "/fleet/snapshot")[1])
     assert snap["schema"] == "tpu-fleet-snapshot-1"
     hzdoc = json.loads(_get(srv.port, "/healthz")[1])
@@ -155,6 +158,7 @@ def test_unknown_path_is_404_with_directory(fleet):
     assert ei.value.code == 404
     doc = json.loads(ei.value.read())
     assert "/fleet/goodput" in doc["endpoints"]
+    assert "/fleet/alerts" in doc["endpoints"]
 
 
 def test_snapshot_roundtrips_through_the_cli(fleet, tmp_path, capsys):

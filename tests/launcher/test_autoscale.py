@@ -435,3 +435,67 @@ class TestSignals:
         time.sleep(0.15)
         ctl.stop()
         assert ctl._thread is None
+
+
+# -- the SLO watchtower's early warning --------------------------------------
+
+
+PAGE = {"rule": "step_anomaly", "severity": "page", "fire_ts": 99.0}
+
+
+class TestAlertBias:
+    def test_checkpoint_prices_page_alert_risk(self):
+        m = CostModel(horizon_s=10.0, ckpt_s=0.2, p_alert_risk=0.35)
+        v = view(step_s=0.1, steps_since_ckpt=20)
+        # No notice, no alert: a proactive save is pure cost...
+        assert m.estimate(ACTION_CHECKPOINT, v) == pytest.approx(-0.2)
+        # ...a page-grade alert puts the unbanked progress at alert risk...
+        v_alert = view(step_s=0.1, steps_since_ckpt=20)
+        v_alert.active_alerts = [PAGE]
+        assert m.estimate(ACTION_CHECKPOINT, v_alert) == pytest.approx(
+            0.35 * 2.0 - 0.2
+        )
+        # ...and a real notice still outranks it (p_preempt, not p_alert_risk).
+        n = Notice(key="r1", rank=1, noticed_at=99.0)
+        v_both = view(notices=[n], step_s=0.1, steps_since_ckpt=20)
+        v_both.active_alerts = [PAGE]
+        assert m.estimate(ACTION_CHECKPOINT, v_both) == pytest.approx(
+            m.p_preempt * 2.0 - 0.2
+        )
+        # Warn-grade alerts do not move the model.
+        v_warn = view(step_s=0.1, steps_since_ckpt=20)
+        v_warn.active_alerts = [{"rule": "r", "severity": "warn"}]
+        assert m.estimate(ACTION_CHECKPOINT, v_warn) == pytest.approx(-0.2)
+
+    def test_page_alert_decides_checkpoint_before_any_verdict(self, seen):
+        """The acceptance story: a page-severity early warning (no straggler
+        verdict, no notice) banks progress via an advised checkpoint."""
+        firing = []
+        ctl = controller(active_alerts_fn=lambda: firing)
+        for i in range(30):  # 29 unbanked 0.1s steps
+            ctl.observe({"kind": "iteration_start", "iteration": i,
+                         "ts": 60.0 + 0.1 * i, "pid": 1})
+        assert ctl.tick() is None  # healthy and silent without the alert
+        firing.append(dict(PAGE))
+        d = ctl.tick()
+        assert d is not None and d.action == ACTION_CHECKPOINT
+        assert "step_anomaly" in d.reason and d.predicted_delta_s > 0
+        doc = ctl.status()
+        assert doc["active_alerts"] == [
+            {"rule": "step_anomaly", "severity": "page"}
+        ]
+        evs = [e for e in seen if e.kind == "autoscale_decision"]
+        assert [e.payload["action"] for e in evs] == [ACTION_CHECKPOINT]
+
+    def test_crashing_alerts_fn_never_hurts(self):
+        def boom():
+            raise RuntimeError("watchtower gone")
+
+        ctl = controller(active_alerts_fn=boom)
+        assert ctl.view().active_alerts == []
+        assert ctl.tick() is None
+        assert ctl.status()["active_alerts"] == []
+
+    def test_view_without_alerts_fn_defaults_empty(self):
+        assert controller().view().active_alerts == []
+        assert view().page_alerts() == []

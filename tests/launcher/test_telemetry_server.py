@@ -142,7 +142,7 @@ def test_unknown_path_is_404_with_directory(server):
     doc = json.loads(ei.value.read())
     assert set(doc["endpoints"]) == {
         "/metrics", "/metrics.json", "/goodput", "/healthz", "/hangz",
-        "/autoscale", "/incidents", "/snapshot", "/storez",
+        "/autoscale", "/incidents", "/snapshot", "/storez", "/alerts",
     }
 
 
@@ -398,3 +398,119 @@ def test_refresh_feeds_byteflow_ledger(server):
     _, body, _ = _get(srv.port, "/metrics")
     assert 'tpu_byteflow_bytes_total{direction="send",purpose="replicate"} 2048' in body
     assert "tpu_byteflow_accounted_ratio 1" in body
+
+
+# -- /alerts: the SLO watchtower's endpoint ----------------------------------
+
+def _make_watchtower(**kw):
+    from tpu_resiliency.telemetry.watchtower import AlertRule, Watchtower
+
+    hot = AlertRule(
+        name="hot",
+        check=lambda store, now, p: (
+            "ratio low"
+            if any(v < 0.5 for _, v in store.query("tpu_goodput_ratio"))
+            else None
+        ),
+        severity="page",
+    )
+    return Watchtower([hot], **kw)
+
+
+def test_alerts_endpoint_serves_and_degrades(server):
+    srv, tmp_path = server
+    # Without a watchtower: a degraded-but-valid document, never an error.
+    status, body, ctype = _get(srv.port, "/alerts")
+    doc = json.loads(body)
+    assert status == 200 and "json" in ctype
+    assert doc["schema"] == "tpu-alerts-1" and doc["job"] == srv.job
+    assert "no watchtower wired" in doc["error"]
+    # With one wired: the events tail feeds it and the rule fires.
+    srv.watchtower = _make_watchtower()
+    with open(tmp_path / "ev.jsonl", "w") as f:
+        f.write(json.dumps({
+            "kind": "goodput_update", "ts": 100.0, "ratio": 0.2, "pid": 9,
+        }) + "\n")
+        f.write(json.dumps({
+            "kind": "goodput_update", "ts": 120.0, "ratio": 0.2, "pid": 9,
+        }) + "\n")
+    doc = json.loads(_get(srv.port, "/alerts")[1])
+    assert doc["schema"] == "tpu-alerts-1"
+    assert [r["name"] for r in doc["rules"]] == ["hot"]
+    assert doc["rules"][0]["state"] == "firing"
+    assert [a["rule"] for a in doc["active"]] == ["hot"]
+    # A crashing engine degrades the document, never the endpoint.
+    class Wedged:
+        def observe(self, rec):
+            pass
+
+        def status(self):
+            raise RuntimeError("engine wedged")
+
+        def stop(self):
+            pass
+
+    srv.watchtower = Wedged()
+    status, body, _ = _get(srv.port, "/alerts")
+    assert status == 200
+    assert "engine wedged" in json.loads(body)["error"]
+
+
+def test_alerts_crashing_rule_degrades_to_error_row(server):
+    from tpu_resiliency.telemetry.watchtower import AlertRule, Watchtower
+
+    srv, tmp_path = server
+    srv.watchtower = Watchtower([AlertRule(
+        name="buggy",
+        check=lambda store, now, p: (_ for _ in ()).throw(ValueError("nan")),
+    )])
+    with open(tmp_path / "ev.jsonl", "w") as f:
+        for ts in (10.0, 20.0):
+            f.write(json.dumps({
+                "kind": "goodput_update", "ts": ts, "ratio": 1.0, "pid": 9,
+            }) + "\n")
+    status, body, _ = _get(srv.port, "/alerts")
+    assert status == 200  # a rule bug is a row-level fact, not an outage
+    doc = json.loads(body)
+    row = doc["rules"][0]
+    assert row["name"] == "buggy" and "nan" in row["error"]
+    assert doc["active"] == []
+
+
+def test_snapshot_storm_costs_one_watchtower_evaluation(server):
+    """REGRESSION (watchtower PR): the fleet-scrape hot path must not
+    multiply watchtower evaluations — N concurrent /snapshot scrapes inside
+    one TTL serve the alerts section from ONE status() call (the snapshot
+    body is computed inside the lock, then cached)."""
+    import threading
+
+    srv, _ = server
+    srv.snapshot_ttl = 30.0
+    tower = _make_watchtower()
+    calls = []
+    real_status = tower.status
+
+    def counting_status():
+        calls.append(1)
+        time.sleep(0.2)  # widen the race window: overlap would double-count
+        return real_status()
+
+    tower.status = counting_status
+    srv.watchtower = tower
+    results = []
+    threads = [
+        threading.Thread(
+            target=lambda: results.append(_get(srv.port, "/snapshot"))
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(results) == 4
+    bodies = {body for _, body, _ in results}
+    assert len(bodies) == 1, "scrapes inside one TTL must share one document"
+    assert len(calls) == 1, "scrape storm stacked watchtower evaluations"
+    doc = json.loads(bodies.pop())
+    assert doc["alerts"]["schema"] == "tpu-alerts-1"

@@ -1,0 +1,296 @@
+"""telemetry/watchtower.py: rule engine, stream clock, replay determinism."""
+
+import json
+
+import pytest
+
+from tpu_resiliency.utils import events
+from tpu_resiliency.utils.events import Event
+from tpu_resiliency.utils.metrics import MetricsRegistry, observe_record
+from tpu_resiliency.telemetry.watchtower import (
+    ALERT_RULES_ENV,
+    AlertRule,
+    Watchtower,
+    WatchtowerSink,
+    default_rules,
+    load_rule_overrides,
+    replay,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_sinks():
+    events.clear_sinks()
+    yield
+    events.clear_sinks()
+
+
+def _hot_rule(threshold=10.0, **kw):
+    """Fires while any retained tpu_goodput_ratio sample >= threshold."""
+    def check(store, now, p):
+        if any(v >= p["threshold"] for _, v in store.query("tpu_goodput_ratio")):
+            return f"hot (>= {p['threshold']:g})"
+        return None
+    return AlertRule(
+        name=kw.pop("name", "hot"), check=check,
+        params={"threshold": threshold}, **kw,
+    )
+
+
+def _gp(ts, ratio):
+    return {"ts": ts, "kind": "goodput_update", "ratio": ratio}
+
+
+def _steps(t0, n, step_s, pid=1, start_it=0):
+    recs, t = [], t0
+    for i in range(n):
+        t += step_s
+        recs.append({"ts": t, "kind": "iteration_start",
+                     "iteration": start_it + i, "pid": pid})
+    return recs
+
+
+class TestStreamClock:
+    def test_boundary_evaluated_before_ingesting_crossing_record(self):
+        # The record that crosses a boundary must NOT be visible to that
+        # boundary's evaluation — ring contents at each boundary are a pure
+        # function of record order (the replay-parity invariant).
+        tower = Watchtower([_hot_rule()], eval_interval=5.0, emit=lambda *a: None)
+        tower.observe(_gp(0.0, 0.0))       # clock starts: next eval at 5.0
+        trs = tower.observe(_gp(5.0, 99.0))  # crosses; evaluated BEFORE ingest
+        assert trs == []
+        trs = tower.observe(_gp(10.0, 0.0))  # now the 99 sample is visible
+        assert [t["kind"] for t in trs] == ["alert_fired"]
+        assert trs[0]["fire_ts"] == 10.0
+
+    def test_hold_down_for_s(self):
+        tower = Watchtower(
+            [_hot_rule(for_s=10.0)], eval_interval=5.0, emit=lambda *a: None
+        )
+        out = tower.observe_many(
+            [_gp(0.0, 99.0), _gp(5.0, 99.0), _gp(10.0, 99.0), _gp(15.0, 99.0),
+             _gp(20.0, 99.0)]
+        )
+        fires = [t for t in out if t["kind"] == "alert_fired"]
+        # pending since the 5.0 boundary; 10s hold-down met at the 15.0 one.
+        assert len(fires) == 1 and fires[0]["fire_ts"] == 15.0
+
+    def test_resolve_carries_duration(self):
+        tower = Watchtower([_hot_rule()], eval_interval=5.0, emit=lambda *a: None)
+        tower.observe_many([_gp(0.0, 99.0), _gp(5.0, 99.0)])
+        # cool samples push the hot one out of the 4-slot ring
+        cool = [_gp(10.0 + i, 0.0) for i in range(600)]
+        out = tower.observe_many(cool)
+        resolved = [t for t in out if t["kind"] == "alert_resolved"]
+        assert len(resolved) == 1
+        r = resolved[0]
+        assert r["resolve_ts"] > r["fire_ts"]
+        assert r["duration_s"] == pytest.approx(r["resolve_ts"] - r["fire_ts"])
+
+    def test_pathological_gap_snaps_clock(self):
+        tower = Watchtower([_hot_rule()], eval_interval=5.0, emit=lambda *a: None)
+        tower.observe(_gp(0.0, 0.0))
+        tower.observe(_gp(1e6, 0.0))  # ~200k boundaries: snap, don't loop
+        st = tower.status()
+        assert st["clock"]["evals"] == 256
+        assert st["clock"]["next_eval"] == 1e6 + 5.0
+
+    def test_non_dict_and_tsless_records_ignored(self):
+        tower = Watchtower([_hot_rule()], emit=lambda *a: None)
+        assert tower.observe("nope") == []
+        assert tower.observe({"kind": "iteration_start"}) == []
+        assert tower.status()["clock"]["hwm"] is None
+
+
+class TestRules:
+    def test_crashing_rule_degrades_to_error_row(self):
+        def boom(store, now, p):
+            raise RuntimeError("rule bug")
+
+        tower = Watchtower(
+            [AlertRule(name="boom", check=boom), _hot_rule()],
+            eval_interval=5.0, emit=lambda *a: None,
+        )
+        out = tower.observe_many([_gp(0.0, 99.0), _gp(5.0, 99.0), _gp(10.0, 99.0)])
+        # the healthy rule still fires; the crasher reports, never raises
+        assert any(t["rule"] == "hot" for t in out)
+        rows = {r["name"]: r for r in tower.status()["rules"]}
+        assert "rule bug" in rows["boom"]["error"]
+        assert rows["hot"]["error"] is None
+
+    def test_active_alerts_severity_ranked(self):
+        tower = Watchtower(
+            [_hot_rule(name="w", severity="warn"),
+             _hot_rule(name="p", severity="page"),
+             _hot_rule(name="i", severity="info")],
+            eval_interval=5.0, emit=lambda *a: None,
+        )
+        tower.observe_many([_gp(0.0, 99.0), _gp(5.0, 99.0)])
+        assert [a["rule"] for a in tower.active_alerts()] == ["p", "w", "i"]
+
+    def test_builtin_step_anomaly_fires_on_straggler(self):
+        rules = [r for r in default_rules() if r.name == "step_anomaly"]
+        recs = _steps(0.0, 12, 0.1) + _steps(1.2, 6, 3.0, start_it=12)
+        _, seq = replay(recs, rules=rules)
+        assert [s["rule"] for s in seq if s["kind"] == "alert_fired"] \
+            == ["step_anomaly"]
+
+    def test_builtin_goodput_burn_fast_and_slow(self):
+        rules = [r for r in default_rules() if r.name == "goodput_burn"]
+        recs = [_gp(2.0 * i, 0.2) for i in range(40)]
+        _, seq = replay(recs, rules=rules)
+        assert any(s["rule"] == "goodput_burn" and s["kind"] == "alert_fired"
+                   for s in seq)
+        # a blip burns the fast window only: no page
+        recs = [_gp(2.0 * i, 1.0) for i in range(300)] + \
+            [_gp(600.0 + 2.0 * i, 0.2) for i in range(3)] + \
+            [_gp(606.0 + 2.0 * i, 1.0) for i in range(30)]
+        _, seq = replay(recs, rules=rules)
+        assert seq == []
+
+
+class TestReplayParity:
+    def _campaign(self):
+        recs = _steps(0.0, 12, 0.1) + _steps(1.2, 4, 3.0, start_it=12)
+        recs += [_gp(20.0 + 2 * i, 0.2) for i in range(40)]
+        recs += [_gp(100.0 + 2 * i, 1.0) for i in range(40)]
+        return recs
+
+    def test_same_stream_same_sequence(self):
+        r1 = replay(self._campaign(), rules=default_rules())[1]
+        r2 = replay(self._campaign(), rules=default_rules())[1]
+        assert r1 and [json.dumps(t, sort_keys=True) for t in r1] \
+            == [json.dumps(t, sort_keys=True) for t in r2]
+
+    def test_recorded_alert_events_are_inert_on_replay(self):
+        recs = self._campaign()
+        _, seq = replay(recs, rules=default_rules())
+        # splice the emitted transitions back into the stream, as a live
+        # run's events tail would see its own alert records
+        enriched = sorted(
+            recs + [
+                {"ts": t.get("resolve_ts") or t["fire_ts"],
+                 "source": "watchtower", **t}
+                for t in seq
+            ],
+            key=lambda r: r["ts"],
+        )
+        _, seq2 = replay(enriched, rules=default_rules())
+        assert [json.dumps(t, sort_keys=True) for t in seq] \
+            == [json.dumps(t, sort_keys=True) for t in seq2]
+
+
+class TestTaps:
+    def test_step_histogram_tap(self):
+        tower = Watchtower([], emit=lambda *a: None)
+        tower.observe_many(_steps(0.0, 3, 0.5))
+        s = tower.store.query("tpu_step_seconds")
+        assert len(s) == 2  # consecutive deltas only
+        assert all(v == pytest.approx(0.5) for _, v in s)
+
+    def test_gauges_sample_from_record_not_wall_clock(self):
+        tower = Watchtower([], emit=lambda *a: None)
+        tower.observe(_gp(123.0, 0.75))
+        tower.observe({"ts": 124.0, "kind": "byteflow_update",
+                       "accounted_ratio": 0.93, "flows": {}})
+        assert tower.store.query("tpu_goodput_ratio") == [(123.0, 0.75)]
+        assert tower.store.query("tpu_byteflow_accounted_ratio") \
+            == [(124.0, 0.93)]
+
+    def test_ckpt_counter_tap(self):
+        tower = Watchtower([], emit=lambda *a: None)
+        tower.observe({"ts": 10.0, "kind": "ckpt_saved", "iteration": 1,
+                       "nbytes": 100, "duration_s": 0.1})
+        tower.observe({"ts": 20.0, "kind": "ckpt_saved", "iteration": 2,
+                       "nbytes": 100, "duration_s": 0.1})
+        assert tower.store.query("tpu_ckpt_saves") == [(10.0, 1.0), (20.0, 2.0)]
+
+    def test_store_stats_mean_latency_tap(self):
+        tower = Watchtower([], emit=lambda *a: None)
+        tower.observe({"ts": 5.0, "kind": "store_stats",
+                       "ops": {"get": 10}, "op_seconds": {"get": 0.1}})
+        tower.observe({"ts": 10.0, "kind": "store_stats",
+                       "ops": {"get": 10}, "op_seconds": {"get": 1.0}})
+        s = tower.store.query("tpu_store_mean_latency")
+        assert [t for t, _ in s] == [5.0, 10.0]
+        assert s[0][1] == pytest.approx(0.01)
+        assert s[1][1] == pytest.approx(0.1)
+
+
+class TestConfig:
+    def test_env_overrides(self, tmp_path, monkeypatch):
+        cfg = tmp_path / "rules.json"
+        cfg.write_text(json.dumps({
+            "goodput_burn": {"severity": "warn", "for_s": 7.5, "slo": 0.5,
+                             "not_a_param": 1},
+            "step_anomaly": {"disabled": True},
+            "unknown_rule": {"severity": "page"},
+        }))
+        monkeypatch.setenv(ALERT_RULES_ENV, str(cfg))
+        overrides, err = load_rule_overrides()
+        assert err is None
+        rules = {r.name: r for r in default_rules(overrides)}
+        assert "step_anomaly" not in rules
+        gb = rules["goodput_burn"]
+        assert (gb.severity, gb.for_s) == ("warn", 7.5)
+        assert gb.params["slo"] == 0.5
+        assert "not_a_param" not in gb.params
+
+    def test_bad_override_file_surfaces_config_error(self, tmp_path, monkeypatch):
+        cfg = tmp_path / "rules.json"
+        cfg.write_text("{not json")
+        monkeypatch.setenv(ALERT_RULES_ENV, str(cfg))
+        tower = Watchtower(emit=lambda *a: None)
+        assert tower.config_error and str(cfg) in tower.config_error
+        # built-ins still loaded — bad config must not disable alerting
+        assert {r.name for r in tower.rules} >= {"goodput_burn", "step_anomaly"}
+        assert "config_error" in tower.status()
+
+    def test_no_env_no_error(self, monkeypatch):
+        monkeypatch.delenv(ALERT_RULES_ENV, raising=False)
+        assert load_rule_overrides() == ({}, None)
+
+
+class TestBridge:
+    def test_emitted_events_drive_alert_metrics(self):
+        # The engine's default emit rides the standard events bridge:
+        # alert_fired/alert_resolved records map to tpu_alerts_total and
+        # the tpu_alerts_active gauge via observe_record.
+        tower = Watchtower([_hot_rule(severity="page")], eval_interval=5.0)
+        recorded = []
+        events.add_sink(
+            lambda e: recorded.append(e) if e.source == "watchtower" else None
+        )
+        tower.observe_many([_gp(0.0, 99.0), _gp(5.0, 99.0)])
+        assert [e.kind for e in recorded] == ["alert_fired"]
+        reg = MetricsRegistry()
+        for e in recorded:
+            observe_record(e.to_record(), reg)
+        prom = reg.to_prometheus()
+        assert 'tpu_alerts_total{rule="hot",severity="page"} 1' in prom
+        assert "tpu_alerts_active 1" in prom
+
+    def test_sink_flattening_matches_jsonl_replay(self):
+        # WatchtowerSink(Event) and a flat-record feed must produce the same
+        # ring contents — the live/post-hoc parity contract.
+        via_sink = Watchtower([], emit=lambda *a: None)
+        sink = WatchtowerSink(via_sink)
+        via_flat = Watchtower([], emit=lambda *a: None)
+        for i, t in enumerate((1.0, 2.0, 3.0)):
+            e = Event(ts=t, source="inprocess", kind="iteration_start",
+                      pid=7, payload={"iteration": i})
+            sink(e)
+            via_flat.observe(e.to_record())
+        assert via_sink.store.query("tpu_step_seconds") \
+            == via_flat.store.query("tpu_step_seconds")
+
+
+def test_start_pumps_poll_fn_and_stop_joins():
+    tower = Watchtower([], emit=lambda *a: None)
+    import threading
+
+    pumped = threading.Event()
+    tower.start(poll_fn=pumped.set, interval=0.01)
+    assert pumped.wait(timeout=5.0)
+    tower.stop()
+    assert tower._thread is None

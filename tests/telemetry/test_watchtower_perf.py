@@ -1,0 +1,82 @@
+"""Slow-marked watchtower perf gate, riding the PR-13 store-storm bench:
+wiring the alert engine into the live events stream (a ``WatchtowerSink``
+processing the store's own ``store_stats`` emissions plus evaluating the full
+builtin rule set on its boundaries) must add <5% to the client-observed op
+p50 — the regression anchor for the ``--alerts on`` default. Same discipline
+as the PR-13 telemetry gate: interleaved median-of-9 trials, one noise-guard
+retry."""
+
+import os
+import statistics
+import sys
+
+import pytest
+
+from tpu_resiliency.telemetry.watchtower import Watchtower, WatchtowerSink
+from tpu_resiliency.utils import events
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench_store  # noqa: E402
+
+pytestmark = pytest.mark.slow
+
+
+def _bench_overhead(trials=9, ops_per_client=4000):
+    """Client-observed p50 with the watchtower wired into the events stream
+    vs not: interleaved on/off trials (fresh server each, background-load
+    spikes hit both arms), compared by MEDIAN. The server emits store_stats
+    on a tight cadence so the ON arm's sink genuinely taps and evaluates on
+    the storm's emitting thread — the only path the engine could tax."""
+    p50 = {True: [], False: []}
+    engaged = 0
+    for _ in range(trials):
+        for on in (True, False):
+            srv = bench_store.KVServer(
+                host="127.0.0.1", port=0,
+                stats_enabled=True, stats_interval=0.05,
+            )
+            sink = None
+            if on:
+                tower = Watchtower(
+                    eval_interval=0.05, emit=lambda *a: None
+                )
+                sink = WatchtowerSink(tower)
+                events.add_sink(sink)
+            try:
+                p50[on].append(
+                    bench_store.run_storm(srv.port, 1, ops_per_client)["p50_us"]
+                )
+            finally:
+                if sink is not None:
+                    events.remove_sink(sink)
+                    if (tower.store.query("tpu_store_mean_latency")
+                            and tower.status()["clock"]["evals"] > 0):
+                        engaged += 1
+                srv.close()
+    on_p50 = statistics.median(p50[True])
+    off_p50 = statistics.median(p50[False])
+    return {
+        "stats_on_p50_us": round(on_p50, 2),
+        "stats_off_p50_us": round(off_p50, 2),
+        "overhead_frac": on_p50 / off_p50 - 1.0 if off_p50 else None,
+        "engaged_trials": engaged,
+        "trials": trials,
+    }
+
+
+def test_watchtower_overhead_under_five_percent():
+    res = _bench_overhead()
+    # A gate that accidentally benchmarks an idle engine proves nothing: the
+    # ON arm must have tapped store_stats AND evaluated rules in most trials.
+    assert res["engaged_trials"] >= res["trials"] - 1, res
+    if res["overhead_frac"] >= 0.05:
+        retry = _bench_overhead()
+        assert retry["engaged_trials"] >= retry["trials"] - 1, retry
+        res = min((res, retry), key=lambda r: r["overhead_frac"])
+    assert res["overhead_frac"] < 0.05, (
+        f"watchtower costs {100 * res['overhead_frac']:.1f}% storm p50 "
+        f"(on {res['stats_on_p50_us']} us vs off {res['stats_off_p50_us']} us)"
+    )

@@ -445,3 +445,28 @@ def test_truncated_by_head_exits_141(tmp_path):
     )
     assert r.returncode == 141, (r.returncode, r.stderr)
     assert "Exception ignored" not in r.stderr
+
+
+def test_alert_transitions_render_and_count(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    _write_events(
+        path,
+        [
+            (0.0, "watchtower", "alert_fired",
+             {"rule": "step_anomaly", "severity": "page",
+              "detail": "z=12.3 over 600s"}),
+            (9.0, "watchtower", "alert_resolved",
+             {"rule": "step_anomaly", "severity": "page", "duration_s": 9.0,
+              "detail": "back under z_max"}),
+            (10.0, "watchtower", "alert_fired",
+             {"rule": "goodput_burn", "severity": "page"}),
+        ],
+    )
+    out = io.StringIO()
+    events_summary.summarize(events_summary.read_events(path), out=out)
+    text = out.getvalue()
+    assert "rule=step_anomaly sev=page FIRING: z=12.3 over 600s" in text
+    assert "rule=step_anomaly sev=page resolved for 9s: back under z_max" in text
+    assert "rule=goodput_burn sev=page FIRING" in text  # detail optional
+    assert "watchtower alerts fired: 2" in text
+    assert "watchtower alerts resolved: 1" in text

@@ -313,3 +313,59 @@ def test_env_var_wires_metrics_bridge(tmp_path, monkeypatch):
     doc = json.load(open(expect))
     vals = [e["value"] for e in doc["metrics"]["tpu_worker_failures_total"]]
     assert vals and vals[0] >= 1
+
+
+def test_step_gap_cap_is_env_tunable(monkeypatch):
+    """$TPU_RESILIENCY_STEP_GAP_MAX retunes the consecutive-step cap per
+    workload; garbage or non-positive values fall back to the 300s default
+    rather than taking metrics down."""
+    from tpu_resiliency.utils.metrics import (
+        STEP_GAP_ENV, STEP_GAP_MAX_S, step_gap_max_s,
+    )
+
+    monkeypatch.delenv(STEP_GAP_ENV, raising=False)
+    assert step_gap_max_s() == STEP_GAP_MAX_S == 300.0
+    monkeypatch.setenv(STEP_GAP_ENV, "5")
+    assert step_gap_max_s() == 5.0
+    for bad in ("zero-ish", "", "0", "-3"):
+        monkeypatch.setenv(STEP_GAP_ENV, bad)
+        assert step_gap_max_s() == STEP_GAP_MAX_S
+    # The knob reaches the step histogram: a 10s gap is a step under the
+    # default cap but downtime under a 5s cap.
+    recs = [
+        {"kind": "iteration_start", "iteration": 0, "ts": 100.0, "pid": 7},
+        {"kind": "iteration_start", "iteration": 1, "ts": 110.0, "pid": 7},
+    ]
+    monkeypatch.setenv(STEP_GAP_ENV, "5")
+    reg = MetricsRegistry()
+    aggregate(recs, reg)
+    assert not reg.histograms("tpu_step_seconds")
+    monkeypatch.delenv(STEP_GAP_ENV)
+    reg = MetricsRegistry()
+    aggregate(recs, reg)
+    assert next(iter(reg.histograms("tpu_step_seconds").values())).count == 1
+
+
+def test_alert_transitions_feed_alert_metrics():
+    """alert_fired/alert_resolved drive the pair the watchtower exports:
+    a by-rule/severity fired counter and a net active-alerts gauge."""
+    reg = MetricsRegistry()
+    aggregate([
+        {"kind": "alert_fired", "rule": "goodput_burn", "severity": "page"},
+        {"kind": "alert_fired", "rule": "ckpt_staleness", "severity": "warn"},
+        {"kind": "alert_resolved", "rule": "goodput_burn", "severity": "page",
+         "duration_s": 12.0},
+    ], reg)
+    snap = reg.snapshot()["metrics"]
+    fired = {
+        tuple(sorted(e["labels"].items())): e["value"]
+        for e in snap["tpu_alerts_total"]
+    }
+    assert fired == {
+        (("rule", "goodput_burn"), ("severity", "page")): 1,
+        (("rule", "ckpt_staleness"), ("severity", "warn")): 1,
+    }
+    assert reg.gauge("tpu_alerts_active").value == 1  # 2 fired - 1 resolved
+    prom = reg.to_prometheus()
+    assert 'tpu_alerts_total{rule="goodput_burn",severity="page"} 1' in prom
+    assert "tpu_alerts_active 1" in prom

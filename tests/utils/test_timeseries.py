@@ -1,0 +1,128 @@
+"""utils/timeseries.py: rings, the store, and the pure window helpers."""
+
+import pytest
+
+from tpu_resiliency.utils.timeseries import (
+    SeriesRing,
+    SeriesStore,
+    ewma,
+    mad,
+    mean_over_time,
+    quantile_over_time,
+    rate,
+    robust_zscore,
+)
+
+
+class TestSeriesRing:
+    def test_append_order_and_len(self):
+        r = SeriesRing(capacity=8)
+        for i in range(5):
+            r.observe(float(i), float(i * 10))
+        assert len(r) == 5
+        assert r.samples() == [(float(i), float(i * 10)) for i in range(5)]
+        assert r.last() == (4.0, 40.0)
+
+    def test_overwrites_oldest_when_full(self):
+        r = SeriesRing(capacity=4)
+        for i in range(10):
+            r.observe(float(i), float(i))
+        assert len(r) == 4
+        assert r.samples() == [(float(i), float(i)) for i in (6, 7, 8, 9)]
+
+    def test_window_is_half_open(self):
+        # start < ts <= end: a sample sits in exactly one adjacent window.
+        r = SeriesRing(capacity=8)
+        for i in range(6):
+            r.observe(float(i), float(i))
+        lo = r.samples(start=0.0, end=3.0)
+        hi = r.samples(start=3.0, end=6.0)
+        assert [s[0] for s in lo] == [1.0, 2.0, 3.0]
+        assert [s[0] for s in hi] == [4.0, 5.0]
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            SeriesRing(capacity=0)
+
+    def test_empty(self):
+        r = SeriesRing(capacity=4)
+        assert len(r) == 0 and r.samples() == [] and r.last() is None
+
+
+class TestSeriesStore:
+    def test_labels_key_series_independently(self):
+        st = SeriesStore(capacity=8)
+        st.observe("m", 1.0, 10.0, rank="0")
+        st.observe("m", 2.0, 20.0, rank="1")
+        assert st.query("m", rank="0") == [(1.0, 10.0)]
+        assert st.query("m", rank="1") == [(2.0, 20.0)]
+        assert st.query("m") == []  # unlabeled series never fed
+
+    def test_label_order_is_canonical(self):
+        st = SeriesStore()
+        st.observe("m", 1.0, 1.0, a="1", b="2")
+        assert st.query("m", b="2", a="1") == [(1.0, 1.0)]
+
+    def test_never_fed_family_queries_empty(self):
+        assert SeriesStore().query("nope") == []
+
+    def test_sizes_census(self):
+        st = SeriesStore(capacity=4)
+        st.observe("m", 1.0, 1.0)
+        st.observe("n", 1.0, 1.0, rank="3")
+        st.observe("n", 2.0, 2.0, rank="3")
+        assert st.sizes() == {"m": 1, "n{rank=3}": 2}
+
+
+class TestHelpers:
+    def test_rate_counter_semantics(self):
+        s = [(0.0, 0.0), (5.0, 50.0), (10.0, 100.0)]
+        assert rate(s) == pytest.approx(10.0)
+
+    def test_rate_handles_reset(self):
+        # A value drop is a restarted emitter: post-reset value counts whole.
+        s = [(0.0, 80.0), (5.0, 100.0), (10.0, 30.0)]
+        assert rate(s) == pytest.approx((20.0 + 30.0) / 10.0)
+
+    def test_rate_degenerate(self):
+        assert rate([]) is None
+        assert rate([(1.0, 1.0)]) is None
+        assert rate([(1.0, 1.0), (1.0, 2.0)]) is None
+
+    def test_quantile_interpolates(self):
+        s = [(float(i), float(v)) for i, v in enumerate([1, 2, 3, 4])]
+        assert quantile_over_time(s, 0.5) == pytest.approx(2.5)
+        assert quantile_over_time(s, 0.0) == 1.0
+        assert quantile_over_time(s, 1.0) == 4.0
+        assert quantile_over_time([], 0.5) is None
+        assert quantile_over_time([(0.0, 7.0)], 0.99) == 7.0
+
+    def test_mean_and_ewma(self):
+        s = [(0.0, 1.0), (1.0, 3.0)]
+        assert mean_over_time(s) == 2.0
+        assert mean_over_time([]) is None
+        assert ewma(s, alpha=0.5) == pytest.approx(2.0)
+        assert ewma([]) is None
+
+    def test_mad(self):
+        s = [(float(i), v) for i, v in enumerate([1.0, 1.0, 1.0, 10.0])]
+        assert mad(s) == pytest.approx(0.0)
+        s2 = [(float(i), v) for i, v in enumerate([1.0, 2.0, 3.0])]
+        assert mad(s2) == pytest.approx(1.0)
+
+    def test_robust_zscore(self):
+        base = [(float(i), v) for i, v in enumerate([1.0, 2.0, 3.0, 2.0, 1.0])]
+        z = robust_zscore(10.0, base)
+        assert z == pytest.approx((10.0 - 2.0) / (1.4826 * 1.0))
+
+    def test_robust_zscore_steady_baseline_floors_scale(self):
+        # A perfectly steady history (MAD 0) is exactly the baseline a
+        # straggler spike must register against: scale floors at 1% of the
+        # median instead of returning None.
+        base = [(float(i), 0.1) for i in range(10)]
+        z = robust_zscore(3.0, base)
+        assert z is not None and z > 100.0
+
+    def test_robust_zscore_no_scale_at_all(self):
+        assert robust_zscore(1.0, [(0.0, 0.0), (1.0, 0.0)]) is None
+        assert robust_zscore(1.0, [(0.0, 1.0)]) is None

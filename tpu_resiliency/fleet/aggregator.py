@@ -52,7 +52,11 @@ GOODPUT_SCHEMA = "tpu-fleet-goodput-1"
 SLO_SCHEMA = "tpu-fleet-slo-1"
 INCIDENTS_SCHEMA = "tpu-fleet-incidents-1"
 HANGZ_SCHEMA = "tpu-fleet-hangz-1"
+ALERTS_SCHEMA = "tpu-fleet-alerts-1"
 SNAPSHOT_SCHEMA = "tpu-fleet-snapshot-1"
+
+#: cross-job alert sort: most urgent severity first (watchtower grades)
+_SEVERITY_RANK = {"page": 0, "warn": 1, "info": 2}
 
 #: family-name prefix of the explicit fleet-total series (Prometheus reserves
 #: the ``:`` namespace for aggregated/recorded series — which these are)
@@ -484,6 +488,49 @@ class FleetView:
             "suspects": suspects,
         }
 
+    def alerts_doc(self) -> dict:
+        """The severity-ranked cross-job alert feed: every job's active
+        watchtower alerts stamped with their job, pages first. An unreachable
+        job degrades to its row (status ``unreachable``) — its last-known
+        alerts are gone with its endpoint, but the job itself never vanishes
+        from the feed, and the endpoint never answers non-200 for it."""
+        jobs = []
+        active = []
+        firing_jobs: dict[str, int] = {}
+        for s in self.states:
+            row = self._row_base(s)
+            al = (s["doc"] or {}).get("alerts")
+            if isinstance(al, dict):
+                row.update(
+                    active=len(al.get("active") or []),
+                    rules=len(al.get("rules") or []),
+                    alerts_error=al.get("error"),
+                )
+                for a in al.get("active") or []:
+                    if isinstance(a, dict):
+                        active.append({"job": s["job"], **a})
+                        firing_jobs[s["job"]] = firing_jobs.get(s["job"], 0) + 1
+            jobs.append(row)
+        active.sort(
+            key=lambda a: (
+                _SEVERITY_RANK.get(a.get("severity"), 9),
+                -(a.get("fire_ts") if isinstance(a.get("fire_ts"), (int, float))
+                  else 0.0),
+                a["job"],
+                str(a.get("rule")),
+            )
+        )
+        return {
+            "schema": ALERTS_SCHEMA,
+            "ts": self.ts,
+            "active": active,
+            "jobs": jobs,
+            "firing_jobs": dict(sorted(firing_jobs.items())),
+            "unreachable": sorted(
+                s["job"] for s in self.states if not s["reachable"]
+            ),
+        }
+
     def snapshot_doc(self) -> dict:
         """The whole fold as one offline-renderable artifact (what
         ``tpu-fleetd --snapshot`` persists and ``tpu-fleet`` renders)."""
@@ -496,5 +543,6 @@ class FleetView:
             "slo": self.slo_doc(),
             "incidents": self.incidents_doc(),
             "hangz": self.hangz_doc(),
+            "alerts": self.alerts_doc(),
             "metrics": self.registry.snapshot(),
         }

@@ -117,7 +117,7 @@ class FleetServer:
         log.info(
             f"fleet endpoint on http://{self._host}:{port} "
             f"(/fleet/metrics /fleet/goodput /fleet/slo /fleet/incidents "
-            f"/fleet/hangz /fleet/snapshot /healthz)"
+            f"/fleet/hangz /fleet/alerts /fleet/snapshot /healthz)"
         )
         return port
 
@@ -196,6 +196,11 @@ class FleetServer:
                 lambda v: v.hangz_doc(), agg_mod.HANGZ_SCHEMA
             )
             self._respond(req, 200, _json_body(doc), "application/json")
+        elif path == "/fleet/alerts":
+            doc = self._doc_or_degraded(
+                lambda v: v.alerts_doc(), agg_mod.ALERTS_SCHEMA
+            )
+            self._respond(req, 200, _json_body(doc), "application/json")
         elif path == "/fleet/snapshot":
             doc = self._doc_or_degraded(
                 lambda v: v.snapshot_doc(), agg_mod.SNAPSHOT_SCHEMA
@@ -212,8 +217,8 @@ class FleetServer:
                     "error": f"unknown path {path!r}",
                     "endpoints": [
                         "/fleet/metrics", "/fleet/goodput", "/fleet/slo",
-                        "/fleet/incidents", "/fleet/hangz", "/fleet/snapshot",
-                        "/healthz",
+                        "/fleet/incidents", "/fleet/hangz", "/fleet/alerts",
+                        "/fleet/snapshot", "/healthz",
                     ],
                 }),
                 "application/json",

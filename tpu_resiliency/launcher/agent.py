@@ -110,6 +110,11 @@ class AgentConfig:
     #: actuates nothing (the safe mode to trust the model first); "act"
     #: routes decisions through the remediation actuators and restart rounds.
     autoscale: str = "off"
+    #: SLO watchtower (``telemetry/watchtower.py``): "on" runs the burn-rate
+    #: alert engine off the telemetry server's events tail and serves it at
+    #: ``GET /alerts``; "off" disables it. Requires telemetry to be enabled
+    #: to matter. Rule overrides ride $TPU_RESILIENCY_ALERT_RULES.
+    alerts: str = "on"
 
     def __post_init__(self):
         if not self.node_id:
@@ -124,6 +129,10 @@ class AgentConfig:
             raise ValueError(
                 f"unknown autoscale mode {self.autoscale!r}: "
                 f"want off | advise | act"
+            )
+        if self.alerts not in ("off", "on"):
+            raise ValueError(
+                f"unknown alerts mode {self.alerts!r}: want off | on"
             )
 
 
@@ -173,6 +182,7 @@ class ElasticAgent:
         self._healthy = True
         self.telemetry = None
         self.autoscale = None
+        self.watchtower = None
         self._metrics_store = None
         self.incidents: Optional["IncidentEngine"] = None
         if cfg.incidents_dir:
@@ -220,6 +230,15 @@ class ElasticAgent:
             # document inside TelemetryServer — never the endpoint.
             return store.client.store_stats()
 
+        watchtower = None
+        if self.cfg.alerts != "off":
+            from tpu_resiliency.telemetry.watchtower import Watchtower
+
+            # rules=None picks up $TPU_RESILIENCY_ALERT_RULES overrides; the
+            # server's refresh() feeds it the events tail (stream clock), and
+            # start() pumps that tail from the watchtower's timer thread.
+            watchtower = Watchtower(job=self.cfg.job_id)
+        self.watchtower = watchtower
         self.telemetry = TelemetryServer(
             port=self.cfg.telemetry_port or 0,
             port_file=os.path.join(self.cfg.run_dir, PORT_FILE_NAME),
@@ -235,6 +254,7 @@ class ElasticAgent:
             job=self.cfg.job_id,
             node_id=self.cfg.node_id,
             incidents_dir=self.cfg.incidents_dir or None,
+            watchtower=watchtower,
         )
         self.telemetry.start()
 
@@ -269,11 +289,15 @@ class ElasticAgent:
             publish_degraded_fn=lambda degraded: None,
             cooldown=10.0,
         )
+        watchtower = self.watchtower
         self.autoscale = AutoscaleController(
             mode=self.cfg.autoscale,
             cost_model=CostModel.from_bench(os.getcwd()),
             remediation=engine,
             spare_capacity_fn=self._spare_capacity,
+            active_alerts_fn=(
+                watchtower.active_alerts if watchtower is not None else None
+            ),
             shrink_fn=lambda victims, reason: self.rdzv.request_restart(
                 f"autoscale shrink {victims}: {reason}"
             ),

@@ -134,6 +134,17 @@ class ControllerView:
     #: EWMA training-step wall clock (None before the first delta)
     step_s: Optional[float]
     steps_since_ckpt: int
+    #: active watchtower alerts ({rule, severity, ...} rows) — the SLO
+    #: plane's early warning, biasing checkpoint/swap ahead of the hang
+    #: verdict. Appended last with a default so hand-built views predate it.
+    active_alerts: list = dataclasses.field(default_factory=list)
+
+    def page_alerts(self) -> list:
+        """The page-severity subset — the only grade the cost model prices."""
+        return [
+            a for a in self.active_alerts
+            if isinstance(a, dict) and a.get("severity") == "page"
+        ]
 
 
 @dataclasses.dataclass
@@ -176,6 +187,9 @@ class CostModel:
         #: probability a notice that reaches its deadline actually reclaims
         #: the capacity (rescinds make this < 1)
         p_preempt: float = 0.7,
+        #: probability a page-severity watchtower alert (pre-hang straggler,
+        #: SLO burn) escalates into lost progress if nothing is banked
+        p_alert_risk: float = 0.35,
         #: extra outage beyond the cold restart when a preemption kills a
         #: rank with no shrink prepared (blocked re-rendezvous, fallback loss)
         preempt_block_s: float = 2.0,
@@ -191,6 +205,7 @@ class CostModel:
         self.reshard_s = reshard_s
         self.ckpt_s = ckpt_s
         self.p_preempt = p_preempt
+        self.p_alert_risk = p_alert_risk
         self.preempt_block_s = preempt_block_s
         self.capacity_weight = capacity_weight
         self.ewma_alpha = ewma_alpha
@@ -271,11 +286,15 @@ class CostModel:
             gain = (self._slow_frac(view) - self.capacity_weight * k / W) * H
             return gain * self._corr(action) - self.reshard_s
         if action == ACTION_CHECKPOINT:
-            # Bank unbanked progress before a notice can kill the rank.
-            if not view.notices or view.step_s is None:
+            # Bank unbanked progress before a notice can kill the rank — or,
+            # absent a notice, before a page-severity watchtower alert
+            # (pre-hang straggler, SLO burn) turns into the hang verdict.
+            pages = view.page_alerts()
+            if view.step_s is None or not (view.notices or pages):
                 return -self.ckpt_s
             at_risk = min(view.steps_since_ckpt * view.step_s, H)
-            return self.p_preempt * at_risk * self._corr(action) - self.ckpt_s
+            p = self.p_preempt if view.notices else self.p_alert_risk
+            return p * at_risk * self._corr(action) - self.ckpt_s
         if action == ACTION_SHRINK:
             # Ride out the reclamation training at W-k instead of dying at
             # the deadline (cold restart + blocked re-rendezvous + the
@@ -317,6 +336,7 @@ class CostModel:
             "reshard_s": self.reshard_s,
             "ckpt_s": self.ckpt_s,
             "p_preempt": self.p_preempt,
+            "p_alert_risk": self.p_alert_risk,
             "preempt_block_s": self.preempt_block_s,
             "capacity_weight": self.capacity_weight,
             "corrections": {
@@ -363,6 +383,9 @@ class AutoscaleController:
         cost_model: Optional[CostModel] = None,
         remediation: Any = None,
         spare_capacity_fn: Optional[Callable[[], int]] = None,
+        #: the watchtower's ``active_alerts`` — polled per tick, so the SLO
+        #: plane's early warning reaches the view before the hang verdict
+        active_alerts_fn: Optional[Callable[[], list]] = None,
         shrink_fn: Optional[Callable[[list, str], None]] = None,
         expand_fn: Optional[Callable[[str], None]] = None,
         target_world: Optional[int] = None,
@@ -394,6 +417,7 @@ class AutoscaleController:
         self.model = cost_model if cost_model is not None else CostModel()
         self.remediation = remediation
         self.spare_capacity_fn = spare_capacity_fn
+        self.active_alerts_fn = active_alerts_fn
         self.shrink_fn = shrink_fn
         self.expand_fn = expand_fn
         self.target_world = target_world
@@ -548,6 +572,12 @@ class AutoscaleController:
                 spares = int(self.spare_capacity_fn())
             except Exception:
                 pass
+        alerts: list = []
+        if self.active_alerts_fn is not None:
+            try:
+                alerts = list(self.active_alerts_fn())
+            except Exception:
+                pass  # a watchtower bug must not take the controller down
         with self._lock:
             return ControllerView(
                 now=self._now(),
@@ -558,6 +588,7 @@ class AutoscaleController:
                 notices=sorted(self._notices.values(), key=lambda n: n.noticed_at),
                 step_s=self._step_ewma,
                 steps_since_ckpt=self._steps_since_ckpt,
+                active_alerts=alerts,
             )
 
     # -- decide -------------------------------------------------------------
@@ -581,6 +612,15 @@ class AutoscaleController:
                     f"straggler(s) {victims} at score {worst:.2f} and no "
                     f"warm capacity; reshape around them",
                 ))
+        pages = view.page_alerts()
+        if pages and not view.notices:
+            rules = sorted({str(a.get("rule")) for a in pages})
+            out.append((
+                ACTION_CHECKPOINT, [],
+                f"page alert(s) {rules} firing with "
+                f"{view.steps_since_ckpt} unbanked step(s); bank progress "
+                f"before the hang verdict lands",
+            ))
         if view.notices:
             victims = sorted(
                 n.rank for n in view.notices if n.rank is not None
@@ -797,6 +837,20 @@ class AutoscaleController:
 
     # -- the /autoscale document --------------------------------------------
 
+    def _alerts_snapshot(self) -> list:
+        """Compact {rule, severity} rows from the wired watchtower, for the
+        ``/autoscale`` document (empty when none is wired or it misbehaves)."""
+        if self.active_alerts_fn is None:
+            return []
+        try:
+            return [
+                {"rule": a.get("rule"), "severity": a.get("severity")}
+                for a in self.active_alerts_fn()
+                if isinstance(a, dict)
+            ]
+        except Exception:
+            return []
+
     def status(self) -> dict:
         with self._lock:
             decisions = [
@@ -822,6 +876,7 @@ class AutoscaleController:
                 "world_size": self._world_size,
                 "target_world": self.target_world,
                 "stragglers": {str(r): s for r, s in self._stragglers.items()},
+                "active_alerts": self._alerts_snapshot(),
                 "pending_notices": notices,
                 "rescinds": self._rescinds,
                 "decisions_total": len(self.decisions),

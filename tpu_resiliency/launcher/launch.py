@@ -229,6 +229,16 @@ def build_parser() -> argparse.ArgumentParser:
         "events + the /autoscale endpoint without acting; 'act' routes "
         "decisions through the remediation actuators and restart rounds",
     )
+    p.add_argument(
+        "--alerts",
+        choices=("off", "on"),
+        default="on",
+        help="SLO watchtower (telemetry/watchtower.py): burn-rate and "
+        "anomaly alert rules evaluated over in-process time-series rings "
+        "fed from the shared events stream, served at GET /alerts and "
+        "folded into /snapshot. Needs telemetry enabled to matter. Rule "
+        "overrides via $TPU_RESILIENCY_ALERT_RULES (JSON file)",
+    )
     p.add_argument("--run-dir", default="", help="scratch dir for sockets/error files")
     p.add_argument("--ft-cfg-path", default=None, help="YAML with a fault_tolerance section")
     p.add_argument("--no-ft-monitors", action="store_true", help="disable per-rank hang monitors")
@@ -591,6 +601,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         fleet_dir=os.path.abspath(args.fleet_dir) if args.fleet_dir else "",
         job_id=args.rdzv_id,
         autoscale=args.autoscale,
+        alerts=args.alerts,
         # rdzv-id namespacing keeps two jobs on one store endpoint from
         # merging each other's metrics snapshots into their /metrics views.
         metrics_push_prefix=f"jobmetrics/{args.rdzv_id}/",

@@ -46,6 +46,14 @@ dir), serving three endpoints:
   bytes in/out, connection counts, dedup hit rate, barrier park depth, hot
   key prefixes. Folded into ``/snapshot`` so fleetd gets it for free; a
   crashing collector degrades the document, never the endpoint.
+- ``GET /alerts`` — the SLO watchtower's state document
+  (``schema: tpu-alerts-1``, ``telemetry/watchtower.py``): the loaded rule
+  table with per-rule state (ok / pending / firing / error), the
+  severity-ranked active alerts, recent fire/resolve history, and the ring
+  census. The watchtower rides the same incremental events tail as the
+  ledgers, so every refresh advances its rings too; a crashing rule degrades
+  to an error row on its rule entry, never a non-200. Folded into
+  ``/snapshot`` so fleetd gets the fleet-wide alert feed for free.
 
 ``/healthz`` results are TTL-cached (``health_ttl``, default 1 s) behind a
 lock, so a scrape storm from fleet pollers costs one ``health_fn``
@@ -115,6 +123,7 @@ class TelemetryServer:
         incidents_dir: Optional[str] = None,
         lease_interval: float = 5.0,
         snapshot_ttl: float = 1.0,
+        watchtower=None,
     ):
         self.registry = registry if registry is not None else MetricsRegistry()
         self.ledger = GoodputLedger()
@@ -133,6 +142,9 @@ class TelemetryServer:
         self.census_fn = census_fn
         self.autoscale_fn = autoscale_fn
         self.store_stats_fn = store_stats_fn
+        #: SLO watchtower (``telemetry/watchtower.py``): fed from the same
+        #: events tail the ledgers ride; None keeps /alerts degraded-but-200.
+        self.watchtower = watchtower
         #: fleet discovery (``fleet/registry.py``): directory the job's lease
         #: lives in; None keeps the server single-job (no registration).
         self.fleet_dir = fleet_dir
@@ -216,12 +228,18 @@ class TelemetryServer:
             os.replace(tmp, self.port_file)
         if self.fleet_dir:
             self._register_lease(port)
+        if self.watchtower is not None:
+            # The pump: alerts fire and resolve on schedule even when nobody
+            # scrapes (refresh tails the events file into the watchtower).
+            self.watchtower.start(poll_fn=self.refresh)
         log.info(f"telemetry endpoint on http://{self._host}:{port} "
                  f"(/metrics /goodput /healthz /hangz /autoscale /snapshot "
-                 f"/storez)")
+                 f"/storez /alerts)")
         return port
 
     def stop(self) -> None:
+        if self.watchtower is not None:
+            self.watchtower.stop()
         if self._lease_thread is not None:
             self._lease_stop.set()
             self._lease_thread.join(timeout=5.0)
@@ -344,6 +362,10 @@ class TelemetryServer:
             self._respond(
                 req, 200, _json_body(self._storez_doc()), "application/json"
             )
+        elif path == "/alerts":
+            self._respond(
+                req, 200, _json_body(self._alerts_doc()), "application/json"
+            )
         else:
             self._respond(
                 req, 404,
@@ -351,7 +373,7 @@ class TelemetryServer:
                             "endpoints": ["/metrics", "/metrics.json",
                                           "/goodput", "/healthz", "/hangz",
                                           "/autoscale", "/incidents",
-                                          "/snapshot", "/storez"]}),
+                                          "/snapshot", "/storez", "/alerts"]}),
                 "application/json",
             )
 
@@ -371,6 +393,24 @@ class TelemetryServer:
         except Exception as e:
             doc["error"] = repr(e)
         doc["schema"] = "tpu-storez-1"
+        return doc
+
+    def _alerts_doc(self) -> dict:
+        """The /alerts body (schema ``tpu-alerts-1``). The watchtower already
+        contains crashing rules to error rows; this guard covers a wedged
+        engine itself — the document degrades, never the endpoint. A refresh
+        first, so a scrape sees alerts derived from every complete line the
+        events file holds right now (same freshness contract as /goodput)."""
+        if self.watchtower is None:
+            return {"schema": "tpu-alerts-1", "job": self.job,
+                    "error": "no watchtower wired"}
+        try:
+            self.refresh()
+            doc = dict(self.watchtower.status())
+        except Exception as e:
+            doc = {"schema": "tpu-alerts-1", "error": repr(e)}
+        doc.setdefault("schema", "tpu-alerts-1")
+        doc.setdefault("job", self.job)
         return doc
 
     def _health_doc(self) -> dict:
@@ -475,6 +515,12 @@ class TelemetryServer:
             doc["autoscale"].setdefault("schema", "tpu-autoscale-1")
         if self.store_stats_fn is not None:
             doc["storez"] = self._storez_doc()
+        if self.watchtower is not None:
+            try:
+                doc["alerts"] = dict(self.watchtower.status())
+            except Exception as e:
+                doc["alerts"] = {"error": repr(e)}
+            doc["alerts"].setdefault("schema", "tpu-alerts-1")
         return doc
 
     def _snapshot_body(self) -> bytes:
@@ -514,6 +560,10 @@ class TelemetryServer:
             for rec in self._read_new_events():
                 self.ledger.observe(rec)
                 self.byteflow.observe(rec)
+                if self.watchtower is not None:
+                    # Same tail, same order — the watchtower's stream clock
+                    # advances exactly as an offline replay of this file would.
+                    self.watchtower.observe(rec)
             self.byteflow.publish()
             return self.ledger.publish()
 
@@ -571,6 +621,8 @@ class TelemetryServer:
         observe_record(rec, self.registry)
         self.ledger.observe(rec)
         self.byteflow.observe(rec)
+        if self.watchtower is not None:
+            self.watchtower.observe(rec)
 
 
 def _json_body(doc: dict) -> bytes:

@@ -197,6 +197,24 @@ def _fmt_preemption_rescinded(p: dict) -> str:
     )
 
 
+def _fmt_alert_fired(p: dict) -> str:
+    detail = p.get("detail")
+    return (
+        f"rule={p.get('rule')} sev={p.get('severity')} FIRING"
+        + (f": {detail}" if detail else "")
+    )
+
+
+def _fmt_alert_resolved(p: dict) -> str:
+    dur = p.get("duration_s")
+    held = f" for {dur:g}s" if isinstance(dur, (int, float)) else ""
+    detail = p.get("detail")
+    return (
+        f"rule={p.get('rule')} sev={p.get('severity')} resolved{held}"
+        + (f": {detail}" if detail else "")
+    )
+
+
 _FORMATTERS = {
     "rendezvous_round": _fmt_rendezvous_round,
     "worker_failed": _fmt_worker_failed,
@@ -206,6 +224,8 @@ _FORMATTERS = {
     "autoscale_decision": _fmt_autoscale_decision,
     "autoscale_outcome": _fmt_autoscale_outcome,
     "preemption_rescinded": _fmt_preemption_rescinded,
+    "alert_fired": _fmt_alert_fired,
+    "alert_resolved": _fmt_alert_resolved,
 }
 
 #: Kinds counted in the footer under friendlier names.
@@ -222,6 +242,8 @@ _SUMMARY_LINES = (
     ("preemption_sync_point", "preemption sync points"),
     ("preemption_rescinded", "preemption notices rescinded"),
     ("autoscale_decision", "autoscale decisions"),
+    ("alert_fired", "watchtower alerts fired"),
+    ("alert_resolved", "watchtower alerts resolved"),
     ("timeouts_calculated", "FT timeout calibrations"),
     ("training_finished", "training finished"),
     ("budget_exhausted", "restart budget exhausted"),

@@ -12,6 +12,11 @@ Usage::
     tpu-fleet slo --snapshot fleet.json
     tpu-fleet incidents --snapshot fleet.json --job trainer-a
     tpu-fleet scoreboard --url http://127.0.0.1:9400
+    tpu-fleet slo --snapshot fleet.json --format json | jq .
+
+``--format json`` emits the selected view's sub-document (the same
+``tpu-fleet-*-1`` schema the daemon serves) instead of tables — the
+scripting-side contract, stable where the table layout is not.
 """
 
 from __future__ import annotations
@@ -162,6 +167,11 @@ def main(argv: Optional[list[str]] = None) -> int:
         "--job", default=None,
         help="incidents view: slice the feed to one job",
     )
+    ap.add_argument(
+        "--format", choices=("table", "json"), default="table",
+        help="table renders for operators; json emits the selected view's "
+        "sub-document verbatim (stable tpu-fleet-*-1 schema, for scripting)",
+    )
     args = ap.parse_args(argv)
     if bool(args.snapshot) == bool(args.url):
         print("exactly one of --snapshot / --url is required", file=sys.stderr)
@@ -173,7 +183,19 @@ def main(argv: Optional[list[str]] = None) -> int:
         return 1
 
     def emit() -> None:
-        if args.view == "scoreboard":
+        if args.format == "json":
+            section = {"scoreboard": "goodput", "slo": "slo",
+                       "incidents": "incidents"}[args.view]
+            sub = doc.get(section) or {}
+            if args.view == "incidents" and args.job is not None:
+                sub = dict(sub)
+                sub["incidents"] = [
+                    i for i in sub.get("incidents") or []
+                    if i.get("job") == args.job
+                ]
+            json.dump(sub, sys.stdout, indent=2, sort_keys=True)
+            print()
+        elif args.view == "scoreboard":
             render_scoreboard(doc)
         elif args.view == "slo":
             render_slo(doc)

@@ -54,7 +54,7 @@ from __future__ import annotations
 from typing import Any, Callable, Iterable, Optional
 
 from tpu_resiliency.utils import events as events_mod
-from tpu_resiliency.utils.metrics import STEP_GAP_MAX_S
+from tpu_resiliency.utils.metrics import step_gap_max_s
 
 SCHEMA = "tpu-goodput-1"
 
@@ -152,8 +152,10 @@ class GoodputLedger:
     priority subtraction happen at summary time, not per event.
     """
 
-    def __init__(self, *, max_step_s: float = STEP_GAP_MAX_S):
-        self.max_step_s = max_step_s
+    def __init__(self, *, max_step_s: Optional[float] = None):
+        # Resolved at construction (not import) so $TPU_RESILIENCY_STEP_GAP_MAX
+        # set by the launcher reaches every ledger built after it.
+        self.max_step_s = step_gap_max_s() if max_step_s is None else max_step_s
         self._min_ts: Optional[float] = None
         self._max_ts: Optional[float] = None
         #: raw (unmerged) intervals per phase

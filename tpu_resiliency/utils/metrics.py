@@ -79,7 +79,31 @@ FORECAST_ERROR_BUCKETS_S = (
 #: An ``iteration_start`` delta larger than this is not a step — it's a gap
 #: (hang, restart, operator pause) and must not pollute the step histogram or
 #: the goodput ledger's ``train`` attribution (``utils/goodput.py`` shares it).
+#: Default 300 s; tune per workload via ``$TPU_RESILIENCY_STEP_GAP_MAX`` (see
+#: :func:`step_gap_max_s`) — a job whose legitimate steps include multi-minute
+#: compiles or evals would otherwise see them misattributed as downtime.
 STEP_GAP_MAX_S = 300.0
+
+#: Env override for :data:`STEP_GAP_MAX_S` (seconds, must parse > 0).
+STEP_GAP_ENV = "TPU_RESILIENCY_STEP_GAP_MAX"
+
+
+def step_gap_max_s() -> float:
+    """The effective step-gap cap: ``$TPU_RESILIENCY_STEP_GAP_MAX`` when it
+    parses to a positive number, else the 300 s default. Read per call so the
+    live sink, a post-hoc ``aggregate()``, and the goodput ledger all honor
+    the same setting without restart-ordering surprises; an unparseable or
+    non-positive value falls back rather than raising — a typo'd env var must
+    not take down metrics."""
+    raw = os.environ.get(STEP_GAP_ENV)
+    if raw:
+        try:
+            v = float(raw)
+            if v > 0:
+                return v
+        except ValueError:
+            pass
+    return STEP_GAP_MAX_S
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_BAD = re.compile(r"[^a-zA-Z0-9_]")
@@ -544,7 +568,7 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             prev = st.get(rec.get("pid"))
             if (
                 prev is not None and it == prev[1] + 1
-                and 0 < ts - prev[0] <= STEP_GAP_MAX_S
+                and 0 < ts - prev[0] <= step_gap_max_s()
             ):
                 reg.histogram(
                     "tpu_step_seconds",
@@ -1057,6 +1081,23 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "tpu_span_failures_total", "spans that raised",
                 span=str(rec.get("span", "?")),
             ).inc()
+    elif kind == "alert_fired":
+        # Watchtower transitions (telemetry/watchtower.py) mirror the
+        # incident counter+gauge pattern: total by rule/severity, plus the
+        # currently-firing gauge the resolve decrements.
+        reg.counter(
+            "tpu_alerts_total",
+            "watchtower alerts fired, by rule and severity",
+            rule=str(rec.get("rule", "?")),
+            severity=str(rec.get("severity", "?")),
+        ).inc()
+        reg.gauge(
+            "tpu_alerts_active", "watchtower alerts currently firing"
+        ).inc()
+    elif kind == "alert_resolved":
+        reg.gauge(
+            "tpu_alerts_active", "watchtower alerts currently firing"
+        ).dec()
 
 
 def aggregate(
@@ -1068,6 +1109,27 @@ def aggregate(
         if isinstance(rec, dict):
             observe_record(rec, reg)
     return reg
+
+
+def flatten_event(event) -> dict:
+    """One Event → the flat record shape its JSONL line would carry.
+
+    The single flattening (including the ``p_``-rename of payload keys that
+    collide with the envelope) shared by :class:`MetricsSink` and the
+    watchtower's sink — live in-process consumers and post-hoc file replays
+    must see byte-identical record shapes.
+    """
+    if hasattr(event, "to_record"):
+        return event.to_record()
+    rec = {
+        "ts": event.ts, "source": event.source, "kind": event.kind,
+        "pid": event.pid, "rank": event.rank,
+        **{f"p_{k}" if k in RESERVED_KEYS else k: v
+           for k, v in event.payload.items()},
+    }
+    if getattr(event, "job", None) is not None:
+        rec["job"] = event.job
+    return rec
 
 
 class MetricsSink:
@@ -1103,18 +1165,7 @@ class MetricsSink:
     def __call__(self, event) -> None:
         # Same flat shape as the JSONL line (including the p_-rename of payload
         # keys that collide with the envelope), minus the json round-trip.
-        if hasattr(event, "to_record"):
-            rec = event.to_record()
-        else:
-            rec = {
-                "ts": event.ts, "source": event.source, "kind": event.kind,
-                "pid": event.pid, "rank": event.rank,
-                **{f"p_{k}" if k in RESERVED_KEYS else k: v
-                   for k, v in event.payload.items()},
-            }
-            if getattr(event, "job", None) is not None:
-                rec["job"] = event.job
-        observe_record(rec, self.registry)
+        observe_record(flatten_event(event), self.registry)
         if self.json_path is not None:
             now = time.monotonic()
             if now - self._last_snapshot >= self.snapshot_interval:
