@@ -18,7 +18,16 @@ bytes — at this scenario's geometry roughly half a shard instead of whole
 containers — and the committed run is the regression anchor for
 ``tests/checkpoint/test_reshard_perf.py``.
 
-    python scripts/bench_reshard.py [--mb 64] [--out BENCH_reshard.json]
+The committed artifact also carries a ``leg_1g`` block (``--with-1g``): the
+same scenario at a 1 GB tree, where fixed costs (collectives, plan build)
+vanish into the noise and the speedup is pure serve-path pipelining — the
+1 GB speedup must EXCEED the 64 MB one, which is the regression gate that
+the overlap keeps scaling with payload instead of being a small-payload
+artifact. ``--assert-subsecond`` turns the report into a pass/fail check of
+the elastic headline: shrink-to-trainable (the slowest survivor's
+``load_resharded`` wall) under one second at the gate payload.
+
+    python scripts/bench_reshard.py [--mb 64] [--with-1g] [--out BENCH_reshard.json]
 """
 
 import argparse
@@ -259,22 +268,44 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, help="write the JSON report here")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny payload, assert the bytes win, exit 0/1")
+    ap.add_argument("--with-1g", action="store_true",
+                    help="also run the slow 1 GB leg (leg_1g in the report); "
+                    "its speedup must exceed the gate payload's")
+    ap.add_argument("--assert-subsecond", action="store_true",
+                    help="exit 1 unless shrink-to-trainable (ranged_s) < 1 s")
     args = ap.parse_args(argv)
     mb = 2 if args.smoke else args.mb
     res = bench(mb)
+    if args.with_1g:
+        res["leg_1g"] = bench(1024)
     print(json.dumps(res, indent=2))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(res, f, indent=2)
             f.write("\n")
+    rc = 0
     if args.smoke:
         ok = (
             res["full_peer_bytes"] > 0
             and res["ranged_peer_bytes"] < res["full_peer_bytes"]
         )
         print(f"bench_reshard smoke: {'PASS' if ok else 'FAIL'}")
-        return 0 if ok else 1
-    return 0
+        rc = max(rc, 0 if ok else 1)
+    if args.assert_subsecond:
+        ok = res["ranged_s"] < 1.0
+        print(
+            f"bench_reshard sub-second resume: shrink-to-trainable "
+            f"{res['ranged_s']}s at {mb} MB — {'PASS' if ok else 'FAIL'}"
+        )
+        rc = max(rc, 0 if ok else 1)
+    if args.with_1g:
+        ok = (res["leg_1g"]["speedup"] or 0) > (res["speedup"] or 0)
+        print(
+            f"bench_reshard 1G scaling: speedup {res['leg_1g']['speedup']}x "
+            f"@1G vs {res['speedup']}x @{mb}MB — {'PASS' if ok else 'FAIL'}"
+        )
+        rc = max(rc, 0 if ok else 1)
+    return rc
 
 
 if __name__ == "__main__":

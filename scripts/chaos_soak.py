@@ -675,7 +675,15 @@ def scenario_coding(seed: int, spec: str | None = None):
 
 #: A light network plan rides along (sender-retried, MUST converge) so the
 #: elastic chain is exercised under the same fault pressure as the others.
-ELASTIC_SPEC = "{seed}:p2p.send.reset@at=3;store.send.reset@at=7"
+#: The second p2p reset (``at=13``) is aimed inside the shrink's parallel
+#: ranged-fetch window (the save-phase replication fan-out plus its one
+#: retry consume indices 0..9; the concurrent ``fetch_ranges`` traffic owns
+#: 10..25), so every soak run proves the degraded-holder re-route under the
+#: overlapped serve/fetch pool, not just under serial resharding. Which
+#: *thread's* send draws index 13 is racy, but the convergence contract —
+#: schedule, victim, and per-rank byte splits — is thread-independent: the
+#: splits come from the plan summary, not from who fetched what when.
+ELASTIC_SPEC = "{seed}:p2p.send.reset@at=3+13;store.send.reset@at=7"
 
 
 def scenario_elastic(seed: int, spec: str | None = None):
@@ -824,6 +832,27 @@ def scenario_elastic(seed: int, spec: str | None = None):
             (e.payload["rank"], e.payload["direction"],
              e.payload["local_bytes"], e.payload["peer_bytes"])
             for e in plans
+        )
+        # The mid-fetch reset must have been consumed inside the reshard
+        # window AND recovered from: either the sender-side retry absorbed it
+        # (a ``p2p_retry`` per reset) or the requester saw the torn reply and
+        # re-routed around the degraded holder (``ckpt_integrity_failure``
+        # with stage="reshard-fetch"). Both are convergent; neither may be
+        # silent.
+        p2p_resets = [e for e in seen if e.kind == "chaos_inject"
+                      and e.payload["channel"] == "p2p"
+                      and e.payload["op"] == "send"]
+        assert len(p2p_resets) >= 2, (
+            f"expected both seeded p2p resets to fire, saw "
+            f"{[(e.payload['op'], e.payload['index']) for e in p2p_resets]}"
+        )
+        recovered = [e for e in seen if e.kind == "p2p_retry"] + [
+            e for e in seen if e.kind == "ckpt_integrity_failure"
+            and e.payload.get("stage") == "reshard-fetch"
+        ]
+        assert len(recovered) >= len(p2p_resets), (
+            f"{len(p2p_resets)} p2p resets but only {len(recovered)} "
+            f"recovery artifacts — a fault was swallowed without re-route"
         )
         _assert_byteflow_accounts(seen)
     finally:
@@ -1333,13 +1362,26 @@ class _AutoscaleSim:
         self.world = self.full_world
 
 
-def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
+def _autoscale_campaign(seed: int, workdir: str, controlled: bool,
+                        repriced: bool = True):
     """One arm of the campaign: fluctuating capacity (a preemption notice
     that rescinds, then one that doesn't) + an injected straggler + a seeded
     disk fault. ``controlled`` runs the AutoscaleController in act mode;
     the baseline runs the identical fault script with today's hard-coded
     reactions (straggle until death, drain-and-stop on every notice, die at
-    the deadline). Returns ``(records, decision_schedule, disk_schedule)``."""
+    the deadline). Returns ``(records, decision_schedule, disk_schedule)``.
+
+    ``repriced`` selects which reshard price the controlled arm's cost model
+    reads from its (synthetic) bench artifact — both prices via the SAME
+    ``CostModel.from_bench`` path production uses. ``True`` gives it the
+    ``phases`` decomposition (plan + fetch = the real per-rank stall once
+    serve/fetch/assembly overlap); ``False`` strips the ``phases`` block so
+    ``from_bench`` falls back to the serial-era ``ranged_s`` top line, which
+    also charges the local assembly that now hides under the fetch. The
+    inflated price keeps shrink's predicted gain under the hysteresis bar at
+    the ripe preemption, so the old-priced arm declines the resize and pays
+    the death it could have dodged — identical fault script, identical
+    physics, different constants, measurably worse goodput."""
     import shutil
     import numpy as np
 
@@ -1367,9 +1409,8 @@ def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
         if ctl is not None:
             ctl.observe(recs[-1])
 
-    ckpt_root = os.path.join(
-        workdir, f"ckpt_{'ctl' if controlled else 'base'}"
-    )
+    arm = ("ctl_phases" if repriced else "ctl_ranged") if controlled else "base"
+    ckpt_root = os.path.join(workdir, f"ckpt_{arm}")
     shutil.rmtree(ckpt_root, ignore_errors=True)
     spares = [1]
 
@@ -1401,16 +1442,33 @@ def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
             request_restart_fn=swap_restart,
             cooldown=0.0,
         )
+        # Price the model the way production does — ``from_bench`` over a
+        # bench artifact. Both arms share ranged_s (the serial-era top line
+        # = the sim's actual reshard stall); only the repriced arm's doc
+        # carries the phase decomposition, whose plan+fetch sum is what the
+        # overlapped hot path really stalls a rank for.
+        bench_dir = os.path.join(workdir, f"bench_{arm}")
+        os.makedirs(bench_dir, exist_ok=True)
+        bench_doc = {"ranged_s": _AutoscaleSim.RESHARD_S}
+        if repriced:
+            bench_doc["phases"] = {"plan_s": 0.002, "fetch_s": 0.038}
+        with open(os.path.join(bench_dir, "BENCH_reshard.json"), "w") as f:
+            json.dump(bench_doc, f)
+        cost_model = CostModel.from_bench(
+            bench_dir,
+            horizon_s=4.0,
+            warm_restart_s=_AutoscaleSim.WARM_RESTART_S,
+            cold_restart_s=_AutoscaleSim.COLD_RESTART_S,
+            ckpt_s=0.02,
+            preempt_block_s=_AutoscaleSim.PREEMPT_BLOCK_S,
+        )
+        assert abs(cost_model.reshard_s - (0.04 if repriced else 0.12)) < 1e-9, (
+            f"from_bench priced reshard_s={cost_model.reshard_s} "
+            f"(repriced={repriced})"
+        )
         ctl = AutoscaleController(
             mode="act",
-            cost_model=CostModel(
-                horizon_s=2.0,
-                warm_restart_s=_AutoscaleSim.WARM_RESTART_S,
-                cold_restart_s=_AutoscaleSim.COLD_RESTART_S,
-                reshard_s=_AutoscaleSim.RESHARD_S,
-                ckpt_s=0.02,
-                preempt_block_s=_AutoscaleSim.PREEMPT_BLOCK_S,
-            ),
+            cost_model=cost_model,
             remediation=engine,
             spare_capacity_fn=lambda: spares[0],
             shrink_fn=sim.shrink,
@@ -1418,7 +1476,10 @@ def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
             target_world=world,
             rescind_grace_s=0.6,
             shrink_lead_s=0.1,
-            hysteresis_s=0.05,
+            # Sits between the two priced shrink gains (0.51 with the serial
+            # ranged_s, 0.59 with plan+fetch): the repricing alone flips the
+            # ripe-preemption decision.
+            hysteresis_s=0.55,
             dwell_s=0.3,
             decision_cooldown_s=10.0,
             outcome_window_s=0.5,
@@ -1481,7 +1542,7 @@ def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
                      step=sim.it, noticed_step=sim.it)
             sim.steps(10)
         # -- phase 3: real preemption (deadline hits) ------------------------
-        if controlled:
+        if controlled and repriced:
             ctl.note_preemption(
                 f"r{v_preempt}", rank=v_preempt, deadline=time.time()
             )
@@ -1495,6 +1556,26 @@ def _autoscale_campaign(seed: int, workdir: str, controlled: bool):
             d = ctl.tick()
             assert d is not None and d.action == "expand", d
             sim.steps(10)
+        elif controlled:
+            # The serial-era price keeps shrink's predicted gain under the
+            # hysteresis bar: the controller banks progress at most (or stays
+            # silent under the per-victim cooldown) and the rank dies at the
+            # deadline — the exact regression the phase repricing closes.
+            ctl.note_preemption(
+                f"r{v_preempt}", rank=v_preempt, deadline=time.time()
+            )
+            sim.emit("preemption", "preemption_sync_point", rank=v_preempt,
+                     step=sim.it)
+            d = ctl.tick()
+            assert d is None or d.action == "checkpoint", d
+            sim.steps(2)  # the grace window ticks away, nothing resizes
+            sim.downtime(
+                _AutoscaleSim.COLD_RESTART_S + _AutoscaleSim.PREEMPT_BLOCK_S,
+                "worker_failed", global_rank=v_preempt, exitcode=137,
+                detail="preempted at deadline; shrink underpriced by the "
+                       "serial-era ranged_s constant",
+            )
+            sim.steps(25)
         else:
             sim.emit("preemption", "preemption_sync_point", rank=v_preempt,
                      step=sim.it)
@@ -1549,6 +1630,13 @@ def scenario_autoscale(seed: int, workdir: str):
     seed, the controlled run's (decision, action, victim) schedule must
     reproduce across two runs, and every decision event must pair with an
     outcome event carrying both predicted and realized goodput deltas.
+
+    A third arm reprices nothing BUT the cost model: same controller, same
+    fault script, constants drawn from the same bench artifact minus its
+    ``phases`` block (the pre-overlap ``ranged_s`` price). That arm must
+    decline the ripe-preemption shrink, never expand, and land a strictly
+    WORSE goodput ratio than the phase-priced arm — the decision-schedule
+    diff is visible in the two arms' ``autoscale_decision`` audit events.
     Leaves ``controlled.jsonl`` / ``baseline.jsonl`` in ``workdir`` for the
     smoke leg's offline ``tpu-metrics-dump --goodput --baseline`` check."""
     from tpu_resiliency.utils.goodput import GoodputLedger, compare
@@ -1563,8 +1651,25 @@ def scenario_autoscale(seed: int, workdir: str):
     assert [a for _, a, _ in c1_sched] == [
         "swap", "checkpoint", "shrink", "expand",
     ], c1_sched
+    o_recs, o_sched, o_disk = _autoscale_campaign(
+        seed, workdir, True, repriced=False
+    )
     b_recs, _, b_disk = _autoscale_campaign(seed, workdir, False)
     assert b_disk == c1_disk, "disk fault schedule diverged between arms"
+    assert o_disk == c1_disk, "disk fault schedule diverged (serial-priced)"
+
+    # The repricing IS the decision diff: the serial-priced arm never
+    # resizes — and the divergence is auditable from the decision events
+    # alone, no internal state needed.
+    old_actions = [a for _, a, _ in o_sched]
+    assert old_actions[:2] == ["swap", "checkpoint"], o_sched
+    assert "shrink" not in old_actions and "expand" not in old_actions, o_sched
+    audit_new = [r["action"] for r in c1_recs
+                 if r.get("kind") == "autoscale_decision"]
+    audit_old = [r["action"] for r in o_recs
+                 if r.get("kind") == "autoscale_decision"]
+    assert "shrink" in audit_new and "expand" in audit_new, audit_new
+    assert "shrink" not in audit_old and "expand" not in audit_old, audit_old
 
     # Every decision carries predicted AND realized goodput delta (the
     # outcome event pairs them; finalize settled any stragglers).
@@ -1581,17 +1686,30 @@ def scenario_autoscale(seed: int, workdir: str):
         assert isinstance(o.get("predicted_delta_s"), (int, float)), o
         assert isinstance(o.get("realized_delta_s"), (int, float)), o
 
-    # The acceptance inequality, via the same compare() helper the CLI uses.
-    controlled, baseline = GoodputLedger(), GoodputLedger()
+    # The acceptance inequalities, via the same compare() helper the CLI
+    # uses: phase-priced > serial-priced > no controller at all.
+    controlled, old_priced, baseline = (
+        GoodputLedger(), GoodputLedger(), GoodputLedger()
+    )
     controlled.observe_many(c1_recs)
+    old_priced.observe_many(o_recs)
     baseline.observe_many(b_recs)
     cmp_doc = compare(controlled, baseline)
     assert cmp_doc["ratio_delta"] > 0, (
         f"controller did NOT beat the no-controller baseline: {cmp_doc}"
     )
+    cmp_old = compare(old_priced, baseline)
+    assert cmp_old["ratio_delta"] > 0, (
+        f"serial-priced controller did NOT beat the baseline: {cmp_old}"
+    )
+    cmp_reprice = compare(controlled, old_priced)
+    assert cmp_reprice["ratio_delta"] > 0, (
+        f"phase repricing did NOT beat the serial-era constants: {cmp_reprice}"
+    )
 
-    # Both arms climbed the identical disk-fault ladder.
-    for name, arm in (("controlled", c1_recs), ("baseline", b_recs)):
+    # Every arm climbed the identical disk-fault ladder.
+    for name, arm in (("controlled", c1_recs), ("serial_priced", o_recs),
+                      ("baseline", b_recs)):
         assert any(r.get("kind") == "ckpt_quarantined" for r in arm), (
             f"{name}: bitflipped container never quarantined"
         )
@@ -1607,7 +1725,9 @@ def scenario_autoscale(seed: int, workdir: str):
     ):
         assert want in prom, f"{want} missing:\n{prom[:2000]}"
 
-    for name, arm in (("controlled", c1_recs), ("baseline", b_recs)):
+    for name, arm in (("controlled", c1_recs),
+                      ("controlled_serial_priced", o_recs),
+                      ("baseline", b_recs)):
         with open(os.path.join(workdir, f"{name}.jsonl"), "w") as f:
             for rec in arm:
                 f.write(json.dumps(rec) + "\n")
@@ -1615,7 +1735,8 @@ def scenario_autoscale(seed: int, workdir: str):
         [list(s) for s in c1_sched],
         (seed % 4, (seed // 4) % 4, (seed // 16) % 4),
         [list(i) for i in c1_disk],
-        cmp_doc["goodput_ratio"],
+        (cmp_doc["goodput_ratio"][0], cmp_old["goodput_ratio"][0],
+         cmp_doc["goodput_ratio"][1]),
     )
 
 
@@ -1843,15 +1964,17 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert h1 == h2, f"hang schedule not reproducible:\n{h1}\n{h2}"
     out["hang_schedule"] = [h1[0], list(h1[1]), h1[2]]
     out["hang_workdir"] = hang_dir
-    # Autoscale campaign: scenario_autoscale internally runs the controlled
-    # arm twice (identical decision schedules) plus the baseline arm and
-    # asserts the goodput-beats-baseline invariant.
+    # Autoscale campaign: scenario_autoscale internally runs the phase-priced
+    # controlled arm twice (identical decision schedules) plus the
+    # serial-priced arm and the baseline, asserting the strict goodput
+    # ordering phase-priced > serial-priced > no controller.
     autoscale_dir = os.path.join(workdir, f"autoscale_{seed}")
     a_sched, a_victims, a_disk, a_ratios = scenario_autoscale(seed, autoscale_dir)
     out["autoscale_schedule"] = a_sched
     out["autoscale_victims"] = list(a_victims)
     out["autoscale_goodput"] = {"controlled": a_ratios[0],
-                                "baseline": a_ratios[1]}
+                                "serial_priced": a_ratios[1],
+                                "baseline": a_ratios[2]}
     out["autoscale_workdir"] = autoscale_dir
     # Watchtower campaign: scenario_alerts internally runs the synthetic
     # stream twice (identical fire/resolve sequences) and byte-compares the

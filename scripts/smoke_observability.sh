@@ -259,6 +259,9 @@ PY
 echo "== smoke: elastic reshard (ranged fetch moves fewer bytes than full mirrors)"
 python scripts/bench_reshard.py --smoke
 
+echo "== smoke: sub-second elastic resume (shrink-to-trainable < 1s at 64 MB)"
+python scripts/bench_reshard.py --mb 64 --assert-subsecond
+
 echo "== smoke: elastic reshard plan preflight (ckpt_info --plan)"
 RS="$WORKDIR/reshard"
 mkdir -p "$RS"

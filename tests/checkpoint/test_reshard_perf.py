@@ -2,8 +2,10 @@
 
 Runs ``scripts/bench_reshard.py`` at a CI-sized payload and asserts the
 ACCEPTANCE byte claim: the ranged-fetch path moves strictly fewer peer bytes
-than a full-mirror retrieve of the same shrink. The committed 64 MB results
-live in ``BENCH_reshard.json``."""
+than a full-mirror retrieve of the same shrink. Also gates the COMMITTED
+artifacts: ``BENCH_reshard.json`` (sub-second shrink-to-trainable at 64 MB,
+1 GB leg with a strictly larger overlap speedup) and
+``BENCH_replication.json`` (the composed delta×erasure leg)."""
 
 import json
 import os
@@ -49,3 +51,63 @@ def test_ranged_fetch_moves_strictly_fewer_bytes(tmp_path):
     # whole-container CRC pass — elastic resume must beat the full-mirror
     # retrieve-and-slice recovery on wall clock, not just bytes.
     assert res["speedup"] > 1.0, res
+
+
+@pytest.mark.slow
+def test_committed_bench_has_subsecond_resume_and_1g_scaling():
+    """Gate the COMMITTED ``BENCH_reshard.json``: the sub-second elastic
+    resume claim (shrink-to-trainable < 1 s at 64 MB) plus the 1 GB leg
+    whose overlap speedup must EXCEED the 64 MB speedup — the parallel
+    serve/fetch/assembly win grows with payload, so a regression in the
+    overlap plumbing shows up here before it shows up in production."""
+    doc = json.loads(
+        open(os.path.join(REPO_ROOT, "BENCH_reshard.json")).read()
+    )
+    assert doc["mb"] == 64, doc
+    assert doc["ranged_s"] < 1.0, doc
+    # phases must be present and well-formed: CostModel.from_bench prefers
+    # them over ranged_s when repricing the autoscale controller.
+    ph = doc["phases"]
+    assert ph["plan_s"] >= 0 and ph["fetch_s"] > 0, ph
+    assert ph["plan_s"] + ph["fetch_s"] <= doc["ranged_s"], doc
+    leg = doc["leg_1g"]
+    assert leg["mb"] == 1024, leg
+    assert leg["speedup"] > doc["speedup"], (leg["speedup"], doc["speedup"])
+
+
+@pytest.mark.slow
+def test_bench_reshard_1g_leg_regenerates_and_holds(tmp_path):
+    """Re-run the 1 GB leg end to end (the slow CI lane): the regenerated
+    point must itself clear both perf gates, not just the committed one."""
+    out = tmp_path / "bench1g.json"
+    r = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "bench_reshard.py"),
+            "--mb", "64", "--with-1g", "--assert-subsecond",
+            "--out", str(out),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-3000:]
+    res = json.loads(out.read_text())
+    assert res["leg_1g"]["speedup"] > res["speedup"], res
+
+
+@pytest.mark.slow
+def test_committed_bench_replication_composed_leg():
+    """Gate the COMMITTED ``BENCH_replication.json`` composed leg: at 5%
+    dirty a steady-state round ships delta frames erasure-coded — ≥20×
+    fewer wire bytes than full mirrors, per-rank wire cost ≤ (1+1/k)× the
+    frame, and the k-of-n frame reconstruction ran byte-identical."""
+    doc = json.loads(
+        open(os.path.join(REPO_ROOT, "BENCH_replication.json")).read()
+    )
+    leg = doc["delta_erasure"]
+    assert leg["dirty_frac"] == 0.05, leg
+    assert leg["bytes_win"] >= 20.0, leg
+    assert leg["payload_ratio"] <= 1 + 1 / leg["k"] + 0.05, leg
+    assert leg["reconstruct_ok"] is True, leg

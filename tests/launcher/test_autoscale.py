@@ -134,6 +134,37 @@ class TestCostModel:
         d = CostModel.from_bench(str(tmp_path / "nope"))
         assert d.cold_restart_s == CostModel().cold_restart_s
 
+    def test_from_bench_prefers_phase_decomposition(self, tmp_path):
+        """A refreshed bench file with a ``phases`` block reprices the shrink
+        delta: plan+fetch beats the top-line ranged_s (which still charges
+        the local assembly that now hides under the overlapped fetch)."""
+        with open(tmp_path / "BENCH_reshard.json", "w") as f:
+            json.dump({"ranged_s": 0.25}, f)
+        old = CostModel.from_bench(str(tmp_path))
+        assert old.reshard_s == pytest.approx(0.25)
+        v = view()
+        priced_old = old.estimate(ACTION_SHRINK, v)
+        # Refresh the artifact with the phase decomposition.
+        with open(tmp_path / "BENCH_reshard.json", "w") as f:
+            json.dump(
+                {"ranged_s": 0.25,
+                 "phases": {"plan_s": 0.01, "fetch_s": 0.03}}, f
+            )
+        new = CostModel.from_bench(str(tmp_path))
+        assert new.reshard_s == pytest.approx(0.04)
+        priced_new = new.estimate(ACTION_SHRINK, v)
+        # The repriced model strictly raises the shrink delta.
+        assert priced_new > priced_old
+        assert priced_new - priced_old == pytest.approx(0.25 - 0.04)
+        # A malformed phases block degrades to the top-line number.
+        with open(tmp_path / "BENCH_reshard.json", "w") as f:
+            json.dump(
+                {"ranged_s": 0.25, "phases": {"plan_s": "x"}}, f
+            )
+        assert CostModel.from_bench(
+            str(tmp_path)
+        ).reshard_s == pytest.approx(0.25)
+
 
 # -- deciding ----------------------------------------------------------------
 

@@ -91,29 +91,112 @@ def test_unchanged_membership_rides_the_fast_path(kv_server):
             s.close()
 
 
-def test_membership_change_takes_the_full_ladder(kv_server):
-    """A dead member changes the membership: the digest no longer matches and
-    the replacement round must re-rank through the full ladder (here: the
-    former spare gets promoted into the active set)."""
-    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b", "c")]
-    pairs = [(n, r) for n, (r, s) in zip(("a", "b", "c"), nodes)]
+def test_departed_active_swaps_in_spare_on_the_fast_path(kv_server):
+    """A departed active whose absence is fully explained (keep-alive gone)
+    no longer forces the full ladder: the shrink fast path backfills the
+    vacated slot from the surviving spare and closes in one CAS + barrier."""
+    names = ("a", "b", "c")
+    nodes = {n: make_rdzv(kv_server.port, n) for n in names}
+    pairs = [(n, nodes[n][0]) for n in names]
+    closed = []
+    try:
+        _place_all(pairs)
+        # Hand-close a spare-bearing round 1 (the shape a simultaneous
+        # restart re-registration produces — a full close races the third
+        # joiner into ``waiting``, so the natural path is timing-dependent)
+        # and seed every survivor's reuse key against it.
+        cur = nodes["a"][1].try_get("state")
+        st1 = {
+            "round": 1, "status": "closed", "seq": cur["seq"] + 1,
+            "participants": {"a": 0, "b": 1, "c": 2}, "waiting": {},
+            "active": ["a", "b"], "spares": ["c"], "epoch": 0,
+            "expected": ["a", "b", "c"],
+        }
+        assert nodes["a"][0]._cas(cur, st1)
+        digest = _membership_digest(["a", "b"], ["c"])
+        for n in names:
+            nodes[n][0]._last_membership = (1, digest)
+        # The rank-0 active departs for good: keep-alive key dropped.
+        nodes["a"][0].leave()
+        nodes["a"][1].close()
+        closed.append("a")
+        nodes["b"][0].request_restart("a died")
+        outs2 = _place_all(
+            [(n, nodes[n][0]) for n in ("b", "c")], prev_round=1
+        )
+        assert {o.round for o in outs2.values()} == {2}
+        # Fast path: surviving active compacts to rank 0, spare backfills.
+        assert all(o.fast for o in outs2.values()), outs2
+        assert outs2["b"].node_rank == 0
+        assert outs2["c"].node_rank == 1
+        assert all(o.spares == [] for o in outs2.values())
+    finally:
+        for n in names:
+            if n not in closed:
+                nodes[n][0].stop_keepalive()
+                nodes[n][1].close()
+
+
+def test_explained_shrink_takes_the_fast_path(kv_server):
+    """A shrink with all survivors live rides the fast-path rounds: the
+    exit-marked member is dropped, survivor ranks compact in order, and no
+    open/join/last-call ladder runs (sub-second, not seconds)."""
+    names = ("a", "b", "c")
+    nodes = [
+        make_rdzv(kv_server.port, n, min_nodes=2, max_nodes=3) for n in names
+    ]
+    pairs = [(n, r) for n, (r, s) in zip(names, nodes)]
     try:
         outs0 = _place_all(pairs)
-        assert outs0["c"].is_spare
-        # "a" dies for good: keep-alive goes stale.
-        nodes[0][0].leave()
-        nodes[0][1].close()
-        time.sleep(2.2)  # past keep_alive_timeout
-        nodes[1][0].request_restart("a died")
-        survivors = pairs[1:]
-        outs1 = _place_all(survivors, prev_round=0)
+        assert {o.node_rank for o in outs0.values()} == {0, 1, 2}
+        # "c" is preempted: clean departure = exit mark + keep-alive drop.
+        nodes[2][0].mark_exited()
+        nodes[2][0].leave()
+        nodes[2][1].close()
+        nodes[0][0].request_restart("c preempted (shrink)")
+        t0 = time.monotonic()
+        outs1 = _place_all(pairs[:2], prev_round=0)
+        elapsed = time.monotonic() - t0
         assert {o.round for o in outs1.values()} == {1}
-        assert not any(o.fast for o in outs1.values()), outs1
-        assert sorted(
-            o.node_rank for o in outs1.values() if o.node_rank is not None
-        ) == [0, 1]
+        assert all(o.fast for o in outs1.values()), outs1
+        assert outs1["a"].node_rank == 0 and outs1["b"].node_rank == 1
+        assert all(o.active == ["a", "b"] for o in outs1.values())
+        # The whole shrink round stays inside the warm-spare envelope —
+        # far under the ladder's last-call + keep-alive grace alone.
+        assert elapsed < 2.0, f"shrink round took {elapsed:.2f}s"
+        # A shrink below min_nodes must NOT fast-close a splinter world:
+        # with "b" also gone, eligibility fails and the ladder owns it.
+        nodes[1][0].mark_exited()
+        nodes[1][0].leave()
+        nodes[1][1].close()
+        state = nodes[0][1].try_get("state")
+        assert state["round"] == 1
+        assert nodes[0][0]._try_fast_reuse(state, 1) is False
     finally:
-        for r, s in nodes[1:]:
+        for r, s in nodes[:1]:
+            r.stop_keepalive()
+            s.close()
+
+
+def test_rejoining_node_clears_its_stale_exit_mark(kv_server):
+    """An exit mark from an earlier life of a node_id must not shrink the
+    live member out of the world: re-entering rendezvous retracts it."""
+    nodes = [make_rdzv(kv_server.port, n) for n in ("a", "b")]
+    pairs = [("a", nodes[0][0]), ("b", nodes[1][0])]
+    try:
+        # Forge a stale exit mark for "b" from a previous incarnation.
+        nodes[1][1].set("exit/b", True)
+        outs0 = _place_all(pairs)
+        assert {o.round for o in outs0.values()} == {0}
+        nodes[0][0].request_restart("worker died")
+        outs1 = _place_all(pairs, prev_round=0)
+        # Both still placed — the mark was cleared on (re)join, so the fast
+        # path reuses the full cast instead of shrinking "b" away.
+        assert {o.node_rank for o in outs1.values()} == {0, 1}
+        assert all(o.fast for o in outs1.values()), outs1
+        assert all(len(o.active) == 2 for o in outs1.values())
+    finally:
+        for r, s in nodes:
             r.stop_keepalive()
             s.close()
 

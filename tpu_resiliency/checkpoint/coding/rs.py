@@ -171,6 +171,74 @@ def reconstruct(
     return out
 
 
+class StreamingEncoder:
+    """Incremental systematic encode: feed the payload in order, read the
+    parity blocks at the end.
+
+    The classic path (:func:`split` + :func:`encode`) pays a payload-sized
+    zero-filled backing copy before the first parity byte is computed, plus
+    GF table passes whose temporaries are block-sized. This encoder removes
+    both: the code is systematic, so data blocks are verbatim byte ranges of
+    the payload (the caller can serve them as views — no backing copy), and
+    parity accumulates window-by-window as the payload streams past, so the
+    transient scratch is O(window), not O(payload). ``update`` is designed to
+    ride the same per-leaf pass the save path's ``Checksummer`` already runs.
+
+    Byte-equivalence with the classic path is exact: the tail zero-padding
+    :func:`split` materializes is absorbing under GF multiply-accumulate
+    (``coeff · 0 = 0``), so never feeding it changes nothing. ``m == 1``
+    keeps the RAID-5 property: the all-ones parity row makes every window
+    pass a pure in-place XOR with zero allocations.
+    """
+
+    def __init__(self, total: int, k: int, m: int, window: int = 1 << 20):
+        if total < 0:
+            raise CheckpointError(f"rs: negative payload size {total}")
+        self.total = int(total)
+        self.k = int(k)
+        self.m = int(m)
+        self.window = max(1, int(window))
+        self.block_len = max(1, (self.total + self.k - 1) // self.k)
+        self.mat = parity_matrix(self.k, self.m)
+        self.parity = [
+            np.zeros(self.block_len, dtype=np.uint8) for _ in range(self.m)
+        ]
+        self._pos = 0
+
+    def update(self, view) -> None:
+        """Accumulate one payload part (any bytes-like) into the parity."""
+        mv = memoryview(view)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        if self._pos + mv.nbytes > self.total:
+            raise CheckpointError(
+                f"rs: streamed {self._pos + mv.nbytes} bytes past the "
+                f"declared total of {self.total}"
+            )
+        off = 0
+        while off < mv.nbytes:
+            pos = self._pos + off
+            blk = pos // self.block_len
+            boff = pos % self.block_len
+            n = min(self.window, mv.nbytes - off, self.block_len - boff)
+            w = np.frombuffer(mv[off : off + n], dtype=np.uint8)
+            for j in range(self.m):
+                _addmul_scalar_vec(
+                    self.parity[j][boff : boff + n], self.mat[j][blk], w
+                )
+            off += n
+        self._pos += mv.nbytes
+
+    def parity_blocks(self) -> list[np.ndarray]:
+        """The ``m`` parity blocks; valid once every declared byte streamed."""
+        if self._pos != self.total:
+            raise CheckpointError(
+                f"rs: parity read after {self._pos} of {self.total} "
+                f"declared payload bytes"
+            )
+        return self.parity
+
+
 def split(buf, k: int) -> tuple[list[np.ndarray], int]:
     """Cut a byte payload into ``k`` equal blocks (tail zero-padded);
     returns ``(blocks, original_length)``. Blocks are views over one backing

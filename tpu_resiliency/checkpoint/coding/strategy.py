@@ -38,6 +38,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from tpu_resiliency.checkpoint import format as ckpt_format
+from tpu_resiliency.checkpoint.coding import delta as ckpt_delta
 from tpu_resiliency.checkpoint.coding import rs
 from tpu_resiliency.checkpoint.replication import (
     CliqueReplicationStrategy,
@@ -68,12 +69,28 @@ def build_block_parts(
     k: int,
     m: int,
     index: int,
-    block: np.ndarray,
+    block,
     orig_len: int,
     container_crc: int,
+    payload_kind: str = "container",
+    base_iteration: Optional[int] = None,
 ) -> list:
-    """One block artifact as send-ready parts (header bytes + block view —
-    no join; concatenated they ARE the on-disk artifact)."""
+    """One block artifact as send-ready parts (header bytes + block views —
+    no join; concatenated they ARE the on-disk artifact).
+
+    ``block`` is one bytes-like (parity) or a sequence of views — a data
+    block served as verbatim byte ranges of the streamed payload, so the
+    systematic half of the code never pays a backing copy. ``payload_kind``
+    records what the coded payload IS (``container`` or a ``delta`` frame,
+    with ``base_iteration`` as the chain hint) so reconstruction runs the
+    right verification; absent in pre-delta artifacts, which read as
+    ``container``."""
+    pieces = list(block) if isinstance(block, (list, tuple)) else [block]
+    crc = 0
+    block_len = 0
+    for p in pieces:
+        crc = ckpt_format.crc32c(p, crc)
+        block_len += memoryview(p).nbytes
     header = {
         "schema": ECB_SCHEMA,
         "owner": int(owner),
@@ -81,14 +98,17 @@ def build_block_parts(
         "k": int(k),
         "m": int(m),
         "index": int(index),
-        "block_len": int(block.nbytes),
+        "block_len": int(block_len),
         "orig_len": int(orig_len),
         "algo": ckpt_format.CRC_ALGO,
-        "crc": ckpt_format.crc32c(block),
+        "crc": crc,
         "container_crc": int(container_crc),
+        "payload": str(payload_kind),
     }
+    if base_iteration is not None:
+        header["base_iteration"] = int(base_iteration)
     hb = pickle.dumps(header, protocol=pickle.HIGHEST_PROTOCOL)
-    return [ECB_MAGIC + _LEN.pack(len(hb)) + hb, block]
+    return [ECB_MAGIC + _LEN.pack(len(hb)) + hb, *pieces]
 
 
 def is_block(buf) -> bool:
@@ -178,6 +198,25 @@ def reconstruct_container(
         have[header["index"]] = np.frombuffer(block, dtype=np.uint8)
     data = rs.reconstruct(k, m, have, want=list(range(k)))
     blob = bytes(rs.join([data[i] for i in range(k)], ref["orig_len"]))
+    if ref.get("payload", "container") == "delta" or ckpt_delta.is_delta(blob):
+        # A delta frame has no container trailer: its generation identity is
+        # a CRC over the whole frame, and verification here is structural
+        # (parse) + that digest. The chained base validation — frame applies
+        # only to the exact base container it names — happens at apply time
+        # in the local manager; a missing/stale base degrades to the agreed
+        # fallback ladder, never to a wrong container.
+        if ckpt_format.crc32c(blob) != ref["container_crc"]:
+            raise CheckpointError(
+                f"{source}: reconstructed delta frame digest mismatch "
+                f"(owner {ref['owner']} iter {ref['iteration']})"
+            )
+        try:
+            ckpt_delta.parse_delta(blob, source=f"{source}-reconstruct")
+        except CheckpointError as e:
+            raise CheckpointError(
+                f"{source}: reconstructed delta frame failed validation ({e})"
+            ) from e
+        return blob
     try:
         ok = ckpt_format.verify_container(
             blob, source=f"{source}(owner={ref['owner']})"
@@ -201,7 +240,9 @@ def reconstruct_container(
 
 def _split_parts(parts: Sequence[Any], k: int) -> tuple[list[np.ndarray], int]:
     """rs.split over a multi-part payload: one padded backing fill, block
-    views over it (the single payload-sized copy erasure encoding costs)."""
+    views over it. Superseded on the hot path by :func:`encode_payload`
+    (which never materializes the payload-sized backing copy); kept as the
+    reference implementation the byte-identity tests compare against."""
     views = []
     total = 0
     for p in parts:
@@ -219,6 +260,78 @@ def _split_parts(parts: Sequence[Any], k: int) -> tuple[list[np.ndarray], int]:
     return [backing[i * block_len : (i + 1) * block_len] for i in range(k)], total
 
 
+def encode_payload(
+    parts: Sequence[Any], k: int, m: int, encoder=None
+) -> tuple[list, int, int, list[np.ndarray]]:
+    """Streaming split+encode over a multi-part payload: ``(views, total,
+    block_len, parity)``.
+
+    Data block ``i`` is the verbatim byte range ``[i·block_len,
+    (i+1)·block_len)`` of the concatenated views (tail zero-padded) —
+    materialize it as views with :func:`data_block_views`; only the parity
+    blocks are new allocations (``m·block_len``, not ``k+m``). When
+    ``encoder`` is a pre-fed :class:`rs.StreamingEncoder` whose geometry and
+    byte count match, its parity is reused — the pipelined save feeds it
+    during the Checksummer pass, making the encode here free; any mismatch
+    (group moved between mint and exchange) falls back to a fresh streaming
+    pass."""
+    views = []
+    total = 0
+    for p in parts:
+        mv = memoryview(p)
+        if mv.ndim != 1 or mv.itemsize != 1:
+            mv = mv.cast("B")
+        views.append(mv)
+        total += mv.nbytes
+    if (
+        encoder is not None
+        and encoder.total == total
+        and encoder.k == k
+        and encoder.m == m
+    ):
+        return views, total, encoder.block_len, encoder.parity_blocks()
+    enc = rs.StreamingEncoder(total, k, m)
+    for mv in views:
+        enc.update(mv)
+    return views, total, enc.block_len, enc.parity_blocks()
+
+
+def data_block_views(
+    views: Sequence[Any], total: int, block_len: int, index: int
+) -> list:
+    """Data block ``index`` as a list of views over the payload parts, plus
+    a zeros tail on the final block — the <k-byte pad ``rs.split`` would
+    have charged a payload-sized backing copy for."""
+    start = index * block_len
+    end = min(start + block_len, total)
+    out = []
+    pos = 0
+    for mv in views:
+        nxt = pos + mv.nbytes
+        if nxt > start and pos < end:
+            out.append(mv[max(start - pos, 0) : min(end - pos, mv.nbytes)])
+        pos = nxt
+    pad = block_len - max(0, end - start)
+    if pad > 0:
+        out.append(np.zeros(pad, dtype=np.uint8))
+    return out
+
+
+def coded_block(
+    views: Sequence[Any],
+    total: int,
+    block_len: int,
+    parity: Sequence[np.ndarray],
+    k: int,
+    index: int,
+):
+    """Coded block ``index``: a data-block view list below ``k``, a parity
+    ndarray at/above — the shape :func:`build_block_parts` accepts either of."""
+    if index < k:
+        return data_block_views(views, total, block_len, index)
+    return parity[index - k]
+
+
 def _container_digest(parts: Sequence[Any]) -> int:
     """The container's trailer digest = the last 4 bytes of the serialized
     container (both trailer versions end with it) — the generation identity
@@ -229,6 +342,23 @@ def _container_digest(parts: Sequence[Any]) -> int:
     if tail.nbytes < 4:
         raise CheckpointError("erasure: container trailer part too short")
     return struct.unpack("<I", tail[-4:])[0]
+
+
+def _payload_meta(parts: Sequence[Any]) -> dict:
+    """Digest + kind + chain hint for the payload a round is about to code:
+    ``{digest, payload_kind[, base_iteration]}``. Containers keep the trailer
+    digest identity; a delta frame (single-part, by construction of the save
+    path) is identified by a CRC over the whole frame since it carries no
+    trailer digest of its own."""
+    if len(parts) == 1 and ckpt_delta.is_delta(parts[0]):
+        header, _ = ckpt_delta.parse_delta(parts[0], source="parity-encode")
+        crc = ckpt_format.crc32c(parts[0])
+        return {
+            "digest": crc,
+            "payload_kind": "delta",
+            "base_iteration": int(header["base_iteration"]),
+        }
+    return {"digest": _container_digest(parts), "payload_kind": "container"}
 
 
 # -- the strategy --------------------------------------------------------------
@@ -278,15 +408,30 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
 
     # -- replicate ---------------------------------------------------------
 
+    def start_encode(self, pending: PendingRound, total: int):
+        """A :class:`rs.StreamingEncoder` sized for this round's payload, or
+        ``None`` when the round is inert. The pipelined save feeds it chunk
+        by chunk alongside the Checksummer so the parity pass of
+        :meth:`exchange_round` is already done when the worker gets there."""
+        if not pending.active:
+            return None
+        group = sorted([self.comm.rank, *pending.peers])
+        k, m = self._code_geometry(group)
+        return rs.StreamingEncoder(total, k, m)
+
     def exchange_round(
-        self, pending: PendingRound, parts: Sequence[Any]
+        self, pending: PendingRound, parts: Sequence[Any], encoder=None
     ) -> dict[int, Any]:
-        """Erasure round: encode this rank's container into coded blocks,
-        ship each peer its positionally-assigned block, receive each peer's
-        assigned block of THEIR container. Returned payloads are block
-        artifacts ``{owner: artifact}`` — the caller persists them like
-        mirrors (the magic routes the filename). Degraded-peer semantics
-        match the mirror strategy exactly."""
+        """Erasure round: encode this rank's payload (container or delta
+        frame) into coded blocks, ship each peer its positionally-assigned
+        block, receive each peer's assigned block of THEIR payload. Returned
+        payloads are block artifacts ``{owner: artifact}`` — the caller
+        persists them like mirrors (the magic routes the filename).
+        Degraded-peer semantics match the mirror strategy exactly.
+
+        Data blocks go on the wire as views over ``parts`` (systematic code,
+        no backing copy); ``encoder``, when pre-fed by the save pipeline,
+        makes the parity pass free here."""
         if not pending.active:
             return {}
         rank = self.comm.rank
@@ -296,9 +441,11 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
             "checkpoint", "ckpt.parity.encode",
             round=pending.round, k=k, m=m,
         ):
-            blocks, orig_len = _split_parts(parts, k)
-            coded = blocks + rs.encode(blocks, m)
-            digest = _container_digest(parts)
+            views, orig_len, block_len, parity = encode_payload(
+                parts, k, m, encoder=encoder
+            )
+            meta = _payload_meta(parts)
+            digest = meta.pop("digest")
         sent = 0
         received: dict[int, Any] = {}
         degraded: set[int] = set()
@@ -308,15 +455,16 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
         with span(
             "checkpoint", "ckpt.replicate.fanout",
             round=pending.round, peers=len(pending.peers),
-            bytes=len(pending.peers) * coded[0].nbytes, erasure=True,
+            bytes=len(pending.peers) * block_len, erasure=True,
         ):
             with cf.ThreadPoolExecutor(max_workers=len(pending.peers)) as pool:
                 futs = {}
                 for peer in pending.peers:
                     idx = self._position(peer, group)
                     art = build_block_parts(
-                        rank, pending.iteration, k, m, idx, coded[idx],
-                        orig_len, digest,
+                        rank, pending.iteration, k, m, idx,
+                        coded_block(views, orig_len, block_len, parity, k, idx),
+                        orig_len, digest, **meta,
                     )
                     sent += sum(memoryview(p).nbytes for p in art)
                     futs[peer] = pool.submit(
@@ -348,7 +496,7 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
         self._mark_degraded(degraded, pending.round)
         record_event(
             "checkpoint", "ckpt_parity",
-            k=k, m=m, round=pending.round, block_bytes=coded[0].nbytes,
+            k=k, m=m, round=pending.round, block_bytes=block_len,
             sent_bytes=sent, sent_blocks=len(pending.peers),
             received=len(received), payload_bytes=orig_len,
         )
@@ -552,15 +700,18 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
             ]
             if targets:
                 parts = [get_blob(rank, it)]
-                blocks, orig_len = _split_parts(parts, k)
-                coded = blocks + rs.encode(blocks, m)
-                digest = _container_digest(parts)
+                views, orig_len, block_len, parity = encode_payload(parts, k, m)
+                meta = _payload_meta(parts)
+                digest = meta.pop("digest")
                 _fan_out([
                     (lambda p=peer, i=self._position(peer, group):
                      self.exchange.send_parts(
                          p, f"{tag}/{rank}",
-                         build_block_parts(rank, it, k, m, i, coded[i],
-                                           orig_len, digest)))
+                         build_block_parts(
+                             rank, it, k, m, i,
+                             coded_block(views, orig_len, block_len, parity,
+                                         k, i),
+                             orig_len, digest, **meta)))
                     for peer in targets
                 ])
         for peer in group:
@@ -603,15 +754,20 @@ class ErasureReplicationStrategy(CliqueReplicationStrategy):
                 ]
                 if rank == primary and dsts:
                     parts = [get_blob(owner, it)]
-                    blocks, orig_len = _split_parts(parts, gk)
-                    coded = blocks + rs.encode(blocks, gm)
-                    digest = _container_digest(parts)
+                    views, orig_len, block_len, parity = encode_payload(
+                        parts, gk, gm
+                    )
+                    meta = _payload_meta(parts)
+                    digest = meta.pop("digest")
                     _fan_out([
                         (lambda p=d, i=self._position(d, grp):
                          self.exchange.send_parts(
                              p, f"{tag}/orph/{owner}",
-                             build_block_parts(owner, it, gk, gm, i, coded[i],
-                                               orig_len, digest)))
+                             build_block_parts(
+                                 owner, it, gk, gm, i,
+                                 coded_block(views, orig_len, block_len,
+                                             parity, gk, i),
+                                 orig_len, digest, **meta)))
                         for d in dsts
                     ])
                 elif rank in dsts:

@@ -690,6 +690,7 @@ class ElasticAgent:
             "launcher", "rendezvous_round", round=outcome.round,
             node_id=cfg.node_id, node_rank=node_rank, world_size=world_size,
             active=list(outcome.active), spares=list(outcome.spares),
+            fast=bool(outcome.fast),
         )
         if (
             self._last_world_size is not None

@@ -246,7 +246,21 @@ class CostModel:
         try:
             with open(os.path.join(bench_dir, "BENCH_reshard.json")) as f:
                 r = json.load(f)
-            if isinstance(r.get("ranged_s"), (int, float)) and r["ranged_s"] > 0:
+            # Prefer the phase decomposition (PR 13): plan + fetch is the
+            # true per-rank resize stall once serve/fetch/assembly overlap —
+            # the top-line ranged_s also charges the local assembly that now
+            # hides under the fetch, so pricing from it overstates elasticity
+            # cost and the controller under-chooses shrink/expand.
+            phases = r.get("phases") or {}
+            plan_s = phases.get("plan_s")
+            fetch_s = phases.get("fetch_s")
+            if (
+                isinstance(plan_s, (int, float))
+                and isinstance(fetch_s, (int, float))
+                and plan_s >= 0 and fetch_s > 0
+            ):
+                kw["reshard_s"] = float(plan_s) + float(fetch_s)
+            elif isinstance(r.get("ranged_s"), (int, float)) and r["ranged_s"] > 0:
                 kw["reshard_s"] = float(r["ranged_s"])
         except (OSError, ValueError):
             pass

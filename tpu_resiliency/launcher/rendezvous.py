@@ -223,6 +223,12 @@ class StoreRendezvous:
         self.start_keepalive()
         try:
             self.store.touch(f"ka/{self.node_id}")
+            # Re-entering rendezvous retracts any previous exit mark: an
+            # ``exit/`` key must mean "left and stayed gone" — the shrink
+            # fast path below treats it as a departure vote, and a stale one
+            # from an earlier life of this node_id would shrink a live member
+            # out of the world.
+            self.store.delete(f"exit/{self.node_id}")
         except StoreError:
             # The store host may be mid-teardown (its job finished while we
             # were between rounds). The keep-alive is advisory; the state read
@@ -468,8 +474,18 @@ class StoreRendezvous:
         - we were placed in exactly ``prev_round`` and ``cur`` IS that round;
         - the membership digest matches our remembered placement (same agents,
           same rank order — the "only locally-promoted ranks changed" case);
-        - no member of the cast is keep-alive-dead, and nobody is waiting for
-          an upscale round (both need the ladder's re-ranking).
+        - nobody is waiting for an upscale round (that needs the ladder's
+          re-ranking);
+        - every missing member of the cast is EXPLAINED: keep-alive-dead or
+          exit-marked. A fully-present cast republishes unchanged (the PR-9
+          worker-restart case). An explained departure set takes the SHRINK
+          fast path: vacated active slots are backfilled from surviving
+          spares in order (the warm-spare swap), any remainder shrinks the
+          world — one CAS plus the confirmation barrier, instead of the full
+          open/join/last-call ladder. An unexplained absence (a survivor that
+          merely stopped answering) cannot occur by construction — absence IS
+          the explanation here — but a departed *us* or an emptied active
+          list degrades to the ladder.
         """
         if not self.s.fast_path or cur["round"] != prev_round:
             return False
@@ -484,38 +500,62 @@ class StoreRendezvous:
             return False
         if cur.get("waiting"):
             return False
+        cast = set(cur["active"]) | set(cur["spares"])
         try:
-            if self.dead_nodes() & (set(cur["active"]) | set(cur["spares"])):
-                return False
+            # Departed = no fresh keep-alive (stale OR deleted — ``leave()``
+            # removes the key outright) or an explicit exit mark. Every cast
+            # member touched ``ka/`` when it was placed, so a missing key is
+            # a departure, never a never-seen node.
+            live = self.live_nodes()
+            exited = {
+                k.rsplit("/", 1)[1] for k in self.store.prefix_get("exit/")
+            }
             epoch = self.restart_epoch()
         except StoreError:
+            return False
+        departed = (cast - live) | (exited & cast)
+        if me in departed:
+            return False
+        survivors_a = [n for n in cur["active"] if n not in departed]
+        survivors_s = [n for n in cur["spares"] if n not in departed]
+        # Warm-spare backfill: surviving spares take vacated active slots in
+        # spare order; what cannot be backfilled is the shrink.
+        vacancies = len(cur["active"]) - len(survivors_a)
+        new_active = survivors_a + survivors_s[:vacancies]
+        new_spares = survivors_s[vacancies:]
+        if not new_active or len(new_active) < self.s.min_nodes:
             return False
         nxt = {
             "round": prev_round + 1,
             "status": "closed",
             "seq": cur["seq"] + 1,
-            "participants": {n: i for i, n in enumerate(cur["active"])},
+            "participants": {n: i for i, n in enumerate(new_active)},
             "waiting": {},
-            "active": list(cur["active"]),
-            "spares": list(cur["spares"]),
+            "active": new_active,
+            "spares": new_spares,
             "epoch": epoch,
             "fast_from": digest,
             # A later full reopen still owes the whole cast its mid-teardown
-            # grace, exactly as a ladder-closed round would.
-            "expected": sorted(set(cur["active"]) | set(cur["spares"])),
+            # grace, exactly as a ladder-closed round would — departed
+            # members excluded (they are gone, not mid-teardown).
+            "expected": sorted(cast - departed),
         }
         try:
             ok = self._cas(cur, nxt)
         except StoreError:
             return False
         if ok:
+            outcome = "shrink" if departed else "reused"
             log.info(
-                f"[{me}] fast-path rendezvous: reused round {prev_round} "
-                f"membership as round {prev_round + 1}"
+                f"[{me}] fast-path rendezvous ({outcome}): round "
+                f"{prev_round} -> {prev_round + 1}, active={new_active} "
+                f"spares={new_spares}"
+                + (f" departed={sorted(departed)}" if departed else "")
             )
             record_event(
-                "rendezvous", "rendezvous_fast_path", outcome="reused",
+                "rendezvous", "rendezvous_fast_path", outcome=outcome,
                 round=prev_round + 1, node_id=me, digest=digest,
+                departed=sorted(departed),
             )
         # CAS failure means the state moved under us (a peer fast-closed the
         # same round, or opened the full ladder) — either way, re-read.

@@ -631,7 +631,7 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
         reg.counter(
             "tpu_rendezvous_fast_path_total",
             "restart fast-path rendezvous attempts by outcome "
-            "(reused | abandoned)",
+            "(reused | shrink | abandoned)",
             outcome=str(rec.get("outcome", "?")),
         ).inc()
     elif kind == "compile_cache":
@@ -815,6 +815,26 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "(local container slice vs peer ranged fetch)",
                 source=str(rec.get("via", "?")),
             ).inc(rec["bytes"])
+    elif kind == "reshard_serve":
+        reg.counter(
+            "tpu_reshard_serve_ranges_total",
+            "byte ranges served to resharding peers, by serve mode (parallel "
+            "= bounded pread/verify worker pool, serial = single range or "
+            "pool disabled)",
+            mode=str(rec.get("mode", "?")),
+        ).inc(rec.get("ranges", 1) or 1)
+    elif kind == "reshard_overlap":
+        reg.counter(
+            "tpu_reshard_parallel_fetches_total",
+            "peer range-fetch batches issued concurrently with local "
+            "pread/assembly during resharded resume",
+        ).inc(rec.get("fetches", 1) or 1)
+        if isinstance(rec.get("duration_s"), (int, float)):
+            reg.histogram(
+                "tpu_reshard_overlap_seconds",
+                "wall time of the overlapped fetch+assembly phase per "
+                "resharded resume",
+            ).observe(rec["duration_s"])
     elif kind == "ckpt_foreground_blocked":
         if isinstance(rec.get("duration_s"), (int, float)):
             reg.histogram(
