@@ -507,6 +507,217 @@ def _tree_gather_worker(spec, proc_id, ranks, world, fanout, lvl_barrier, q):
     q.put((proc_id, lat, max(ops.values()) if ops else 0))
 
 
+def _failover_storm_worker(spec, client_id, ops, q):
+    """One replicated client's slice of the failover storm: the same
+    launcher-shaped mix as :func:`storm_client`, but through a replicating
+    ``ShardedKVClient`` (every write double-writes to the successor). The
+    untimed warmup trips this process's circuit breaker for any dead shard,
+    so the timed ops measure STEADY-STATE failover routing — the transient
+    trip cost is the chaos scenario's business, not this gate's."""
+    from tpu_resiliency.exceptions import StoreError
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+
+    c = ShardedKVClient(
+        parse_endpoints(spec), timeout=30.0, connect_retries=2,
+        retry_budget=0.5, replicate=True,
+    )
+    lat: list[float] = []
+    try:
+        for i in range(24):
+            try:
+                c.set(f"fstorm/c{client_id}/warm{i % 4}", i)
+                c.try_get(f"fstorm/c{client_id}/warm{i % 4}")
+            except StoreError:
+                pass
+        for i in range(ops):
+            kind = i % 8
+            key = f"fstorm/c{client_id}/k{i % 16}"
+            t0 = time.perf_counter()
+            if kind < 3:
+                c.set(key, i)
+            elif kind < 6:
+                c.try_get(key)
+            elif kind == 6:
+                c.add(f"fstorm/c{client_id}/ctr", 1)
+            else:
+                c.touch(f"fstorm/hb/c{client_id}")
+            lat.append(time.perf_counter() - t0)
+    finally:
+        c.close()
+    q.put((client_id, lat))
+
+
+def bench_failover_storm(clients: int = 8, ops_per_client: int = 800,
+                         shards: int = 3) -> dict:
+    """Storm-under-failover: the same replicated storm healthy, then again
+    with one shard SIGKILLed (clients route its keyspace to the successor
+    replica). The committed ``p95_ratio`` is THE degraded-operation
+    acceptance number: failover must cost ≤2× the healthy p95
+    (``tests/platform/test_store_perf.py`` pins it)."""
+    from tpu_resiliency.platform.shardstore import SpawnedClique
+
+    clique = SpawnedClique(shards)
+    ctx = mp.get_context("fork")
+
+    def leg() -> dict:
+        q = ctx.Queue()
+        procs = [
+            ctx.Process(target=_failover_storm_worker,
+                        args=(clique.spec, i, ops_per_client, q))
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for p in procs:
+            p.start()
+        lats: list[float] = []
+        for _ in range(clients):
+            _, lat = q.get(timeout=300)
+            lats.extend(lat)
+        wall = time.perf_counter() - t0
+        for p in procs:
+            p.join(20.0)
+            if p.is_alive():
+                p.terminate()
+        return {
+            "ops": len(lats), "wall_s": round(wall, 3),
+            "ops_per_s": round(len(lats) / wall, 1), **_quantiles(lats),
+        }
+
+    victim = shards // 2
+    try:
+        healthy = leg()
+        clique.procs[victim].kill()
+        time.sleep(0.2)
+        degraded = leg()
+    finally:
+        clique.close()
+    return {
+        "clients": clients, "shards": shards, "victim_shard": victim,
+        "healthy": healthy, "degraded": degraded,
+        "p95_ratio": round(degraded["p95_us"] / healthy["p95_us"], 3)
+        if healthy["p95_us"] else None,
+    }
+
+
+def _flat_join_worker(spec, proc_id, ranks, q):
+    """The flat rendezvous join ladder: every rank CAS-appends itself to the
+    ONE state key — N contended read-modify-writes against a single shard,
+    each carrying the whole O(N) participant list back and forth."""
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+
+    c = ShardedKVClient(parse_endpoints(spec), timeout=60.0)
+    lat: list[float] = []
+
+    def op(fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        lat.append(time.perf_counter() - t0)
+        return out
+
+    try:
+        for rank in ranks:
+            while True:
+                cur = op(c.try_get, "rl/flat/state")
+                nxt = (cur or []) + [rank]
+                ok, _ = op(c.compare_set, "rl/flat/state", cur, nxt)
+                if ok:
+                    break
+                time.sleep(0.001)  # the real ladder's contention backoff
+    finally:
+        c.close()
+    q.put((proc_id, lat))
+
+
+def _scatter_join_worker(spec, proc_id, ranks, world, lvl_barrier, q):
+    """The tree-laddered join: every rank ONE hash-scattered edge write
+    (``treecomm.scatter_register``), then the leader folds the whole
+    registration set with a shard-parallel prefix scan and ONE state write —
+    O(N) ops spread over every shard with O(1) payloads, vs the flat arm's
+    O(N) contended round trips on one shard with O(N) payloads."""
+    from tpu_resiliency.platform import treecomm
+    from tpu_resiliency.platform.shardstore import ShardedKVClient, parse_endpoints
+
+    c = ShardedKVClient(parse_endpoints(spec), timeout=60.0)
+    lat: list[float] = []
+
+    def op(fn, *a):
+        t0 = time.perf_counter()
+        out = fn(*a)
+        lat.append(time.perf_counter() - t0)
+        return out
+
+    try:
+        for rank in ranks:
+            op(treecomm.scatter_register, c, "rl/join", f"n{rank}")
+        lvl_barrier.wait()
+        if proc_id == 0:
+            regs = op(treecomm.scatter_collect, c, "rl/join")
+            assert len(regs) == world, (len(regs), world)
+            op(c.set, "rl/scatter/state",
+               {"round": 0, "parts": len(regs)})
+            op(treecomm.scatter_clear, c, "rl/join")
+        lvl_barrier.wait()
+    finally:
+        c.close()
+    q.put((proc_id, lat))
+
+
+def bench_rendezvous_ladder(world: int = 4096, shards: int = 4,
+                            procs: int = 16) -> dict:
+    """Full rendezvous join round, flat vs tree-laddered, same clique.
+    The committed ``wall_win`` (flat wall / scattered wall) is the
+    acceptance number: the scattered ladder must beat the flat baseline at
+    4096 ranks (``tests/platform/test_store_perf.py`` pins it)."""
+    from tpu_resiliency.platform.shardstore import SpawnedClique
+
+    clique = SpawnedClique(shards)
+    ctx = mp.get_context("fork")
+    nproc = min(procs, world)
+    per, extra = world // nproc, world % nproc
+    slices, lo = [], 0
+    for i in range(nproc):
+        hi = lo + per + (1 if i < extra else 0)
+        slices.append(range(lo, hi))
+        lo = hi
+
+    def run(target, with_barrier: bool) -> tuple[float, list]:
+        q = ctx.Queue()
+        lvl_barrier = ctx.Barrier(nproc)
+        workers = [
+            ctx.Process(
+                target=target,
+                args=(clique.spec, i, slices[i], world, lvl_barrier, q)
+                if with_barrier else (clique.spec, i, slices[i], q),
+            )
+            for i in range(nproc)
+        ]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        lats: list[float] = []
+        for _ in range(nproc):
+            _, lat = q.get(timeout=600)
+            lats.extend(lat)
+        wall = time.perf_counter() - t0
+        for w in workers:
+            w.join(30.0)
+        return wall, lats
+
+    try:
+        flat_wall, flat_lats = run(_flat_join_worker, with_barrier=False)
+        scatter_wall, scatter_lats = run(_scatter_join_worker, with_barrier=True)
+    finally:
+        clique.close()
+    return {
+        "world": world, "shards": shards, "procs": nproc,
+        "flat": {"wall_s": round(flat_wall, 3), "ops": len(flat_lats),
+                 **_quantiles(flat_lats)},
+        "scattered": {"wall_s": round(scatter_wall, 3),
+                      "ops": len(scatter_lats), **_quantiles(scatter_lats)},
+        "wall_win": round(flat_wall / scatter_wall, 2) if scatter_wall else None,
+    }
+
+
 def bench_scale_report(ranks: int, shards: int, procs: int, rounds: int,
                        fanout: int, compare_sizes) -> dict:
     """The full scale leg + the committed baseline replayed side-by-side."""
@@ -522,6 +733,14 @@ def bench_scale_report(ranks: int, shards: int, procs: int, rounds: int,
         "cpus": os.cpu_count(),
         "storm": storm,
         "tree_vs_flat": compare,
+        # Degraded-operation leg: the replicated storm with one shard
+        # SIGKILLed vs healthy. p95_ratio ≤ 2.0 is the committed gate.
+        "failover": bench_failover_storm(shards=shards),
+        # Tree-laddered rendezvous join round vs the flat CAS ladder at the
+        # storm's rank count. wall_win > 1.0 is the committed gate.
+        "rendezvous_ladder": bench_rendezvous_ladder(
+            world=ranks, shards=shards, procs=procs,
+        ),
     }
     base_path = os.path.join(REPO_ROOT, "BENCH_store_baseline.json")
     if os.path.exists(base_path):
@@ -621,6 +840,31 @@ def main(argv=None) -> int:
                 {"bench_store_scale_smoke": "PASS" if scale_ok else "FAIL"}
             ))
             ok = ok and scale_ok
+            # Reduced failover + rendezvous-ladder legs: the HA plumbing end
+            # to end (replicated double-writes, SIGKILL, breaker-routed
+            # successor reads, scattered join fold) with sanity asserts.
+            fo = bench_failover_storm(
+                clients=2, ops_per_client=120, shards=min(args.shards, 3) or 2,
+            )
+            rl = bench_rendezvous_ladder(
+                world=min(args.ranks, 128), shards=args.shards, procs=4,
+            )
+            ha_ok = (
+                fo["healthy"]["p95_us"] > 0
+                and fo["degraded"]["p95_us"] > 0
+                and fo["degraded"]["ops"] == fo["healthy"]["ops"]
+                and rl["flat"]["wall_s"] > 0
+                and rl["scattered"]["wall_s"] > 0
+            )
+            print(json.dumps({
+                "layer": "store-failover-storm",
+                "p95_ratio": fo["p95_ratio"],
+                "ladder_wall_win": rl["wall_win"],
+            }))
+            print(json.dumps(
+                {"bench_store_failover_smoke": "PASS" if ha_ok else "FAIL"}
+            ))
+            ok = ok and ha_ok
         return 0 if ok else 1
 
     if args.ranks:

@@ -262,6 +262,173 @@ def scenario_store_scale(seed: int, world: int = 9, shards: int = 2,
     return plan.schedule(), digest
 
 
+# -- scenario: replicated-clique failover (SIGKILL a shard) ------------------
+
+
+def scenario_store_failover(seed: int, world: int = 4, shards: int = 3,
+                            rounds: int = 6):
+    """SIGKILL one shard of a successor-replicated clique mid-barrier-storm,
+    then again mid-rendezvous; every store guarantee must survive failover.
+
+    Leg 1 (barrier storm): ``world`` replicated clients run ``rounds`` of
+    set + deduped ``add`` + a fresh named barrier per round over a
+    ``shards``-wide :class:`SpawnedClique`. The victim is the shard that
+    OWNS the seed-chosen mid-storm barrier, SIGKILLed by worker 0 right
+    before its own join — the other workers are already parked on the dying
+    primary, so their joins must fail over to the successor's mirrored
+    arrival ledger. Every barrier must still open exactly once per joiner
+    (no double-fires: each client returns from exactly one blocking join),
+    the counter must be EXACT (at-most-once dedup composed with the
+    double-write), and the final keyspace complete via dead-shard
+    absorption on the fan-out read.
+
+    Leg 2 (rendezvous): ``world`` nodes run a store rendezvous over a fresh
+    replicated clique with the seeded victim killed while joins are in
+    flight; all nodes must land in one round with unique contiguous ranks.
+
+    Returns ``(kill_round, victims, counter, kv_digest, rdzv_outcome)`` —
+    all deterministic per seed; the caller runs the scenario twice and
+    compares.
+    """
+    import hashlib
+    import pickle
+    import random
+
+    from tpu_resiliency.launcher.rendezvous import (
+        RendezvousSettings,
+        StoreRendezvous,
+    )
+    from tpu_resiliency.platform import store as store_mod
+    from tpu_resiliency.platform.shardstore import SpawnedClique, shard_of
+    from tpu_resiliency.utils import events as tpu_events
+    from tpu_resiliency.utils.metrics import aggregate
+
+    rng = random.Random(seed)
+    kill_round = rng.randrange(1, rounds - 1)
+    # The victim is the shard the mid-storm barrier hashes to, so the
+    # parked-join failover path is exercised on EVERY seed (which shard that
+    # is still varies with the seeded round choice).
+    victim_storm = shard_of(f"fo/storm-{kill_round}", shards)
+    victim_rdzv = rng.randrange(shards)
+    seen: list = []
+    tpu_events.add_sink(seen.append)
+
+    clique = SpawnedClique(shards)
+    stores: list = []
+    try:
+        def body(w: int):
+            st = clique.client(prefix="fo/", timeout=60.0,
+                               connect_retries=3, retry_budget=1.0,
+                               replicate=True)
+            stores.append(st)
+            for r in range(rounds):
+                st.set(f"w{w}/k{r}", (w, r))
+                st.add("counter", 1)
+                if w == 0 and r == kill_round:
+                    # Give peers time to park on this round's barrier, then
+                    # SIGKILL its owning shard mid-round.
+                    time.sleep(0.3)
+                    clique.procs[victim_storm].kill()
+                st.barrier(f"storm-{r}", w, world, timeout=120.0)
+
+        with cf.ThreadPoolExecutor(max_workers=world) as pool:
+            for f in [pool.submit(body, w) for w in range(world)]:
+                f.result(timeout=240)
+
+        probe = clique.client(prefix="fo/", timeout=60.0,
+                              connect_retries=3, retry_budget=1.0,
+                              replicate=True)
+        stores.append(probe)
+        counter = probe.get("counter", timeout=10.0)
+        assert counter == world * rounds, (
+            f"counter diverged through failover: {counter} != {world * rounds}"
+            f" (a failed-over add double- or under-applied)"
+        )
+        data = probe.prefix_get("")
+        for w in range(world):
+            for r in range(rounds):
+                assert data.get(f"w{w}/k{r}") == (w, r), (
+                    f"key w{w}/k{r} lost through failover: "
+                    f"{data.get(f'w{w}/k{r}')!r}"
+                )
+        kv_digest = hashlib.sha256(
+            pickle.dumps(sorted(
+                (k, v) for k, v in data.items() if k != "counter"
+            ))
+        ).hexdigest()
+        fo = [e for e in seen if e.kind == "store_failover"]
+        assert fo, "SIGKILLed shard produced no store_failover events"
+        outcomes = {e.payload.get("outcome") for e in fo}
+        assert "barrier" in outcomes, (
+            f"parked joins on the dead barrier shard never failed over "
+            f"(outcomes {sorted(outcomes)})"
+        )
+        prom = aggregate(
+            [{"kind": e.kind, **e.payload} for e in seen]
+        ).to_prometheus()
+        assert "tpu_store_failover_total" in prom, prom[:2000]
+    finally:
+        for s in stores:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for h, p in clique.endpoints:
+            store_mod._breaker_clear(h, p)
+        clique.close()
+
+    # -- leg 2: SIGKILL mid-rendezvous --------------------------------------
+    clique2 = SpawnedClique(shards)
+    stores2: list = []
+    outs: dict = {}
+    try:
+        def join(i: int):
+            st = clique2.client(prefix="rdzv/", timeout=60.0,
+                                connect_retries=3, retry_budget=1.0,
+                                replicate=True)
+            stores2.append(st)
+            rdzv = StoreRendezvous(st, f"n{i}", RendezvousSettings(
+                min_nodes=world, max_nodes=world, join_timeout=120.0,
+                last_call_timeout=0.3, keep_alive_interval=0.1,
+                keep_alive_timeout=5.0, poll_interval=0.05,
+            ))
+            outs[f"n{i}"] = rdzv.next_round()
+            rdzv.stop_keepalive()
+
+        threads = [threading.Thread(target=join, args=(i,))
+                   for i in range(world)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        time.sleep(0.1)  # joins in flight
+        clique2.procs[victim_rdzv].kill()
+        for t in threads:
+            t.join(180.0)
+        assert len(outs) == world, (
+            f"rendezvous lost nodes through failover: {sorted(outs)}"
+        )
+        rounds_seen = sorted({o.round for o in outs.values()})
+        assert len(rounds_seen) == 1, f"split-brain rounds: {rounds_seen}"
+        assert not any(o.is_spare for o in outs.values())
+        ranks = sorted(o.node_rank for o in outs.values())
+        assert ranks == list(range(world)), (
+            f"failover broke rank assignment: {ranks}"
+        )
+        rdzv_outcome = (sorted(outs), world, rounds_seen)
+    finally:
+        for s in stores2:
+            try:
+                s.close()
+            except Exception:
+                pass
+        for h, p in clique2.endpoints:
+            store_mod._breaker_clear(h, p)
+        clique2.close()
+        tpu_events.remove_sink(seen.append)
+    return (kill_round, (victim_storm, victim_rdzv), counter, kv_digest,
+            rdzv_outcome)
+
+
 # -- scenario: clique replication -------------------------------------------
 
 #: Send-side faults are retried by the sender and MUST converge; a recv-side
@@ -1917,6 +2084,16 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     assert ss1[1] == ss2[1], "store-scale gathered bytes not reproducible"
     out["store_scale_injections"] = [list(i) for i in ss1[0]]
     out["store_scale_digest"] = ss1[1]
+    # Replicated-clique failover campaign (SIGKILL a shard mid-barrier-storm
+    # and mid-rendezvous), twice per seed: the victims, the deduped counter,
+    # the final keyspace digest and the rendezvous outcome must all reproduce.
+    fo1 = scenario_store_failover(seed)
+    fo2 = scenario_store_failover(seed)
+    assert fo1 == fo2, f"store-failover outcome not reproducible:\n{fo1}\n{fo2}"
+    out["store_failover_kill_round"] = fo1[0]
+    out["store_failover_victims"] = list(fo1[1])
+    out["store_failover_counter"] = fo1[2]
+    out["store_failover_digest"] = fo1[3]
     r1 = scenario_replication(seed, spec=repl_spec)
     r2 = scenario_replication(seed, spec=repl_spec)
     assert r1 == r2, f"replication schedule not reproducible:\n{r1}\n{r2}"

@@ -290,8 +290,20 @@ else
     echo "reshard plan OK: --plan caught the uncovered rank (exit 1 as designed)"
 fi
 
-echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign + elastic chain)"
-python scripts/chaos_soak.py --smoke --workdir "$WORKDIR/chaos"
+echo "== smoke: chaos (seeded fault injection across store/p2p/ipc/disk channels + mixed campaign + elastic chain + store failover)"
+python scripts/chaos_soak.py --smoke --workdir "$WORKDIR/chaos" --out "$WORKDIR/chaos/report.json"
+# The store-failover campaign (SIGKILL a shard mid-barrier-storm and
+# mid-rendezvous) must have run inside the seeded pass and reproduced: exact
+# deduped counter, a keyspace digest, and both victims recorded.
+python - "$WORKDIR/chaos/report.json" <<'PY'
+import json, sys
+run = json.load(open(sys.argv[1]))["runs"][0]
+assert run.get("store_failover_digest"), "store-failover scenario left no keyspace digest"
+assert run.get("store_failover_counter", 0) > 0, run.get("store_failover_counter")
+assert len(run.get("store_failover_victims", [])) == 2, run
+print(f"store-failover chaos OK: kill_round={run['store_failover_kill_round']} "
+      f"victims={run['store_failover_victims']} counter={run['store_failover_counter']}")
+PY
 
 echo "== smoke: incident plane (artifact renders + tpu_incident_*/tpu_remediation_* metrics)"
 MIXED_DIR="$WORKDIR/chaos/mixed_1234"

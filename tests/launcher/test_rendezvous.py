@@ -7,7 +7,9 @@ import pytest
 
 from tpu_resiliency.exceptions import FaultToleranceError
 from tpu_resiliency.launcher.rendezvous import RendezvousSettings, StoreRendezvous
+from tpu_resiliency.platform import treecomm
 from tpu_resiliency.platform.store import CoordStore
+from tpu_resiliency.utils import events as tpu_events
 
 
 def make_rdzv(port, node_id, **kw):
@@ -167,6 +169,78 @@ def test_signals_roundtrip(kv_server):
     assert "n0" not in rdzv.healthy_live_nodes()
     rdzv.stop_keepalive()
     store.close()
+
+
+def test_scattered_join_ladder_above_tree_floor(kv_server, monkeypatch):
+    """Worlds at/above the tree floor join via scattered per-node keys that
+    the leader folds in batches — not per-joiner CAS on the one state key.
+    Same outcome contract as the flat ladder (unique consecutive ranks, one
+    round), plus: a fold event fires and the round's scratch join keys are
+    GC'd at close."""
+    monkeypatch.setenv(treecomm.TREE_MIN_ENV, "3")  # force the tree shape at world 4
+    seen = []
+    tpu_events.add_sink(seen.append)
+    outs = {}
+
+    def join(nid):
+        rdzv, store = make_rdzv(kv_server.port, nid, min_nodes=4, max_nodes=4)
+        try:
+            outs[nid] = rdzv.next_round()
+        finally:
+            rdzv.stop_keepalive()
+            store.close()
+
+    try:
+        threads = [threading.Thread(target=join, args=(f"n{i}",)) for i in range(4)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        for t in threads:
+            t.join(20.0)
+        assert len(outs) == 4
+        assert {o.round for o in outs.values()} == {0}
+        assert not any(o.is_spare for o in outs.values())
+        assert sorted(o.node_rank for o in outs.values()) == [0, 1, 2, 3]
+        folded = [e for e in seen if e.kind == "rendezvous_join_folded"]
+        assert folded, "no fold event — joins went through the flat CAS path"
+        assert sum(e.payload["folded"] for e in folded) == 3  # opener self-seeds
+    finally:
+        tpu_events.remove_sink(seen.append)
+    # Scratch keys for the closed round were cleared by the leader.
+    gc_view = CoordStore("127.0.0.1", kv_server.port, prefix="rdzv/")
+    try:
+        assert gc_view.prefix_get("join/0/") == {}
+    finally:
+        gc_view.close()
+
+
+def test_small_world_keeps_flat_join(kv_server, monkeypatch):
+    """Below the tree floor the ladder must stay byte-identical to the
+    pre-tree shape: no scattered keys, no fold events."""
+    monkeypatch.setenv(treecomm.TREE_MIN_ENV, "17")
+    seen = []
+    tpu_events.add_sink(seen.append)
+    outs = {}
+
+    def join(nid):
+        rdzv, store = make_rdzv(kv_server.port, nid, min_nodes=2, max_nodes=2)
+        try:
+            outs[nid] = rdzv.next_round()
+        finally:
+            rdzv.stop_keepalive()
+            store.close()
+
+    try:
+        threads = [threading.Thread(target=join, args=(f"n{i}",)) for i in range(2)]
+        for t in threads:
+            t.start()
+            time.sleep(0.05)
+        for t in threads:
+            t.join(20.0)
+        assert sorted(o.node_rank for o in outs.values()) == [0, 1]
+        assert not [e for e in seen if e.kind == "rendezvous_join_folded"]
+    finally:
+        tpu_events.remove_sink(seen.append)
 
 
 def test_round_close_detection_is_event_driven(kv_server):

@@ -1,0 +1,310 @@
+"""Successor-replicated clique HA (platform/shardstore.py): every key is
+double-written to its primary and successor shard, reads/mutations/barriers
+fail over once the primary's breaker opens, fan-outs absorb a dead shard via
+its successor's replica keyspace, and the epoch protocol reshards a live
+clique without a caller ever noticing. The 1-shard degeneracy contract:
+replication enabled on a singleton clique must change NOTHING (successor ==
+primary, zero double-writes)."""
+
+import threading
+import time
+
+import pytest
+
+from tpu_resiliency.platform.store import KVClient, KVServer
+from tpu_resiliency.platform.shardstore import (
+    EPOCH_KEY,
+    LocalClique,
+    ShardedKVClient,
+    replicate_from_env,
+    reshard_clique,
+    shard_of,
+    successor_of,
+)
+from tpu_resiliency.utils import events as tpu_events
+from tpu_resiliency.utils.metrics import aggregate
+
+
+@pytest.fixture
+def seen():
+    rec = []
+    tpu_events.add_sink(rec.append)
+    yield rec
+    tpu_events.remove_sink(rec.append)
+
+
+@pytest.fixture
+def clique():
+    c = LocalClique(3)
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def client(clique):
+    c = ShardedKVClient(
+        clique.endpoints, timeout=30.0, connect_retries=2,
+        retry_budget=0.3, replicate=True,
+    )
+    yield c
+    c.close()
+
+
+def key_on(shard: int, n: int, prefix: str = "ha/") -> str:
+    """First key under ``prefix`` whose primary is ``shard``."""
+    i = 0
+    while True:
+        k = f"{prefix}{i}"
+        if shard_of(k, n) == shard:
+            return k
+        i += 1
+
+
+def direct(clique, shard: int) -> KVClient:
+    return KVClient("127.0.0.1", clique.servers[shard].port,
+                    timeout=10.0, connect_retries=2, retry_budget=0.3)
+
+
+def test_successor_math():
+    assert successor_of(0, 3) == 1
+    assert successor_of(2, 3) == 0
+    # Singleton clique: the successor IS the primary — replication degenerates.
+    assert successor_of(0, 1) == 0
+
+
+def test_replicate_env_gate(monkeypatch):
+    monkeypatch.delenv("TPU_RESILIENCY_STORE_REPLICATE", raising=False)
+    assert replicate_from_env() is False
+    monkeypatch.setenv("TPU_RESILIENCY_STORE_REPLICATE", "1")
+    assert replicate_from_env() is True
+    monkeypatch.setenv("TPU_RESILIENCY_STORE_REPLICATE", "off")
+    assert replicate_from_env() is False
+
+
+def test_double_write_lands_on_primary_and_successor(clique, client):
+    k = key_on(0, 3)
+    client.set(k, "v")
+    d0, d1, d2 = (direct(clique, i) for i in range(3))
+    try:
+        assert d0.try_get(k) == "v"      # primary copy
+        assert d1.try_get(k) == "v"      # successor replica
+        assert d2.try_get(k) is None     # nowhere else
+    finally:
+        for d in (d0, d1, d2):
+            d.close()
+
+
+def test_one_shard_clique_degenerates_exactly(seen):
+    """Satellite contract: replication on a 1-shard clique is a no-op —
+    successor == primary, and a set mutates the key ONCE (the mirror branch
+    never runs), byte-identical to a plain client."""
+    single = LocalClique(1)
+    try:
+        repl = ShardedKVClient(single.endpoints, timeout=10.0, replicate=True)
+        plain = ShardedKVClient(single.endpoints, timeout=10.0, replicate=False)
+        try:
+            # The server's version counter is global and bumps once per
+            # mutation: a double-write by the replicated client would land
+            # its key at version 2 and push the plain key to 3.
+            repl.set("deg/replicated", 1)
+            _, v_repl = repl.get_versioned("deg/replicated")
+            assert v_repl == 1, "replicated set mutated the singleton twice"
+            plain.set("deg/plain", 1)
+            _, v_plain = plain.get_versioned("deg/plain")
+            assert v_plain == 2
+            assert not [e for e in seen if e.kind == "store_failover"]
+        finally:
+            repl.close()
+            plain.close()
+    finally:
+        single.close()
+
+
+def test_read_fails_over_to_successor(clique, client, seen):
+    k = key_on(1, 3)
+    client.set(k, 41)
+    clique.servers[1].close()
+    assert client.get(k, timeout=10.0) == 41  # served by shard 2's replica
+    fo = [e for e in seen if e.kind == "store_failover"]
+    assert any(e.payload.get("outcome") == "read" for e in fo), fo
+    prom = aggregate(
+        [{"kind": e.kind, **e.payload} for e in seen]
+    ).to_prometheus()
+    assert "tpu_store_failover_total" in prom
+
+
+def test_failed_over_add_stays_exact(clique, client, seen):
+    """The at-most-once dedup composed with the double-write: a counter
+    keeps exact arithmetic across the failover boundary."""
+    k = key_on(0, 3, prefix="ctr/")
+    for _ in range(3):
+        client.add(k, 1)
+    clique.servers[0].close()
+    for _ in range(2):
+        client.add(k, 1)           # mutate failover onto shard 1
+    assert client.get(k, timeout=10.0) == 5
+    fo = [e for e in seen if e.kind == "store_failover"]
+    assert any(e.payload.get("outcome") == "mutate" for e in fo), fo
+
+
+def test_barrier_fails_over_mid_round(clique, seen):
+    """SIGKILL-shaped loss of a barrier's shard mid-round: the parked joiner
+    and the late joiner both complete on the successor's mirrored arrival
+    ledger, with one release (same generation seen by both)."""
+    name = key_on(2, 3, prefix="bar/")
+    cs = [
+        ShardedKVClient(clique.endpoints, timeout=30.0, connect_retries=2,
+                        retry_budget=0.3, replicate=True)
+        for _ in range(2)
+    ]
+    gens = {}
+    try:
+        t = threading.Thread(
+            target=lambda: gens.__setitem__(
+                0, cs[0].barrier_join(name, 0, 2, 20.0)
+            )
+        )
+        t.start()
+        time.sleep(0.3)            # rank 0 is parked on shard 2
+        clique.servers[2].close()  # the primary dies mid-round
+        gens[1] = cs[1].barrier_join(name, 1, 2, 20.0)
+        t.join(20.0)
+        assert not t.is_alive(), "parked joiner never failed over"
+        assert gens[0] == gens[1] == 1, gens
+        fo = [e for e in seen if e.kind == "store_failover"]
+        assert any(e.payload.get("outcome") == "barrier" for e in fo), fo
+    finally:
+        for c in cs:
+            c.close()
+
+
+def test_fanout_absorbs_dead_shard(clique, client, seen):
+    for i in range(30):
+        client.set(f"fan/{i}", i)
+    clique.servers[1].close()
+    got = client.prefix_get("fan/")
+    assert got == {f"fan/{i}": i for i in range(30)}
+    assert set(client.keys("fan/")) == set(got)
+    fo = [e for e in seen if e.kind == "store_failover"]
+    assert any(e.payload.get("outcome") == "absorbed" for e in fo), fo
+
+
+def test_store_stats_annotates_absorbing_successor(clique, client):
+    client.set("st/one", 1)
+    clique.servers[1].close()
+    try:
+        client.get(key_on(1, 3), timeout=0.5)   # tally at least one failover
+    except Exception:
+        pass
+    doc = client.store_stats()
+    assert doc["shard_map"]["replicate"] is True
+    assert doc["shard_map"]["epoch"] == 0
+    rows = doc["shards"]
+    dead = [r for r in rows if r["backend"] == "unreachable"]
+    assert len(dead) == 1
+    assert dead[0]["absorbed_by"] == rows[2]["endpoint"]
+    assert dead[0]["endpoint"] in rows[2].get("absorbing", [])
+    assert doc.get("failover", {}).get("ops", 0) >= 1
+
+
+def test_merge_stats_docs_ha_accounting():
+    from tpu_resiliency.utils.opstats import merge_stats_docs
+
+    docs = [
+        {"enabled": True, "backend": "epoll", "endpoint": "h:1",
+         "ops": {"set": {"count": 10}}},
+        {"endpoint": "h:2", "error": "unreachable"},          # dead shard
+        {"enabled": True, "backend": "epoll", "endpoint": "h:3",
+         "ops": {"set": {"count": 20}}},
+    ]
+    out = merge_stats_docs(
+        docs,
+        successor_map={0: 1, 1: 2, 2: 0},
+        failover_ops={1: 7},
+    )
+    rows = out["shards"]
+    assert rows[1]["backend"] == "unreachable"
+    assert rows[1]["absorbed_by"] == "h:3"
+    assert rows[2]["absorbing"] == ["h:2"]
+    assert rows[2]["failover_ops"] == 7
+    assert out["failover"] == {"ops": 7, "by_shard": {1: 7}}
+    # Attribution only: absorbed ops never double-sum into served totals.
+    assert rows[2]["ops_total"] == 20
+
+
+def test_reshard_grows_the_clique_live(clique, client, seen):
+    extra = KVServer(host="127.0.0.1", port=0)
+    try:
+        for i in range(20):
+            client.set(f"grow/{i}", i)
+        doc = reshard_clique(client, clique.endpoints + [extra_ep(extra)])
+        assert doc["epoch"] == 1 and doc["prev"] is None
+        assert doc["migrated"] >= 20
+        assert client._epoch == 1 and len(client.endpoints) == 4
+        assert client.prefix_get("grow/") == {f"grow/{i}": i for i in range(20)}
+        # New writes route per the NEW map (primary + successor of 4).
+        k = key_on(3, 4, prefix="grow4/")
+        client.set(k, "post")
+        d = KVClient("127.0.0.1", extra.port, timeout=10.0)
+        try:
+            assert d.try_get(k) == "post"
+        finally:
+            d.close()
+        kinds = [e.payload.get("outcome") for e in seen
+                 if e.kind == "shard_epoch"]
+        assert "migrating" in kinds and "settled" in kinds, kinds
+    finally:
+        extra.close()
+
+
+def test_reshard_replaces_a_dead_shard(clique, client):
+    for i in range(20):
+        client.set(f"repl/{i}", i)
+    clique.servers[1].close()          # dead — its keyspace lives on shard 2
+    replacement = KVServer(host="127.0.0.1", port=0)
+    try:
+        new_eps = [clique.endpoints[0], extra_ep(replacement),
+                   clique.endpoints[2]]
+        doc = reshard_clique(client, new_eps)
+        assert doc["epoch"] == 1
+        assert client.prefix_get("repl/") == {f"repl/{i}": i for i in range(20)}
+        # The replacement serves its slice of the new map.
+        k = key_on(1, 3, prefix="repl2/")
+        client.set(k, "fresh")
+        d = KVClient("127.0.0.1", replacement.port, timeout=10.0)
+        try:
+            assert d.try_get(k) == "fresh"
+        finally:
+            d.close()
+    finally:
+        replacement.close()
+
+
+def test_dual_route_window_covers_both_maps(clique, client):
+    """With ``settle=False`` the transition window stays open: adopted
+    clients dual-route (new-map writes land on the old map too; reads fall
+    back to the old map for unmigrated keys) until a settling pass ends it."""
+    extra = KVServer(host="127.0.0.1", port=0)
+    old_eps = list(clique.endpoints)
+    old_reader = ShardedKVClient(old_eps, timeout=10.0, replicate=True)
+    try:
+        client.set("win/seed", 0)
+        new_eps = old_eps + [extra_ep(extra)]
+        doc = reshard_clique(client, new_eps, settle=False)
+        assert doc["prev"] is not None and client._prev_client is not None
+        # New-map write reaches an old-map-only reader via the write-through.
+        client.set("win/new", 1)
+        assert old_reader.try_get("win/new") == 1
+        # A key born on the OLD map mid-window is found via the read fallback.
+        old_reader.set("win/straggler", 2)
+        assert client.get("win/straggler", timeout=5.0) == 2
+        # Settling (idempotent second pass, same endpoints) ends the window.
+        doc = reshard_clique(client, new_eps)
+        assert doc["prev"] is None and client._prev_client is None
+    finally:
+        old_reader.close()
+        extra.close()
+
+
+def extra_ep(server: KVServer) -> tuple:
+    return ("127.0.0.1", server.port)

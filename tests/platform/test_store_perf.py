@@ -87,3 +87,54 @@ def test_storm_curve_and_server_account():
     set_row = stats["ops"].get("set")
     assert set_row and set_row["handle"]["count"] > 0
     assert set_row["wait"]["count"] > 0
+
+
+def test_failover_storm_holds_within_2x():
+    """Storm-under-failover gate behind BENCH_store_scale.json's failover
+    leg: with one shard SIGKILLed mid-clique, steady-state failover routing
+    (successor reads + dedup'd mutate failover + skipped mirrors) must hold
+    client-observed p95 within 2× of the healthy leg, and every op must
+    still complete (no silent drops). One noise-guard retry, same policy as
+    the other gates."""
+    res = bench_store.bench_failover_storm(clients=4, ops_per_client=600,
+                                           shards=3)
+    if res["p95_ratio"] > 2.0:
+        retry = bench_store.bench_failover_storm(clients=4,
+                                                 ops_per_client=600, shards=3)
+        res = min((res, retry), key=lambda r: r["p95_ratio"])
+    assert res["degraded"]["ops"] == res["healthy"]["ops"], res
+    assert res["p95_ratio"] <= 2.0, (
+        f"degraded p95 {res['degraded']['p95_us']}us vs healthy "
+        f"{res['healthy']['p95_us']}us — failover routing fell off the curve"
+    )
+
+
+def test_rendezvous_ladder_beats_flat():
+    """The tree-laddered full rendezvous round (scattered joins + leader
+    folds) must beat the flat CAS-retry ladder on wall clock at scale — the
+    O(N) flat store-op bill is the thing the ladder exists to kill."""
+    res = bench_store.bench_rendezvous_ladder(world=512, shards=2, procs=8)
+    if res["wall_win"] <= 1.0:
+        res = bench_store.bench_rendezvous_ladder(world=512, shards=2,
+                                                  procs=8)
+    assert res["wall_win"] > 1.0, (
+        f"scattered ladder {res['scattered']['wall_s']}s vs flat "
+        f"{res['flat']['wall_s']}s at world {res['world']}"
+    )
+
+
+def test_committed_bench_has_ha_legs():
+    """The committed BENCH_store_scale.json must carry both PR legs at the
+    gated thresholds: storm-under-failover p95 ≤ 2× healthy, and the
+    4096-rank tree-laddered rendezvous beating the flat baseline."""
+    import json
+
+    path = os.path.join(REPO, "BENCH_store_scale.json")
+    with open(path) as f:
+        doc = json.load(f)
+    fo = doc["failover"]
+    assert fo["degraded"]["ops"] == fo["healthy"]["ops"], fo
+    assert fo["p95_ratio"] <= 2.0, fo
+    rl = doc["rendezvous_ladder"]
+    assert rl["world"] >= 4096, rl
+    assert rl["wall_win"] > 1.0, rl

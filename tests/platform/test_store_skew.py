@@ -18,12 +18,23 @@ import pytest
 
 from tpu_resiliency.platform import framing
 from tpu_resiliency.platform.shardstore import (
+    EPOCH_KEY,
     LocalClique,
     ShardedKVClient,
     connect_store,
     format_endpoints,
+    reshard_clique,
+    shard_of,
 )
-from tpu_resiliency.platform.store import CoordStore, _client_hello
+from tpu_resiliency.platform.store import (
+    CoordStore,
+    KVClient,
+    KVServer,
+    StoreError,
+    StoreTransportError,
+    _client_hello,
+)
+from tpu_resiliency.utils import events as tpu_events
 
 
 class OldWireClient:
@@ -107,6 +118,119 @@ def test_factory_degenerates_without_spec(kv_server, monkeypatch):
         assert st.get("k", timeout=1.0) == 1
     finally:
         st.close()
+
+
+def _key_on(shard: int, n: int, prefix: str) -> str:
+    i = 0
+    while True:
+        k = f"{prefix}{i}"
+        if shard_of(k, n) == shard:
+            return k
+        i += 1
+
+
+def test_lagging_client_adopts_epoch_after_transport_failure():
+    """Epoch-transition skew, happy direction: a client still on the OLD
+    shard map keeps working after the clique resharded out a shard it
+    depends on — its transport exhaustion triggers a one-shot epoch probe,
+    it adopts the new map, and the retried op succeeds against the migrated
+    keyspace."""
+    seen = []
+    tpu_events.add_sink(seen.append)
+    clique = LocalClique(2)
+    replacement = KVServer(host="127.0.0.1", port=0)
+    author = ShardedKVClient(clique.endpoints, timeout=10.0, replicate=True)
+    lagging = ShardedKVClient(clique.endpoints, timeout=10.0,
+                              connect_retries=2, retry_budget=0.3,
+                              replicate=False)
+    try:
+        k = _key_on(1, 2, "mv/")
+        author.set(k, "survives-the-reshard")
+        new_eps = [clique.endpoints[0], ("127.0.0.1", replacement.port)]
+        reshard_clique(author, new_eps)
+        clique.servers[1].close()   # the resharded-out shard goes away
+        # The lagging client (epoch 0) routes k to the dead old shard,
+        # exhausts transport, adopts epoch 1 and retries on the new map.
+        assert lagging.get(k, timeout=10.0) == "survives-the-reshard"
+        assert lagging._epoch == 1
+        assert lagging.endpoints == [tuple(e) for e in new_eps]
+        adopted = [e for e in seen if e.kind == "shard_epoch"
+                   and e.payload.get("outcome") == "adopted"]
+        assert adopted, [e.kind for e in seen]
+    finally:
+        tpu_events.remove_sink(seen.append)
+        lagging.close()
+        author.close()
+        replacement.close()
+        clique.close()
+
+
+def test_lagging_client_dual_routes_inside_open_window():
+    """Epoch-transition skew mid-window: a lagging client that adopts an
+    UNSETTLED epoch must dual-route — new-map writes reach old-map readers
+    via the write-through, and keys born on the old map mid-window are
+    found via the prev-map read fallback."""
+    clique = LocalClique(2)
+    extra = KVServer(host="127.0.0.1", port=0)
+    author = ShardedKVClient(clique.endpoints, timeout=10.0, replicate=True)
+    lagging = ShardedKVClient(clique.endpoints, timeout=10.0, replicate=True)
+    old_reader = ShardedKVClient(clique.endpoints, timeout=10.0,
+                                 replicate=True)
+    try:
+        new_eps = list(clique.endpoints) + [("127.0.0.1", extra.port)]
+        reshard_clique(author, new_eps, settle=False)
+        assert lagging._maybe_adopt_epoch(min_interval=0.0) is True
+        assert lagging._epoch == 1
+        assert lagging._prev_client is not None, \
+            "unsettled adoption must open the dual-route window"
+        lagging.set("skewwin/new", 7)
+        assert old_reader.try_get("skewwin/new") == 7
+        old_reader.set("skewwin/straggler", 8)
+        assert lagging.get("skewwin/straggler", timeout=5.0) == 8
+    finally:
+        old_reader.close()
+        lagging.close()
+        author.close()
+        extra.close()
+        clique.close()
+
+
+def test_malformed_epoch_doc_fails_closed():
+    """Epoch-transition skew, fail-closed direction: when the clique moved
+    to a map this client cannot parse, the adoption probe raises a clear
+    StoreError naming the contract — never a silent wrong-map op."""
+    clique = LocalClique(2)
+    lagging = ShardedKVClient(clique.endpoints, timeout=10.0,
+                              connect_retries=2, retry_budget=0.3,
+                              replicate=False)
+    anchor = KVClient("127.0.0.1", clique.servers[0].port, timeout=10.0)
+    try:
+        # A future-format document the epoch-0 client cannot follow.
+        anchor.set(EPOCH_KEY, {"epoch": "v2-layout", "topology": "ring"})
+        clique.servers[1].close()
+        with pytest.raises(StoreError, match="malformed"):
+            lagging.get(_key_on(1, 2, "mv/"), timeout=5.0)
+    finally:
+        anchor.close()
+        lagging.close()
+        clique.close()
+
+
+def test_absent_epoch_doc_preserves_transport_error():
+    """No epoch document at all: the probe finds nothing and the caller's
+    original transport error surfaces untouched — a plain dead shard is not
+    misreported as a reshard."""
+    clique = LocalClique(2)
+    lagging = ShardedKVClient(clique.endpoints, timeout=10.0,
+                              connect_retries=2, retry_budget=0.3,
+                              replicate=False)
+    try:
+        clique.servers[1].close()
+        with pytest.raises(StoreTransportError):
+            lagging.get(_key_on(1, 2, "mv/"), timeout=5.0)
+    finally:
+        lagging.close()
+        clique.close()
 
 
 def test_old_wire_client_against_a_clique_shard():

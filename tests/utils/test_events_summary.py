@@ -470,3 +470,32 @@ def test_alert_transitions_render_and_count(tmp_path):
     assert "rule=goodput_burn sev=page FIRING" in text  # detail optional
     assert "watchtower alerts fired: 2" in text
     assert "watchtower alerts resolved: 1" in text
+
+
+def test_store_ha_events_render_and_count(tmp_path):
+    path = str(tmp_path / "ev.jsonl")
+    _write_events(
+        path,
+        [
+            (0.0, "store", "store_failover",
+             {"shard": 1, "op": "get", "outcome": "read",
+              "endpoint": "10.0.0.2:7777", "successor": 2}),
+            (0.5, "store", "store_failover",
+             {"shard": 1, "op": "barrier", "outcome": "barrier",
+              "endpoint": "10.0.0.2:7777", "successor": 2}),
+            (2.0, "store", "shard_epoch",
+             {"epoch": 3, "nshards": 4, "outcome": "migrating"}),
+            (4.0, "store", "shard_epoch",
+             {"epoch": 3, "nshards": 4, "outcome": "settled",
+              "migrated": 120}),
+        ],
+    )
+    out = io.StringIO()
+    events_summary.summarize(events_summary.read_events(path), out=out)
+    text = out.getvalue()
+    assert "shard 1 (10.0.0.2:7777) get: read → successor shard 2" in text
+    assert "shard 1 (10.0.0.2:7777) barrier: barrier → successor shard 2" in text
+    assert "epoch 3 (4 shards): migrating" in text
+    assert "epoch 3 (4 shards): settled, 120 keys migrated" in text
+    assert "store shard failovers: 2" in text
+    assert "store shard-map epoch transitions: 2" in text

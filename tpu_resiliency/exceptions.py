@@ -23,6 +23,16 @@ class StoreTransportError(StoreError):
     failure (an error *response* — retrying would repeat the same answer)."""
 
 
+class StoreShutdownError(StoreTransportError):
+    """The server announced teardown while this op was parked: it did not
+    complete and the endpoint is going away.
+
+    A transport-class failure (HA clique clients fail it over to the
+    successor shard exactly like a SIGKILL'd shard) — but definitive, so the
+    retry layer fails fast instead of burning its budget reconnecting to a
+    server that just said goodbye."""
+
+
 class StoreTimeoutError(StoreError, TimeoutError):
     """A blocking store operation (get/wait/barrier) timed out."""
 

@@ -86,6 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
         "keeps today's single in-process server",
     )
     p.add_argument(
+        "--store-replicate", action="store_true",
+        help="HA clique: every key is written to its home shard AND the "
+        "successor shard ((h+1) %% N), so a SIGKILL'd shard's keyspace — "
+        "barriers included — stays servable from the successor while the "
+        "clique is degraded; clients fail over automatically once the "
+        "shard's circuit breaker opens. Descendants inherit via "
+        "$TPU_RESILIENCY_STORE_REPLICATE. No effect with --store-shards 1 "
+        "(successor == primary: the degenerate clique replicates nothing)",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node convenience: host the store on an ephemeral local port "
@@ -338,7 +348,8 @@ def endpoint_is_local(host: str) -> bool:
 
 
 def host_or_connect_store(
-    endpoint: str, rdzv_id: str = "default", store_shards: int = 1
+    endpoint: str, rdzv_id: str = "default", store_shards: int = 1,
+    store_replicate: bool = False,
 ):
     """Bind the KVServer on the endpoint port when the endpoint IS this machine and
     the port is free; otherwise connect as a client.
@@ -360,6 +371,7 @@ def host_or_connect_store(
     from tpu_resiliency.exceptions import StoreError
     from tpu_resiliency.platform.shardstore import (
         CLIQUE_KEY,
+        REPLICATE_ENV,
         SHARDS_ENV,
         SpawnedClique,
         connect_store,
@@ -435,6 +447,11 @@ def host_or_connect_store(
         # Every process we spawn (agents are in-process, workers/monitors
         # inherit the environment) must route through the same shard map.
         os.environ[SHARDS_ENV] = clique_spec
+    if store_replicate and clique_spec:
+        # Successor replication is a CLIENT-side discipline: descendants must
+        # all double-write or the replica keyspace develops holes, so the
+        # flag rides the environment the same way the shard spec does.
+        os.environ[REPLICATE_ENV] = "1"
     # rdzv_id namespaces every launcher key: two jobs sharing one store server
     # never see each other's rendezvous/agent state (reference --rdzv-id).
     prefix = STORE_PREFIX + (f"{rdzv_id}/" if rdzv_id != "default" else "")
@@ -544,6 +561,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     store, server, store_host, store_port = host_or_connect_store(
         args.rdzv_endpoint, rdzv_id=args.rdzv_id,
         store_shards=max(1, args.store_shards),
+        store_replicate=bool(args.store_replicate),
     )
     # Cross-job registry OUTSIDE any rdzv-id namespace: which jobs are on this
     # endpoint. Powers the hosted-store teardown warning (a job-hosted server

@@ -23,6 +23,7 @@ import time
 from typing import Callable, Optional
 
 from tpu_resiliency.exceptions import BarrierTimeout, FaultToleranceError, StoreError
+from tpu_resiliency.platform import treecomm
 from tpu_resiliency.platform.store import CoordStore, StoreView
 from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
@@ -108,6 +109,9 @@ class StoreRendezvous:
         #: in — the fast path's reuse key: a replacement round may ride the
         #: single-CAS path only against exactly this membership
         self._last_membership: Optional[tuple[int, str]] = None
+        #: round number we last published a scattered join registration for
+        #: (tree-laddered join — one idempotent set per round, never repeated)
+        self._scatter_round = -1
 
     # -- keep-alive --------------------------------------------------------
 
@@ -378,15 +382,40 @@ class StoreRendezvous:
                 )
             # Case 3: an open round.
             parts = cur["participants"]
+            scatter = self._scatter_join_enabled()
             if me not in parts:
-                nxt = dict(cur)
-                nxt["participants"] = dict(parts)
-                nxt["participants"][me] = nxt["seq"]
-                nxt["seq"] += 1
-                self._cas(cur, nxt)
-                continue
+                if scatter:
+                    # Tree-laddered join (the treecomm edge shape lifted onto
+                    # the ladder): one idempotent ``set`` on a per-node key —
+                    # hash-scattered across clique shards — instead of a CAS
+                    # retry storm where every joiner read-modify-writes the
+                    # ONE state key through one event loop. The leader folds
+                    # registrations into ``participants`` in batches below;
+                    # we park on the state key until a fold lands us.
+                    if self._scatter_round != cur["round"]:
+                        try:
+                            treecomm.scatter_register(
+                                self.store, f"join/{cur['round']}", me
+                            )
+                            self._scatter_round = cur["round"]
+                        except StoreError:
+                            pass
+                else:
+                    nxt = dict(cur)
+                    nxt["participants"] = dict(parts)
+                    nxt["participants"][me] = nxt["seq"]
+                    nxt["seq"] += 1
+                    self._cas(cur, nxt)
+                    continue
             dead = self.dead_nodes()
             live_parts = {n: s for n, s in parts.items() if n == me or n not in dead}
+            if scatter and live_parts and min(live_parts, key=live_parts.get) == me:
+                # Aggregator duty rides leadership (lowest join seq): fold
+                # every scattered registration in one batched CAS. A fold
+                # mutates state, so every parked joiner wakes into its
+                # membership at once — O(N/batch) CASes for the whole world.
+                if self._fold_scattered_joins(cur, dead):
+                    continue
             if len(live_parts) >= self.s.min_nodes:
                 if min_reached_at is None:
                     min_reached_at = time.monotonic()
@@ -449,6 +478,17 @@ class StoreRendezvous:
                             round=cur["round"], node_id=me, waited_s=waited,
                             active=active, spares=spares, full=full,
                         )
+                        if scatter:
+                            # GC the round's scattered join keys. A joiner
+                            # whose registration raced the close re-reads
+                            # closed state and lands in ``waiting`` — the
+                            # same late-arrival semantics as a lost CAS.
+                            try:
+                                treecomm.scatter_clear(
+                                    self.store, f"join/{cur['round']}"
+                                )
+                            except StoreError:
+                                pass
                     continue
             # Event-driven: any peer's CAS on the round state wakes us at once
             # (a follower learns of the leader's close in ~ms instead of up to
@@ -462,6 +502,45 @@ class StoreRendezvous:
             f"rendezvous did not complete within {self.s.join_timeout}s "
             f"(node {me}, waiting for round > {prev_round})"
         )
+
+    # -- tree-laddered join (scatter/fold) ----------------------------------
+
+    def _scatter_join_enabled(self) -> bool:
+        """Worlds at or above the tree floor join by scattered edge keys +
+        leader folds; smaller worlds keep the flat per-node CAS (one op per
+        joiner is already optimal there, and it's the shape every pre-tree
+        test pins)."""
+        tree_min = int(
+            os.environ.get(treecomm.TREE_MIN_ENV, treecomm.DEFAULT_TREE_MIN)
+        )
+        return self.s.max_nodes >= tree_min
+
+    def _fold_scattered_joins(self, cur: dict, dead: set[str]) -> bool:
+        """Leader/aggregator half of the tree-laddered join: collect the
+        round's scattered registrations (concurrent prefix scan — fans
+        across clique shards) and CAS the whole batch into ``participants``
+        with consecutive join seqs (sorted by node id within a batch —
+        deterministic given membership). True ⇒ a fold CAS was attempted and
+        the caller must re-read state before acting on it."""
+        try:
+            regs = treecomm.scatter_collect(self.store, f"join/{cur['round']}")
+        except StoreError:
+            return False
+        parts = cur["participants"]
+        new = sorted(n for n in regs if n not in parts and n not in dead)
+        if not new:
+            return False
+        nxt = dict(cur)
+        nxt["participants"] = dict(parts)
+        for n in new:
+            nxt["participants"][n] = nxt["seq"]
+            nxt["seq"] += 1
+        self._cas(cur, nxt)
+        record_event(
+            "rendezvous", "rendezvous_join_folded", round=cur["round"],
+            node_id=self.node_id, folded=len(new),
+        )
+        return True
 
     # -- restart fast path (round reuse) -----------------------------------
 

@@ -37,6 +37,37 @@ Discovery: the launcher exports ``$TPU_RESILIENCY_STORE_SHARDS`` as a
 comma-separated ``host:port`` list (shard order IS the hash order — every
 client must see the identical list); :func:`connect_store` honors it and
 falls back to the classic single-endpoint env pair.
+
+**HA (successor replication).** With ``replicate=True`` (launcher
+``--store-replicate`` → ``$TPU_RESILIENCY_STORE_REPLICATE``) every key is
+written to its primary shard ``h = crc32(key) % N`` *and* to the successor
+``(h + 1) % N``. The double-write is safe precisely because of the existing
+machinery: idempotent ops replay harmlessly, and non-idempotent ops
+(``add``, ``barrier``) ride each shard's own req_id dedup LRU — the replica
+copy is an independent dedup'd call, not a replayed frame. On shard
+transport failure (retry budget exhausted → circuit breaker open) the
+client fails over reads, watch-parks, barriers, and dedup'd mutations to
+the successor, emitting ``store_failover`` events →
+``tpu_store_failover_total{shard,outcome}``. Barrier arrivals are mirrored
+(a non-blocking replica join precedes every primary join), so a shard
+SIGKILLed mid-round strands nobody: stragglers fail over and the
+successor's mirrored count releases the round exactly once per joiner.
+A 1-shard clique with replication enabled degenerates exactly: successor ==
+primary, so every mirror branch is skipped (zero double-writes).
+
+**Live resharding (epoch protocol).** A clique changes size — or replaces a
+dead shard with a fresh ``KVServer`` — through an epoch'd shard map CAS'd
+under the raw :data:`EPOCH_KEY` on shard 0 (mirrored to its successor and
+to the new map's shard 0). :func:`reshard_clique` bumps the epoch with
+``prev`` set (the dual-route window), migrates the value keyspace by
+concurrent prefix scan, then settles (``prev: None``). Clients never poll:
+they probe the epoch key only when an op exhausts both primary and
+successor, adopt any newer map, and retry once. During the window writes go
+to the new map *and* write-through to the old primary, reads fall back to
+the old map on a miss, and barriers stay on the old map — so old-map and
+new-map clients interoperate until settle. A client that cannot find a
+usable newer map fails closed with the original transport error (or a
+descriptive :class:`StoreError` when the epoch document is malformed).
 """
 
 from __future__ import annotations
@@ -49,19 +80,30 @@ import time
 import zlib
 from typing import Any, Iterable, Optional
 
-from tpu_resiliency.exceptions import StoreError
+from tpu_resiliency.exceptions import (
+    BarrierOverflow,
+    BarrierTimeout,
+    StoreError,
+    StoreTransportError,
+)
 from tpu_resiliency.platform.store import (
     AUTH_KEY_ENV,
     KVClient,
     KVServer,
     StoreView,
+    breaker_open,
     store_answers,
 )
+from tpu_resiliency.utils.events import record as record_event
 from tpu_resiliency.utils.logging import get_logger
 
 log = get_logger(__name__)
 
 SHARDS_ENV = "TPU_RESILIENCY_STORE_SHARDS"
+
+#: "1"/"true"/"on" turns on successor replication for every clique client
+#: built from the environment (the launcher's ``--store-replicate`` export).
+REPLICATE_ENV = "TPU_RESILIENCY_STORE_REPLICATE"
 
 #: Reserved raw key on shard 0 where a clique's spawner publishes the full
 #: endpoint list. A client handed only the classic ``host:port`` endpoint
@@ -69,6 +111,11 @@ SHARDS_ENV = "TPU_RESILIENCY_STORE_SHARDS"
 #: reconnects as a sharded client — late joiners cannot split the keyspace
 #: by talking to shard 0 alone.
 CLIQUE_KEY = "store-clique/endpoints"
+
+#: Reserved raw key carrying the CAS'd epoch'd shard map (live resharding).
+#: Anchored on the *old* map's shard 0, mirrored to its successor and to the
+#: new map's shard 0 — reachable from either side of a transition.
+EPOCH_KEY = "store-clique/epoch"
 
 #: keyspace-hash identity carried in every aggregated stats doc — a client
 #: and a doc reader disagreeing on the hash would mis-attribute per-shard load
@@ -82,6 +129,19 @@ def shard_of(key: str, nshards: int) -> int:
     if nshards <= 1:
         return 0
     return zlib.crc32(key.encode("utf-8", "surrogatepass")) % nshards
+
+
+def successor_of(shard: int, nshards: int) -> int:
+    """The replica shard for a key whose primary is ``shard`` — the next
+    shard on the hash ring. Degenerates to the primary itself at N=1, which
+    is what makes 1-shard replication an exact no-op."""
+    if nshards <= 1:
+        return 0
+    return (shard + 1) % nshards
+
+
+def replicate_from_env() -> bool:
+    return os.environ.get(REPLICATE_ENV, "").strip().lower() in ("1", "true", "on")
 
 
 def parse_endpoints(spec: str) -> list[tuple[str, int]]:
@@ -124,6 +184,7 @@ class ShardedKVClient:
         connect_retries: int = 60,
         auth_key: str | None = None,
         retry_budget: float = 8.0,
+        replicate: bool | None = None,
     ):
         if not endpoints:
             raise ValueError("ShardedKVClient needs at least one endpoint")
@@ -131,6 +192,26 @@ class ShardedKVClient:
         self.default_timeout = timeout
         self._connect_retries = connect_retries
         self._retry_budget = retry_budget
+        #: successor replication (module doc): None defers to the launcher's
+        #: $TPU_RESILIENCY_STORE_REPLICATE export.
+        self._replicate = replicate_from_env() if replicate is None else bool(replicate)
+        # HA bookkeeping: client-side failover tallies per failed shard
+        # (folded into store_stats → merge_stats_docs so degraded-mode ops
+        # land under the successor's row instead of vanishing), and the last
+        # released generation per barrier name (the failover join's "already
+        # released on the replica?" baseline).
+        self._ha_lock = threading.Lock()
+        self._failover_counts: dict[int, dict[str, int]] = {}
+        self._barrier_gen: dict[str, int] = {}
+        # Epoch'd shard map (live resharding): adopted lazily — probed only
+        # when an op exhausts both primary and successor, never on a timer.
+        self._epoch = 0
+        self._epoch_checked_at = 0.0
+        self._prev_client: Optional["ShardedKVClient"] = None
+        # Set on clients built to speak a PREVIOUS map (dual-route window):
+        # they must never adopt epochs themselves, or a write-through whose
+        # old-map shard is dead chains prev→prev→prev adoption without bound.
+        self._epoch_frozen = False
         # Per-shard clients are built LAZILY on first use: a clique client
         # must stay constructible while one shard is down (diagnostics
         # against a degraded clique, ops that never touch the dead shard).
@@ -173,6 +254,230 @@ class ShardedKVClient:
 
     def _live_shards(self) -> list[KVClient]:
         return [self._shard(i) for i in range(len(self.endpoints))]
+
+    # -- HA routing (successor replication + failover) ---------------------
+
+    def _route(self, key: str) -> tuple[int, int]:
+        """(primary, successor) shard indices for ``key``. Successor equals
+        primary when replication is off or the clique has one shard — every
+        mirror/failover branch below keys off that equality."""
+        n = len(self._shards)
+        p = shard_of(key, n)
+        if not self._replicate:
+            return p, p
+        return p, successor_of(p, n)
+
+    def _emit_failover(self, shard: int, op: str, outcome: str) -> None:
+        with self._ha_lock:
+            per = self._failover_counts.setdefault(shard, {})
+            per[outcome] = per.get(outcome, 0) + 1
+        try:
+            h, p = self.endpoints[shard]
+            record_event(
+                "store", "store_failover", shard=shard, op=op,
+                outcome=outcome, endpoint=f"{h}:{p}",
+                successor=successor_of(shard, len(self._shards)),
+            )
+        except Exception:
+            pass
+
+    def _breaker_tripped(self, shard: int) -> bool:
+        h, p = self.endpoints[shard]
+        return breaker_open(h, p)
+
+    def _ha_read(self, key: str, op: str, fn):
+        """Run ``fn(shard_client)`` against the key's primary, failing over
+        to the successor replica on transport failure (or straight to it when
+        the primary's breaker is already open). On total exhaustion, probe
+        for a newer clique epoch once and retry on the adopted map."""
+        for attempt in (0, 1):
+            p, s = self._route(key)
+            if s != p and self._breaker_tripped(p) and not self._breaker_tripped(s):
+                self._emit_failover(p, op, "read")
+                return fn(self._shard(s))
+            try:
+                return fn(self._shard(p))
+            except StoreTransportError:
+                if s != p:
+                    self._emit_failover(p, op, "read")
+                    try:
+                        return fn(self._shard(s))
+                    except StoreTransportError:
+                        pass
+                if attempt == 0 and self._maybe_adopt_epoch():
+                    continue
+                raise
+
+    def _ha_write(self, key: str, op: str, fn):
+        """Apply ``fn`` to the key's primary (successor failover on transport
+        failure) and mirror it to the successor replica. ``fn`` runs as a
+        fresh call per shard, so non-idempotent ops (``add``) get their own
+        req_id against each shard's dedup LRU — the mirror is an independent
+        dedup'd call, never a replayed frame."""
+        for attempt in (0, 1):
+            p, s = self._route(key)
+            primary_dead = s != p and self._breaker_tripped(p) and not self._breaker_tripped(s)
+            if not primary_dead:
+                try:
+                    r = fn(self._shard(p))
+                except StoreTransportError:
+                    primary_dead = s != p
+                    if not primary_dead:
+                        if attempt == 0 and self._maybe_adopt_epoch():
+                            continue
+                        raise
+            if primary_dead:
+                # The successor copy IS the write now; the primary picks the
+                # key back up at the next epoch transition (reshard/replace).
+                self._emit_failover(p, op, "mutate")
+                try:
+                    r = fn(self._shard(s))
+                except StoreTransportError:
+                    if attempt == 0 and self._maybe_adopt_epoch():
+                        continue
+                    raise
+                self._write_through_prev(op, fn)
+                return r
+            if s != p:
+                if self._breaker_tripped(s):
+                    # Dead successor: skip the mirror outright instead of
+                    # paying the retry ladder on every write until the
+                    # breaker's next half-open probe.
+                    self._emit_failover(s, op, "replica_skipped")
+                else:
+                    try:
+                        fn(self._shard(s))
+                    except StoreError:
+                        # Replica temporarily behind: degrade the mirror,
+                        # never the caller's (primary-acknowledged) write.
+                        self._emit_failover(s, op, "replica_skipped")
+            self._write_through_prev(op, fn)
+            return r
+
+    def _write_through_prev(self, op: str, fn) -> None:
+        """Dual-route window (mid-reshard): a new-map write also lands on the
+        previous map so pre-epoch clients keep reading fresh values until the
+        transition settles. Contained — the old map may be half torn down."""
+        prev = self._prev_client
+        if prev is None:
+            return
+        try:
+            fn(prev)
+        except StoreError:
+            pass
+
+    def _prev_try_get(self, key: str, sentinel):
+        """Dual-route read fallback: a key not yet migrated to the new map is
+        still live on the previous one."""
+        prev = self._prev_client
+        if prev is None:
+            return sentinel
+        try:
+            return prev.try_get(key, sentinel)
+        except StoreError:
+            return sentinel
+
+    # -- epoch'd shard map (live resharding) -------------------------------
+
+    def _epoch_anchors(self) -> list[int]:
+        """Shard indices the epoch document is probed on: shard 0 and (when
+        replicating) its successor — the two places a transition's author
+        anchored it relative to *this* client's map."""
+        n = len(self._shards)
+        return [0] if (n == 1 or not self._replicate) else [0, successor_of(0, n)]
+
+    def _read_epoch_doc(self) -> Optional[dict]:
+        for i in self._epoch_anchors():
+            try:
+                doc = self._shard(i).try_get(EPOCH_KEY)
+            except StoreError:
+                continue
+            if doc is not None:
+                return doc
+        return None
+
+    def _maybe_adopt_epoch(self, min_interval: float = 1.0) -> bool:
+        """Probe for a newer clique epoch and adopt it: rebuild the shard
+        list, hold the previous map for dual-routing while the transition is
+        unsettled (``prev`` present), drop it once settled. Called only from
+        transport-failure exhaustion paths (rate-limited), so the healthy
+        path never pays an epoch round trip. True ⇒ the caller should
+        re-resolve routing and retry its op once.
+
+        Fail-closed contract: a *malformed* epoch document (the clique moved
+        to a map this client cannot parse) raises a descriptive
+        :class:`StoreError`; an absent/unreachable document returns False and
+        the caller re-raises its original transport error."""
+        if self._epoch_frozen:
+            return False
+        now = time.monotonic()
+        with self._ha_lock:
+            if now - self._epoch_checked_at < min_interval:
+                return False
+            self._epoch_checked_at = now
+        doc = self._read_epoch_doc()
+        if doc is None:
+            return False
+        if not isinstance(doc, dict) or not isinstance(doc.get("epoch"), int) \
+                or not doc.get("endpoints"):
+            raise StoreError(
+                f"clique epoch document under {EPOCH_KEY!r} is malformed "
+                f"({doc!r}): the clique resharded to a map this client "
+                f"cannot follow — reconnect via the launcher's current "
+                f"shard spec"
+            )
+        settled = not doc.get("prev")
+        with self._ha_lock:
+            if doc["epoch"] < self._epoch or (
+                doc["epoch"] == self._epoch
+                and not (settled and self._prev_client is not None)
+            ):
+                return False
+            new_eps = [tuple(e) for e in doc["endpoints"]]
+            changed = new_eps != self.endpoints
+            old_shards, old_pool = [], None
+            if changed:
+                old_shards, self._shards = self._shards, [None] * len(new_eps)
+                old_pool, self._fan_pool = self._fan_pool, None
+                old_prev, self._prev_client = self._prev_client, None
+                self.endpoints = new_eps
+                self.host, self.port = self.endpoints[0]
+                if not settled:
+                    # Dual-route window: keep one plain (non-replicating)
+                    # client on the previous map for fallbacks/write-through.
+                    self._prev_client = ShardedKVClient(
+                        [tuple(e) for e in doc["prev"]],
+                        timeout=self.default_timeout,
+                        connect_retries=1, auth_key=self.auth_key,
+                        retry_budget=0.0, replicate=False,
+                    )
+                    self._prev_client._epoch_frozen = True
+            elif settled and self._prev_client is not None:
+                old_prev, self._prev_client = self._prev_client, None
+            else:
+                old_prev = None
+            self._epoch = doc["epoch"]
+            if "replicate" in doc:
+                self._replicate = bool(doc["replicate"])
+        for c in [*old_shards, old_prev]:
+            if c is not None:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+        if old_pool is not None:
+            old_pool.shutdown(wait=False)
+        record_event(
+            "store", "shard_epoch", epoch=doc["epoch"],
+            nshards=len(doc["endpoints"]),
+            outcome="adopted" if changed else "settled",
+        )
+        log.info(
+            f"adopted clique epoch {doc['epoch']}: "
+            f"{format_endpoints(self.endpoints)}"
+            + ("" if settled else " (dual-route window)")
+        )
+        return True
 
     def _fan_out(self, fn, contain: bool = False) -> list:
         """Run ``fn(shard_client)`` on every shard concurrently; results in
@@ -226,9 +531,10 @@ class ShardedKVClient:
             self._closed = True
             shards, self._shards = self._shards, [None] * len(self.endpoints)
             pool, self._fan_pool = self._fan_pool, None
+        prev, self._prev_client = self._prev_client, None
         if pool is not None:
             pool.shutdown(wait=False)
-        for s in shards:
+        for s in [*shards, prev]:
             if s is None:
                 continue
             try:
@@ -236,51 +542,113 @@ class ShardedKVClient:
             except Exception:
                 pass
 
-    # -- keyed ops (route by hash) ----------------------------------------
+    # -- keyed ops (route by hash; replicated + failover per module doc) ---
+
+    _MISS = object()  # dual-route miss sentinel
 
     def set(self, key: str, value: Any) -> None:
-        self._for(key).set(key, value)
+        self._ha_write(key, "set", lambda s: s.set(key, value))
 
     def get(self, key: str, timeout: float | None = None) -> Any:
-        return self._for(key).get(key, timeout)
+        if self._prev_client is not None:
+            # Dual-route window: a not-yet-migrated key would park the
+            # blocking get on the new map while its value sits on the old.
+            v = self._ha_read(
+                key, "get", lambda s: s.try_get(key, self._MISS)
+            )
+            if v is self._MISS:
+                v = self._prev_try_get(key, self._MISS)
+            if v is not self._MISS:
+                return v
+        return self._ha_read(key, "get", lambda s: s.get(key, timeout))
 
     def try_get(self, key: str, default: Any = None) -> Any:
-        return self._for(key).try_get(key, default)
+        v = self._ha_read(key, "try_get", lambda s: s.try_get(key, self._MISS))
+        if v is self._MISS:
+            v = self._prev_try_get(key, self._MISS)
+        return default if v is self._MISS else v
 
     def delete(self, key: str) -> bool:
-        return self._for(key).delete(key)
+        return self._ha_write(key, "delete", lambda s: s.delete(key))
 
     def add(self, key: str, amount: int = 1) -> int:
-        return self._for(key).add(key, amount)
+        # Non-idempotent, but each shard call carries its own req_id against
+        # that shard's dedup LRU — the mirror keeps the replica's total in
+        # lockstep so a failover read of the counter is exact.
+        return self._ha_write(key, "add", lambda s: s.add(key, amount))
 
     def compare_set(self, key: str, expected: Any, desired: Any) -> tuple[bool, Any]:
-        return self._for(key).compare_set(key, expected, desired)
+        # CAS linearizes on the primary; the replica converges via an
+        # unconditional set of the winning value (losers don't mirror), so a
+        # failed-over CAS chain resumes from (at worst) a recent committed
+        # value and the state machine's own CAS semantics re-converge.
+        for attempt in (0, 1):
+            p, s = self._route(key)
+            primary_dead = s != p and self._breaker_tripped(p) and not self._breaker_tripped(s)
+            target = s if primary_dead else p
+            if primary_dead:
+                self._emit_failover(p, "cas", "mutate")
+            try:
+                ok, cur = self._shard(target).compare_set(key, expected, desired)
+            except StoreTransportError:
+                if not primary_dead and s != p:
+                    self._emit_failover(p, "cas", "mutate")
+                    try:
+                        ok, cur = self._shard(s).compare_set(key, expected, desired)
+                    except StoreTransportError:
+                        if attempt == 0 and self._maybe_adopt_epoch():
+                            continue
+                        raise
+                elif attempt == 0 and self._maybe_adopt_epoch():
+                    continue
+                else:
+                    raise
+            else:
+                if ok and s != p and target == p:
+                    if self._breaker_tripped(s):
+                        self._emit_failover(s, "cas", "replica_skipped")
+                    else:
+                        try:
+                            self._shard(s).set(key, desired)
+                        except StoreError:
+                            self._emit_failover(s, "cas", "replica_skipped")
+            if ok:
+                self._write_through_prev("cas", lambda c: c.set(key, desired))
+            return ok, cur
 
     def get_versioned(self, key: str) -> tuple[Any, int]:
-        return self._for(key).get_versioned(key)
+        return self._ha_read(key, "get_versioned", lambda s: s.get_versioned(key))
 
     def wait_changed(
         self, key: str, seen_version: int, timeout: float
     ) -> tuple[bool, Any, int]:
-        return self._for(key).wait_changed(key, seen_version, timeout)
+        # Watch-parks fail over too. Version clocks are per shard, so after
+        # a failover the seen_version from the dead primary almost certainly
+        # mismatches the replica's — the park wakes immediately (spurious but
+        # safe: every caller re-reads state for truth on wake).
+        return self._ha_read(
+            key, "wait_changed", lambda s: s.wait_changed(key, seen_version, timeout)
+        )
 
     def touch(self, key: str) -> None:
-        self._for(key).touch(key)
+        self._ha_write(key, "touch", lambda s: s.touch(key))
 
     def list_append(self, key: str, value: Any) -> None:
-        self._for(key).list_append(key, value)
+        # Dedup'd per shard like add; both copies append once per call.
+        self._ha_write(key, "list_append", lambda s: s.list_append(key, value))
 
     def list_get(self, key: str) -> list:
-        return self._for(key).list_get(key)
+        return self._ha_read(key, "list_get", lambda s: s.list_get(key))
 
     def list_clear(self, key: str) -> None:
-        self._for(key).list_clear(key)
+        self._ha_write(key, "list_clear", lambda s: s.list_clear(key))
 
     def set_add(self, key: str, values: Iterable) -> int:
-        return self._for(key).set_add(key, values)
+        values = list(values)
+        return self._ha_write(key, "set_add", lambda s: s.set_add(key, values))
 
     def set_get(self, key: str) -> set:
-        return self._for(key).set_get(key)
+        return self._ha_read(key, "set_get", lambda s: s.set_get(key))
 
     def barrier_join(
         self,
@@ -292,18 +660,174 @@ class ShardedKVClient:
         on_behalf: bool = False,
     ) -> Optional[int]:
         # A barrier name hashes to ONE shard, so arrivals, parks, proxy joins
-        # and the dedup of retried joins all stay on that shard's loop.
-        return self._for(name).barrier_join(
-            name, rank, world_size, timeout, wait, on_behalf
+        # and the dedup of retried joins all stay on that shard's loop. With
+        # replication, every arrival is FIRST mirrored to the successor as a
+        # non-blocking join (idempotent re-registration server-side), so a
+        # primary SIGKILLed mid-round leaves a complete arrival ledger on the
+        # replica: stragglers fail over and the round releases there —
+        # exactly once per joiner, because each client returns from exactly
+        # one blocking join (primary or replica, never both).
+        p, s = self._route(name)
+        mirrored = False
+        if s != p:
+            if self._breaker_tripped(s) and not self._breaker_tripped(p):
+                self._emit_failover(s, "barrier", "replica_skipped")
+            else:
+                try:
+                    self._shard(s).barrier_join(
+                        name, rank, world_size, timeout, wait=False,
+                        on_behalf=on_behalf,
+                    )
+                    mirrored = True
+                except StoreError:
+                    self._emit_failover(s, "barrier", "replica_skipped")
+        if not (s != p and self._breaker_tripped(p) and not self._breaker_tripped(s)):
+            try:
+                gen = self._shard(p).barrier_join(
+                    name, rank, world_size, timeout, wait, on_behalf
+                )
+                if gen is not None:
+                    with self._ha_lock:
+                        self._barrier_gen[name] = gen
+                return gen
+            except StoreTransportError:
+                if s == p:
+                    raise
+        self._emit_failover(p, "barrier", "barrier")
+        return self._failover_barrier_join(
+            s, name, rank, world_size, timeout, wait, on_behalf, mirrored
         )
 
+    def _failover_barrier_join(
+        self, s: int, name: str, rank: int, world_size: int,
+        timeout: float, wait: bool, on_behalf: bool, mirrored: bool,
+    ) -> Optional[int]:
+        """Complete a barrier join on the successor after the primary died.
+
+        Replica states, all resolved without double-firing or phantom rounds:
+        the mirrored round already released there (generation advanced past
+        our baseline, or our mirrored arrival was consumed by a release we
+        never saw → return that generation); our mirror registration is
+        still among the arrivals (only the release is missing → wait for the
+        generation, NEVER re-join: a release racing the status read clears
+        ``arrived``, and a blind re-join would then seed a phantom round and
+        park forever); or the mirror was skipped (plain join, with "joined
+        twice" overflow downgraded to a release wait)."""
+        with self._ha_lock:
+            base = self._barrier_gen.get(name)
+        c = self._shard(s)
+        st = c.barrier_status(name)
+        gen = (st or {}).get("generation", 0)
+        arrived = (st or {}).get("arrived") or ()
+        if base is not None and gen > base:
+            with self._ha_lock:
+                self._barrier_gen[name] = gen
+            return gen if wait else None
+        if mirrored and st is not None:
+            if rank in arrived:
+                # The mirror IS our arrival; it only lacks the release.
+                if not wait:
+                    return None
+                return self._await_barrier_release(c, name, gen, timeout)
+            if gen > (base or 0):
+                # Not among the arrivals and the generation moved: the
+                # release that cleared us is the one that counted us.
+                with self._ha_lock:
+                    self._barrier_gen[name] = gen
+                return gen if wait else None
+            # Anomalous (registration vanished with no release — e.g. a
+            # barrier_del raced us): fall through to a real join.
+        try:
+            gen = c.barrier_join(name, rank, world_size, timeout, wait, on_behalf)
+            if gen is not None:
+                with self._ha_lock:
+                    self._barrier_gen[name] = gen
+            return gen
+        except BarrierOverflow:
+            # "Joined twice": our arrival is already on the books.
+            if not wait:
+                return None
+            return self._await_barrier_release(c, name, gen, timeout)
+
+    def _await_barrier_release(
+        self, c: KVClient, name: str, base: int, timeout: float
+    ) -> int:
+        """Wait for barrier ``name``'s generation to advance past ``base`` on
+        shard client ``c`` — the already-arrived half of a blocking join."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while True:
+            st = c.barrier_status(name)
+            gen = (st or {}).get("generation", 0)
+            if gen > base:
+                with self._ha_lock:
+                    self._barrier_gen[name] = gen
+                return gen
+            if time.monotonic() >= deadline:
+                raise BarrierTimeout(
+                    f"failover barrier wait timed out on successor: {name}"
+                )
+            time.sleep(0.05)
+
     def barrier_status(self, name: str) -> Optional[dict]:
-        return self._for(name).barrier_status(name)
+        return self._ha_read(name, "barrier_status", lambda s: s.barrier_status(name))
 
     def barrier_del(self, name: str) -> bool:
-        return self._for(name).barrier_del(name)
+        return self._ha_write(name, "barrier_del", lambda s: s.barrier_del(name))
 
     # -- fan-out ops (merge across shards) ---------------------------------
+
+    def _fan_out_ha(self, op: str, fn) -> list:
+        """Fan out with dead-shard absorption: when replicating, a shard
+        that fails on transport is dropped from the merge *iff* its successor
+        answered — the successor's slot holds the dead shard's replicated
+        keyspace, so the merged result is still complete. Results arrive in
+        shard order with absorbed slots as ``None``."""
+        n = len(self.endpoints)
+        if not self._replicate or n == 1:
+            return self._fan_out(fn)
+        results = self._fan_out(fn, contain=True)
+        first_err: Optional[BaseException] = None
+        out: list = []
+        for i, r in enumerate(results):
+            if isinstance(r, BaseException):
+                succ = successor_of(i, n)
+                if isinstance(r, StoreTransportError) and not isinstance(
+                    results[succ], BaseException
+                ):
+                    self._emit_failover(i, op, "absorbed")
+                    out.append(None)
+                    continue
+                if first_err is None:
+                    first_err = r
+                out.append(None)
+                continue
+            out.append(r)
+        if first_err is not None:
+            raise first_err
+        return out
+
+    def _merge_keyed(self, op: str, fn) -> dict:
+        """Merge dict-shaped fan-out results. Under replication a key exists
+        on two shards; the primary's copy wins (the replica may be one
+        skipped mirror behind), and absorbed shards contribute through their
+        successor's slot."""
+        parts = self._fan_out_ha(op, fn)
+        n = len(self.endpoints)
+        if not self._replicate or n == 1:
+            out: dict = {}
+            for part in parts:
+                out.update(part)  # shards hold disjoint keys
+            return out
+        out = {}
+        for i, part in enumerate(parts):
+            if part is None:
+                continue
+            for k, v in part.items():
+                if shard_of(k, n) == i:
+                    out[k] = v  # primary copy is authoritative
+                else:
+                    out.setdefault(k, v)
+        return out
 
     def ping(self) -> bool:
         return all(self._fan_out(lambda s: s.ping()))
@@ -316,51 +840,76 @@ class ShardedKVClient:
             return True
         import concurrent.futures as cf
 
+        def check_batch(i: int, ks: list[str]) -> bool:
+            try:
+                return self._shard(i).check(ks)
+            except StoreTransportError:
+                succ = successor_of(i, len(self._shards))
+                if succ == i or not self._replicate:
+                    raise
+                self._emit_failover(i, "check", "read")
+                return self._shard(succ).check(ks)
+
         if len(by_shard) == 1:
             ((i, ks),) = by_shard.items()
-            return self._shard(i).check(ks)
+            return check_batch(i, ks)
         with cf.ThreadPoolExecutor(max_workers=len(by_shard)) as pool:
             futs = [
-                pool.submit(self._shard(i).check, ks)
+                pool.submit(check_batch, i, ks)
                 for i, ks in sorted(by_shard.items())
             ]
             return all(f.result() for f in futs)
 
     def prefix_get(self, prefix: str) -> dict[str, Any]:
-        out: dict[str, Any] = {}
-        for part in self._fan_out(lambda s: s.prefix_get(prefix)):
-            out.update(part)  # shards hold disjoint keys
+        out = self._merge_keyed("prefix_get", lambda s: s.prefix_get(prefix))
+        prev = self._prev_client
+        if prev is not None:
+            try:
+                for k, v in prev.prefix_get(prefix).items():
+                    out.setdefault(k, v)  # not-yet-migrated keys
+            except StoreError:
+                pass
         return out
 
     def prefix_clear(self, prefix: str) -> int:
-        return sum(self._fan_out(lambda s: s.prefix_clear(prefix)))
+        # Replicas live under the same names, so the all-shards fan-out
+        # clears both copies; the count under replication is copies removed.
+        n = sum(
+            r for r in self._fan_out_ha(
+                "prefix_clear", lambda s: s.prefix_clear(prefix)
+            ) if r is not None
+        )
+        self._write_through_prev("prefix_clear", lambda c: c.prefix_clear(prefix))
+        return n
 
     def stale_keys(self, prefix: str, max_age: float) -> dict[str, float]:
-        out: dict[str, float] = {}
-        for part in self._fan_out(lambda s: s.stale_keys(prefix, max_age)):
-            out.update(part)
-        return out
+        return self._merge_keyed(
+            "stale_keys", lambda s: s.stale_keys(prefix, max_age)
+        )
 
     def num_keys(self) -> int:
+        if self._replicate and len(self.endpoints) > 1:
+            return len(self.keys())  # replicas would double-count
         return sum(self._fan_out(lambda s: s.num_keys()))
 
     def keys(self, prefix: str = "") -> list[str]:
-        out: list[str] = []
-        for part in self._fan_out(lambda s: s.keys(prefix)):
-            out.extend(part)
+        out: set[str] = set()
+        for part in self._fan_out_ha("keys", lambda s: s.keys(prefix)):
+            if part is not None:
+                out.update(part)  # replicas dedupe by name
         return sorted(out)
 
     def barrier_names(self) -> list[str]:
-        out: list[str] = []
-        for part in self._fan_out(lambda s: s.barrier_names()):
-            out.extend(part)
+        out: set[str] = set()
+        for part in self._fan_out_ha("barrier_names", lambda s: s.barrier_names()):
+            if part is not None:
+                out.update(part)
         return sorted(out)
 
     def barrier_census(self, prefix: str = "") -> dict[str, dict]:
-        out: dict[str, dict] = {}
-        for part in self._fan_out(lambda s: s.barrier_census(prefix)):
-            out.update(part)
-        return out
+        return self._merge_keyed(
+            "barrier_census", lambda s: s.barrier_census(prefix)
+        )
 
     def store_stats(self) -> dict:
         """One aggregated ``tpu-store-stats-1`` document for the whole clique
@@ -384,11 +933,23 @@ class ShardedKVClient:
                 doc = {"enabled": False, "error": repr(doc)}
             doc["endpoint"] = f"{h}:{p}"
             docs.append(doc)
-        merged = merge_stats_docs(docs)
+        n = len(self._shards)
+        with self._ha_lock:
+            failover_ops = {
+                i: sum(per.values()) for i, per in self._failover_counts.items()
+            }
+        merged = merge_stats_docs(
+            docs,
+            successor_map={i: successor_of(i, n) for i in range(n)}
+            if self._replicate else None,
+            failover_ops=failover_ops or None,
+        )
         merged["shard_map"] = {
-            "nshards": len(self._shards),
+            "nshards": n,
             "hash": SHARD_HASH,
             "endpoints": [f"{h}:{p}" for h, p in self.endpoints],
+            "replicate": self._replicate,
+            "epoch": self._epoch,
         }
         return merged
 
@@ -405,15 +966,187 @@ class CliqueStore(StoreView):
         connect_retries: int = 60,
         auth_key: str | None = None,
         retry_budget: float = 8.0,
+        replicate: bool | None = None,
     ):
         client = ShardedKVClient(
             endpoints, timeout=timeout, connect_retries=connect_retries,
-            auth_key=auth_key, retry_budget=retry_budget,
+            auth_key=auth_key, retry_budget=retry_budget, replicate=replicate,
         )
         super().__init__(client, prefix)
 
     def close(self) -> None:
         self.client.close()
+
+
+def reshard_clique(
+    client: ShardedKVClient,
+    new_endpoints,
+    *,
+    settle: bool = True,
+    scan_prefix: str = "",
+) -> dict:
+    """Transition a live clique to a new shard map — grow, shrink, or replace
+    a dead shard with a fresh :class:`KVServer` — without a barrier ever
+    failing. The epoch protocol, in order:
+
+    1. **Publish** the next epoch document (CAS on the old map's shard 0,
+       raw :data:`EPOCH_KEY`; mirrored by plain set to the old shard 0's
+       successor and the new map's shard 0) with ``prev`` set — the
+       dual-route window opens. ``client`` adopts it immediately.
+    2. **Migrate** the value keyspace by concurrent prefix scan of the old
+       map's reachable shards (a dead shard's keyspace comes from its
+       successor replica — that's what replication bought), rewriting every
+       key through the new map's routing (primary + successor). Coordination
+       state that is round-scoped (barriers, lists/sets in flight) is not
+       copied: during the window those ops stay on the old map, and rounds
+       opened after settle live natively on the new map.
+    3. **Settle** (``prev: None``): dual-routing ends; old-map clients that
+       lose a shard after this adopt the new map on their next failure.
+       Republish :data:`CLIQUE_KEY` on the new shard 0 so late joiners probe
+       straight into the new map.
+
+    Returns the settled (or migrating, with ``settle=False``) epoch doc with
+    a ``migrated`` key count folded in. The caller owns the new servers'
+    lifecycle; with ``settle=False`` the caller finishes by calling this
+    again with the same endpoints (idempotent: same-epoch settle)."""
+    new_eps = [
+        tuple(e) for e in (
+            parse_endpoints(new_endpoints)
+            if isinstance(new_endpoints, str) else new_endpoints
+        )
+    ]
+    if not new_eps:
+        raise ValueError("reshard_clique needs at least one endpoint")
+    cur = client._read_epoch_doc()
+    cur_epoch = cur["epoch"] if isinstance(cur, dict) else 0
+    old_eps = list(client.endpoints)
+    resuming = (
+        isinstance(cur, dict) and cur.get("prev")
+        and [list(e) for e in new_eps] == cur.get("endpoints")
+    )
+    if resuming:
+        # Finishing a window opened by an earlier ``settle=False`` pass:
+        # same epoch, same endpoints — re-migrate and settle, don't chain a
+        # fresh epoch.
+        doc = {k: cur[k] for k in ("epoch", "endpoints", "prev", "replicate")
+               if k in cur}
+        old_eps = [tuple(e) for e in cur["prev"]]
+    else:
+        doc = {
+            "epoch": cur_epoch + 1,
+            "endpoints": [list(e) for e in new_eps],
+            "prev": [list(e) for e in old_eps],
+            "replicate": client._replicate,
+        }
+
+    def direct(ep) -> KVClient:
+        return KVClient(
+            ep[0], ep[1], timeout=10.0, connect_retries=1,
+            auth_key=client.auth_key, retry_budget=0.0,
+        )
+
+    def publish(d: dict, expected) -> None:
+        # CAS anchor: the OLD map's shard 0 (concurrent-reshard detection
+        # lives where every pre-transition client can see it). When that
+        # shard is the casualty being replaced, fall through to the new
+        # map's shard 0 — a recovery write, force-set when the new anchor
+        # never saw the chain. Mirrors (plain set) land everywhere any
+        # client's epoch probe looks: old successor-of-0, new shard 0, new
+        # successor-of-0.
+        anchors = [old_eps[0]]
+        if tuple(new_eps[0]) != tuple(old_eps[0]):
+            anchors.append(new_eps[0])
+        published = False
+        last_err: Optional[BaseException] = None
+        for ai, ep in enumerate(anchors):
+            try:
+                a = direct(ep)
+                try:
+                    ok, now_cur = a.compare_set(EPOCH_KEY, expected, d)
+                    if not ok and now_cur == d:
+                        ok = True  # idempotent republish (retried settle)
+                    if not ok and ai > 0 and (
+                        now_cur is None
+                        or (isinstance(now_cur, dict)
+                            and now_cur.get("epoch", 0) < d["epoch"])
+                    ):
+                        a.set(EPOCH_KEY, d)  # new anchor never saw the chain
+                        ok = True
+                    if not ok:
+                        raise StoreError(
+                            f"concurrent reshard detected (epoch key moved "
+                            f"to {now_cur!r})"
+                        )
+                finally:
+                    a.close()
+                published = True
+                break
+            except StoreTransportError as e:
+                last_err = e
+        if not published:
+            raise StoreError(
+                f"reshard could not publish epoch {d['epoch']}: no anchor "
+                f"shard reachable"
+            ) from last_err
+        mirrors: list[tuple[str, int]] = []
+        for ep in (
+            old_eps[successor_of(0, len(old_eps))] if len(old_eps) > 1 else None,
+            new_eps[0],
+            new_eps[successor_of(0, len(new_eps))] if len(new_eps) > 1 else None,
+        ):
+            if ep is not None and tuple(ep) != tuple(old_eps[0]) \
+                    and tuple(ep) not in mirrors:
+                mirrors.append(tuple(ep))
+        for ep in mirrors:
+            try:
+                m = direct(ep)
+                try:
+                    m.set(EPOCH_KEY, d)
+                finally:
+                    m.close()
+            except StoreError:
+                pass
+
+    publish(doc, cur)
+    record_event(
+        "store", "shard_epoch", epoch=doc["epoch"], nshards=len(new_eps),
+        outcome="migrating", prev_nshards=len(old_eps),
+    )
+    client._maybe_adopt_epoch(min_interval=0.0)
+    # Migrate through the adopted client: its prefix_get absorbs a dead old
+    # shard via the successor replica, and its set() writes land replicated
+    # on the new map AND write-through to the old primary (dual-route).
+    snapshot = client.prefix_get(scan_prefix)
+    migrated = 0
+    for k, v in snapshot.items():
+        if k == EPOCH_KEY or k == CLIQUE_KEY:
+            continue
+        client.set(k, v)
+        migrated += 1
+    if settle:
+        settled = dict(doc)
+        settled["prev"] = None
+        publish(settled, doc)
+        try:
+            c0 = KVClient(
+                *new_eps[0], timeout=10.0, connect_retries=1,
+                auth_key=client.auth_key, retry_budget=0.0,
+            )
+            try:
+                c0.set(CLIQUE_KEY, format_endpoints(new_eps))
+            finally:
+                c0.close()
+        except StoreError:
+            pass
+        record_event(
+            "store", "shard_epoch", epoch=doc["epoch"], nshards=len(new_eps),
+            outcome="settled", migrated=migrated,
+        )
+        client._maybe_adopt_epoch(min_interval=0.0)
+        doc = settled
+    out = dict(doc)
+    out["migrated"] = migrated
+    return out
 
 
 def endpoints_from_env() -> Optional[list[tuple[str, int]]]:
@@ -435,13 +1168,15 @@ def connect_store(
     connect_retries: int = 60,
     auth_key: str | None = None,
     retry_budget: float = 8.0,
+    replicate: bool | None = None,
 ):
     """Store-client factory every plane shares: a ``shards`` spec (argument,
     else ``$TPU_RESILIENCY_STORE_SHARDS``) yields a :class:`CliqueStore`;
     otherwise the classic single-endpoint
     :class:`~tpu_resiliency.platform.store.CoordStore`. Components that take
     ``(host, port)`` today migrate by calling this instead of the
-    constructor — no signature churn."""
+    constructor — no signature churn. ``replicate=None`` defers to the
+    launcher's ``$TPU_RESILIENCY_STORE_REPLICATE`` export."""
     from tpu_resiliency.platform.store import CoordStore
 
     eps = parse_endpoints(shards) if shards else endpoints_from_env()
@@ -449,7 +1184,7 @@ def connect_store(
         return CliqueStore(
             eps, prefix=prefix, timeout=timeout,
             connect_retries=connect_retries, auth_key=auth_key,
-            retry_budget=retry_budget,
+            retry_budget=retry_budget, replicate=replicate,
         )
     if eps:  # single-shard clique spec: classic layout at that endpoint
         host, port = eps[0]

@@ -52,6 +52,7 @@ from tpu_resiliency.exceptions import (
     BarrierOverflow,
     BarrierTimeout,
     StoreError,
+    StoreShutdownError,
     StoreTimeoutError,
     StoreTransportError,
 )
@@ -112,7 +113,14 @@ def _retry_event(op: str, outcome: str) -> None:
 #: them paying one budget is diagnosis enough — teardown must not serialize
 #: N × retry_budget of sleeps. Shared state, not per-client, for that reason.
 _breakers: dict[tuple[str, int], float] = {}
+#: Consecutive trips per endpoint since the last success. Each re-trip doubles
+#: the cooldown (capped): an endpoint that stays dead gets probed with
+#: exponentially decaying frequency instead of costing one full retry budget
+#: per cooldown window — under HA failover routing that re-probe IS the
+#: steady-state degraded tail, so its frequency is the p95.
+_breaker_streaks: dict[tuple[str, int], int] = {}
 _breakers_lock = threading.Lock()
+_BREAKER_COOLDOWN_CAP = 30.0
 
 
 def _breaker_open(host: str, port: int) -> bool:
@@ -122,12 +130,25 @@ def _breaker_open(host: str, port: int) -> bool:
 
 def _breaker_trip(host: str, port: int, cooldown: float) -> None:
     with _breakers_lock:
-        _breakers[(host, port)] = time.monotonic() + cooldown
+        streak = _breaker_streaks.get((host, port), 0) + 1
+        _breaker_streaks[(host, port)] = streak
+        eff = min(cooldown * (2 ** min(streak - 1, 16)),
+                  max(cooldown, _BREAKER_COOLDOWN_CAP))
+        _breakers[(host, port)] = time.monotonic() + eff
 
 
 def _breaker_clear(host: str, port: int) -> None:
     with _breakers_lock:
         _breakers.pop((host, port), None)
+        _breaker_streaks.pop((host, port), None)
+
+
+def breaker_open(host: str, port: int) -> bool:
+    """Public read-only view of the endpoint circuit breaker. The HA clique
+    client (``platform/shardstore.py``) routes around a shard whose breaker
+    is open — straight to the successor replica — instead of paying even the
+    fail-fast round trip on every op while the shard is down."""
+    return _breaker_open(host, port)
 
 
 def _hmac(key: str, nonce: bytes) -> bytes:
@@ -1229,6 +1250,13 @@ class KVClient:
                 last = e
                 time.sleep(delay)
                 delay = min(delay * 1.7, 2.0)
+        # Constructor-path connects raise from HERE, never reaching _call's
+        # exhaustion bookkeeping — without this trip, a lazily-(re)constructed
+        # client to a dead endpoint pays the full connect ladder on EVERY op
+        # and the HA routing layer, which keys off the breaker, never learns
+        # the shard is down.
+        if self.retry_budget > 0:
+            _breaker_trip(self.host, self.port, self.retry_budget)
         raise StoreTransportError(
             f"cannot connect to store at {self.host}:{self.port}: {last!r}"
         )
@@ -1281,6 +1309,14 @@ class KVClient:
                 if failed:
                     _retry_event(op, "recovered")
                 return out
+            except StoreShutdownError:
+                # Definitive: the server said goodbye. Reconnect-retrying this
+                # endpoint inside the call buys nothing — open the breaker so
+                # every client of it fails fast and HA routing moves on.
+                if not breaker_open:
+                    _breaker_trip(self.host, self.port, self.retry_budget)
+                    _retry_event(op, "exhausted")
+                raise
             except StoreTransportError:
                 failed = True
                 if self._closed or time.monotonic() + delay >= deadline:
@@ -1342,6 +1378,16 @@ class KVClient:
             raise StoreTimeoutError(f"store op {req.get('op')} timed out")
         if status == "overflow":
             raise BarrierOverflow(resp.get("error", ""))
+        err = resp.get("error")
+        if isinstance(err, str) and "store shut down" in err:
+            # Teardown cut a parked op loose: the op did NOT complete and the
+            # endpoint is going away. That is a transport-class failure, not a
+            # server-side verdict — surfacing it as one lets HA clique clients
+            # fail a graceful shard shutdown over to the successor exactly
+            # like a SIGKILL'd shard.
+            raise StoreShutdownError(
+                f"store op {req.get('op')} aborted by server shutdown"
+            )
         raise StoreError(f"store op {req.get('op')} failed: {resp.get('error')}")
 
     # -- primitive ops -----------------------------------------------------

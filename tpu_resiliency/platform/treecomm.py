@@ -64,6 +64,39 @@ def children(i: int, world: int, fanout: int) -> list[int]:
     return list(range(lo, min(lo + fanout, world)))
 
 
+# -- scattered registration (the rendezvous join ladder's edge shape) --------
+#
+# The tree collectives above assume the group is already ranked. The
+# rendezvous JOIN phase can't be — ranks don't exist until the round closes —
+# so its tree-laddered form uses the degenerate one-level tree: every joiner
+# publishes one *edge key* of its own (hash-scattered across a sharded
+# clique, exactly like the barrier edges above), and the single aggregator
+# (the round's opener/leader) folds them with concurrent prefix scans. That
+# turns N contended CAS retries on ONE state key — each retry a full
+# read-modify-write round trip through one event loop — into N independent
+# one-hop sets plus O(N/batch) scans on the leader, the same
+# serialization-killing move as the tree barrier's per-edge keys.
+
+def scatter_register(store, scope: str, member: str, payload: Any = 1) -> None:
+    """Publish ``member``'s registration under its own edge key — one
+    idempotent ``set`` (safe under blind retry), no CAS, no contention."""
+    store.set(f"{scope}/{member}", payload)
+
+
+def scatter_collect(store, scope: str) -> dict[str, Any]:
+    """Aggregator side: every registered member (name → payload), via the
+    store's concurrent prefix scan (fans across clique shards)."""
+    out = {}
+    for k, v in store.prefix_get(f"{scope}/").items():
+        out[k.rsplit("/", 1)[1]] = v
+    return out
+
+
+def scatter_clear(store, scope: str) -> int:
+    """GC a finished scope's edge keys (aggregator, post-close)."""
+    return store.prefix_clear(f"{scope}/")
+
+
 def parent(i: int, fanout: int) -> int:
     return (i - 1) // fanout
 

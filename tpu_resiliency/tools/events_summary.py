@@ -215,6 +215,23 @@ def _fmt_alert_resolved(p: dict) -> str:
     )
 
 
+def _fmt_store_failover(p: dict) -> str:
+    ep = p.get("endpoint")
+    return (
+        f"shard {p.get('shard')}{f' ({ep})' if ep else ''} {p.get('op')}: "
+        f"{p.get('outcome')} → successor shard {p.get('successor')}"
+    )
+
+
+def _fmt_shard_epoch(p: dict) -> str:
+    mig = p.get("migrated")
+    return (
+        f"epoch {p.get('epoch')} ({p.get('nshards')} shards): "
+        f"{p.get('outcome')}"
+        + (f", {mig} keys migrated" if isinstance(mig, int) else "")
+    )
+
+
 _FORMATTERS = {
     "rendezvous_round": _fmt_rendezvous_round,
     "worker_failed": _fmt_worker_failed,
@@ -226,6 +243,8 @@ _FORMATTERS = {
     "preemption_rescinded": _fmt_preemption_rescinded,
     "alert_fired": _fmt_alert_fired,
     "alert_resolved": _fmt_alert_resolved,
+    "store_failover": _fmt_store_failover,
+    "shard_epoch": _fmt_shard_epoch,
 }
 
 #: Kinds counted in the footer under friendlier names.
@@ -244,6 +263,8 @@ _SUMMARY_LINES = (
     ("autoscale_decision", "autoscale decisions"),
     ("alert_fired", "watchtower alerts fired"),
     ("alert_resolved", "watchtower alerts resolved"),
+    ("store_failover", "store shard failovers"),
+    ("shard_epoch", "store shard-map epoch transitions"),
     ("timeouts_calculated", "FT timeout calibrations"),
     ("training_finished", "training finished"),
     ("budget_exhausted", "restart budget exhausted"),

@@ -160,9 +160,16 @@ def report_stats(client: KVClient, out=None) -> int:
     smap = doc.get("shard_map") or {}
     backend = doc.get("backend", "threaded")
     if smap:
+        ha = ""
+        if smap.get("replicate"):
+            ha = (
+                f"   replicated (successor = shard+1 mod n), "
+                f"epoch {smap.get('epoch', 0)}"
+            )
         print(
             f"backend: {backend}   shards: {smap.get('nshards')} "
-            f"({smap.get('hash')} keyspace hash; quantiles are worst-shard)",
+            f"({smap.get('hash')} keyspace hash; quantiles are worst-shard)"
+            f"{ha}",
             file=out,
         )
     else:
@@ -193,6 +200,28 @@ def report_stats(client: KVClient, out=None) -> int:
                 f"{row.get('conns', 0):>6} {row.get('keys', 0):>8}",
                 file=out,
             )
+            # HA annotations from merge_stats_docs: a dead shard names the
+            # successor replica absorbing its keyspace; the successor lists
+            # who it is covering for and how many ops it absorbed.
+            if row.get("absorbed_by"):
+                print(
+                    f"      UNREACHABLE — keyspace absorbed by successor "
+                    f"{row['absorbed_by']}",
+                    file=out,
+                )
+            if row.get("absorbing"):
+                covered = ", ".join(str(e) for e in row["absorbing"])
+                extra = ""
+                if row.get("failover_ops"):
+                    extra = f" ({row['failover_ops']} failover ops served)"
+                print(f"      absorbing for: {covered}{extra}", file=out)
+    fo = doc.get("failover") or {}
+    if fo.get("ops"):
+        by = fo.get("by_shard") or {}
+        detail = ", ".join(
+            f"shard {k}: {v}" for k, v in sorted(by.items(), key=lambda kv: str(kv[0]))
+        )
+        print(f"failover ops absorbed: {fo['ops']} ({detail})", file=out)
     print(
         f"bytes: in {_fmt_bytes(b.get('in', 0))}, out {_fmt_bytes(b.get('out', 0))}"
         f"   dedup: {dd.get('hits', 0)}/{dd.get('lookups', 0)} hits "

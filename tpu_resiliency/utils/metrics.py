@@ -960,6 +960,29 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
             "(retried per attempt; recovered/exhausted once per call)",
             op=str(rec.get("op", "?")), outcome=str(rec.get("outcome", "?")),
         ).inc()
+    elif kind == "store_failover":
+        reg.counter(
+            "tpu_store_failover_total",
+            "clique-client shard failovers to the successor replica, by "
+            "failed shard and outcome (read | mutate | barrier | absorbed "
+            "once per failed-over op; replica_skipped once per degraded "
+            "mirror write)",
+            shard=str(rec.get("shard", "?")),
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "shard_epoch":
+        reg.counter(
+            "tpu_store_reshards_total",
+            "clique shard-map epoch transitions by phase "
+            "(migrating | settled | adopted)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+        if isinstance(rec.get("epoch"), (int, float)):
+            reg.gauge(
+                "tpu_store_epoch",
+                "current clique shard-map epoch (0 = launch map, never "
+                "resharded)",
+            ).set(rec["epoch"])
     elif kind == "peer_degraded":
         reg.counter(
             "tpu_replication_peer_degraded_total",

@@ -322,7 +322,11 @@ class OpStats:
         return out
 
 
-def merge_stats_docs(docs: list[dict]) -> dict:
+def merge_stats_docs(
+    docs: list[dict],
+    successor_map: Optional[dict[int, int]] = None,
+    failover_ops: Optional[dict[int, int]] = None,
+) -> dict:
     """Fold per-shard ``tpu-store-stats-1`` documents into one clique view
     (``ShardedKVClient.store_stats`` and ``tpu-store-info --stats`` over a
     sharded endpoint list).
@@ -336,6 +340,19 @@ def merge_stats_docs(docs: list[dict]) -> dict:
     ``shards`` table the callers fold in alongside. ``backend`` merges to the
     single common value or a comma-joined set when shards disagree
     (mid-rolling-upgrade cliques render honestly instead of guessing).
+
+    HA accounting: ``successor_map`` (shard → successor index, from a
+    replicating clique client) annotates each unreachable shard's row with
+    ``absorbed_by`` (the successor now serving its keyspace) and the
+    successor's row with ``absorbing`` — and ``failover_ops`` (shard →
+    client-observed failover count against that shard) lands as
+    ``failover_ops`` **on the successor's row**, so ops that the dead shard
+    can no longer report are counted where they were actually served instead
+    of silently dropped: the clique-total the ``<5%`` opstats overhead gate
+    reads stays a true total during degraded operation. The successor's own
+    served-op counters already include the absorbed traffic (it served it);
+    ``failover_ops`` is the *attribution* column, never double-summed into
+    ``ops_total``.
     """
     enabled = [d for d in docs if d.get("enabled")]
     backends = sorted({
@@ -390,7 +407,7 @@ def merge_stats_docs(docs: list[dict]) -> dict:
             except (KeyError, TypeError, ValueError):
                 continue
     out["hot_prefixes"] = hot.items(top=16)
-    out["shards"] = [
+    rows = [
         {
             "endpoint": d.get("endpoint", f"#{i}"),
             "enabled": bool(d.get("enabled")),
@@ -415,4 +432,34 @@ def merge_stats_docs(docs: list[dict]) -> dict:
         }
         for i, d in enumerate(docs)
     ]
+    if successor_map:
+        for i, row in enumerate(rows):
+            if row["backend"] != "unreachable":
+                continue
+            succ = successor_map.get(i)
+            if succ is None or succ == i or not (0 <= succ < len(rows)):
+                continue
+            row["absorbed_by"] = rows[succ]["endpoint"]
+            absorbing = rows[succ].setdefault("absorbing", [])
+            absorbing.append(row["endpoint"])
+    if failover_ops:
+        total = 0
+        for i, n_ops in sorted(failover_ops.items()):
+            if n_ops <= 0:
+                continue
+            total += int(n_ops)
+            succ = (successor_map or {}).get(i)
+            tgt = succ if succ is not None and 0 <= succ < len(rows) else None
+            if tgt is not None and tgt != i:
+                rows[tgt]["failover_ops"] = (
+                    rows[tgt].get("failover_ops", 0) + int(n_ops)
+                )
+        if total:
+            out["failover"] = {
+                "ops": total,
+                "by_shard": {
+                    int(i): int(n) for i, n in sorted(failover_ops.items()) if n > 0
+                },
+            }
+    out["shards"] = rows
     return out
