@@ -87,13 +87,16 @@ def _touch_tree(tree, it):
 
 def bench_clique(
     world: int, mb: int, rounds: int, pipelined: bool, root: str,
-    delta_interval: int = 0, mutate: bool = False,
+    delta_interval: int = 0, mutate: bool = False, cold_dir: str = None,
 ):
     """Per-round (foreground_s, e2e_s) as max across ranks; returns medians.
 
     ``delta_interval`` > 1 turns on chunk-diff replication between keyframes
     (the steady-state byte-economy leg); ``mutate`` applies a small per-round
-    parameter update so consecutive saves differ realistically."""
+    parameter update so consecutive saves differ realistically.
+    ``cold_dir`` attaches a durable cold tier (``checkpoint/coldtier.py``)
+    to every rank — the spiller's claim is that the foreground numbers do
+    not move, since uploads ride the background worker off save-finalize."""
     srv = KVServer(host="127.0.0.1", port=0)
     stores = []
 
@@ -112,9 +115,20 @@ def bench_clique(
             strat = CliqueReplicationStrategy(
                 comm, ex, replication_jump=1, replication_factor=world
             )
+            cold = None
+            if cold_dir is not None:
+                from tpu_resiliency.checkpoint.coldtier import (
+                    ColdTier,
+                    FilesystemStore,
+                )
+
+                cold = ColdTier(
+                    FilesystemStore(cold_dir), session=0, rank=rank
+                )
             mgr = LocalCheckpointManager(
                 root, rank=rank, comm=comm, replication=strat,
                 pipelined=pipelined, delta_interval=delta_interval,
+                cold=cold if cold is not None else False,
             )
             tree = make_tree(mb, float(rank))
             out = []
@@ -132,6 +146,11 @@ def bench_clique(
                 out.append((fg, e2e))
             if rank == 0:
                 staging_stats.update(mgr.staging.stats())
+            if cold is not None:
+                # Drain OUTSIDE the timed loop: upload completion is the
+                # background worker's business, never the train loop's.
+                assert cold.flush(timeout=600.0), "cold uploads did not drain"
+                cold.close()
             mgr.close()
             return out
         finally:
@@ -187,6 +206,41 @@ def bench_delta_leg(world: int, mb: int, rounds: int, root: str) -> dict:
         #: the ≥5x-fewer-bytes acceptance reads from here
         "bytes_ratio": round(frame / full, 4),
         "bytes_win": round(full / frame, 1),
+    }
+
+
+def bench_cold_leg(world: int, mb: int, rounds: int, root: str) -> dict:
+    """The cold-tier non-interference gate: the same pipelined clique loop
+    with and without a durable cold tier attached. Reports both foreground
+    medians plus what the spiller archived (from ``coldtier_spilled``
+    events) — the acceptance is that ``fg_ms`` is unchanged within noise
+    while every keyframe still lands in the object store."""
+    from tpu_resiliency.utils import events as events_mod
+
+    base_fg, base_e2e, _ = bench_clique(
+        world, mb, rounds, pipelined=True, root=os.path.join(root, "nocold")
+    )
+    seen = []
+    events_mod.add_sink(seen.append)
+    try:
+        cold_fg, cold_e2e, _ = bench_clique(
+            world, mb, rounds, pipelined=True,
+            root=os.path.join(root, "cold"),
+            cold_dir=os.path.join(root, "coldstore"),
+        )
+    finally:
+        events_mod.remove_sink(seen.append)
+    spills = [e.payload for e in seen if e.kind == "coldtier_spilled"]
+    degraded = [e.payload for e in seen if e.kind == "coldtier_degraded"]
+    return {
+        "base_fg_ms": round(base_fg * 1e3, 3),
+        "cold_fg_ms": round(cold_fg * 1e3, 3),
+        "fg_delta_ms": round((cold_fg - base_fg) * 1e3, 3),
+        "base_e2e_ms": round(base_e2e * 1e3, 1),
+        "cold_e2e_ms": round(cold_e2e * 1e3, 1),
+        "spills": len(spills),
+        "spilled_bytes": int(sum(p.get("bytes", 0) for p in spills)),
+        "degraded": len(degraded),
     }
 
 
@@ -267,9 +321,21 @@ def run_smoke() -> int:
         assert delta["rounds_delta"] >= 1, delta
         assert delta["applied_ok"] >= 1, delta
         assert delta["bytes_ratio"] < 0.5, delta
+        # Cold-tier non-interference: the spiller must not move the
+        # foreground window (within loopback noise — a synchronous upload
+        # would add the whole container's write time and fail this by a
+        # mile), while every keyframe still lands in the store.
+        cold = bench_cold_leg(2, LEAF_MB, 2, os.path.join(root, "coldleg"))
+        assert cold["spills"] >= 2 * 2, cold  # world x rounds keyframes
+        assert cold["degraded"] == 0, cold
+        assert cold["cold_fg_ms"] <= max(
+            cold["base_fg_ms"] * 2.0, cold["base_fg_ms"] + 25.0
+        ), f"cold tier moved the foreground window: {cold}"
         print(
             f"bench_ckpt_save smoke OK: fg={fg*1e3:.2f} ms, e2e={e2e*1e3:.1f} ms, "
-            f"staging={staging}, delta_ratio={delta['bytes_ratio']}"
+            f"staging={staging}, delta_ratio={delta['bytes_ratio']}, "
+            f"cold_fg_delta={cold['fg_delta_ms']} ms "
+            f"({cold['spills']} spills, {cold['spilled_bytes']} B)"
         )
         return 0
     finally:
@@ -305,6 +371,8 @@ def main(argv=None) -> int:
             )
             root_d = os.path.join(workdir, f"delta{mb}")
             delta = bench_delta_leg(args.world, mb, args.rounds, root_d)
+            root_c = os.path.join(workdir, f"cold{mb}")
+            cold = bench_cold_leg(args.world, mb, args.rounds, root_c)
             sizes.append({
                 "mb": mb,
                 "sync_fg_ms": round(sync_fg * 1e3, 3),
@@ -314,10 +382,12 @@ def main(argv=None) -> int:
                 "pipelined_e2e_ms": round(pipe_e2e * 1e3, 1),
                 "staging": staging,
                 "delta": delta,
+                "cold": cold,
             })
             shutil.rmtree(root_s, ignore_errors=True)
             shutil.rmtree(root_p, ignore_errors=True)
             shutil.rmtree(root_d, ignore_errors=True)
+            shutil.rmtree(root_c, ignore_errors=True)
         probe_mb = min(args.mb)
         results = {
             "world": args.world,

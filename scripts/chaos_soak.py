@@ -32,6 +32,14 @@ despite injected faults (`tpu_resiliency/platform/chaos.py`):
   layout, then the victim returns wiped and the wide world reshards back up.
   Convergence = every resumed world byte-identical, the shrink's peer traffic
   strictly less than whole mirrors, ``tpu_reshard_*`` metrics aggregate.
+- **cold-start**: checkpoints that outlive the job — a 3-rank job archives two
+  keyframes to the durable cold tier (``checkpoint/coldtier.py``), then its
+  ENTIRE process tree is SIGKILLed mid-training. A fresh 2-rank world with an
+  EMPTY workdir resumes from the cold tier alone, byte-identical. The seeded
+  bitflip variant corrupts one archived payload byte (victim owner + offset
+  derived from the seed): the fresh world refuses it fail-closed
+  (``coldtier_fetch{outcome="corrupt"}``) and the group agrees to climb to the
+  next-older covered iteration. Outcome tuple reproduces run-to-run per seed.
 - **launcher**: the real ``tpu-ft-launcher`` restart chain (worker fails round
   0, succeeds round 1) with FT monitors on, under env-propagated chaos hitting
   the store AND ipc channels. Convergence = exit 0 + the events file shows at
@@ -2045,6 +2053,285 @@ def scenario_alerts(seed: int, workdir: str):
     return ordinals, round(hang_ts - anomaly_fire["fire_ts"], 3)
 
 
+# -- scenario: cold-start (checkpoints that outlive the job) -----------------
+
+#: The cold-start campaign's fixed geometry: a 3-rank dp world whose global
+#: "w" is reassembled by a 2-rank fresh world — rows divisible by both.
+COLD_WORLD = 3
+COLD_RESUME_RANKS = [0, 1]
+
+
+def _cold_global():
+    import numpy as np
+
+    return np.arange(24 * 8, dtype=np.float32).reshape(24, 8) * 0.5
+
+
+def _cold_job_child(base: str) -> int:
+    """Hidden ``--_cold-job`` mode: the victim job of
+    :func:`scenario_cold_start`. A 3-rank world saves two cold-archived
+    keyframe iterations (layout-bearing, clique-replicated), spawns a worker
+    subprocess so there is a real process TREE to kill, signals readiness,
+    then "trains" forever — the parent SIGKILLs the whole group mid-step, so
+    nothing here ever closes cleanly. Durability must come from what already
+    landed in the cold tier."""
+    from tpu_resiliency.checkpoint import reshard as ckpt_reshard
+    from tpu_resiliency.checkpoint.coldtier import ColdTier, FilesystemStore
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.checkpoint.state_dict import PyTreeStateDict
+
+    G = _cold_global()
+    world = COLD_WORLD
+    layout = ckpt_reshard.TreeLayout(
+        [("dp", world)], list(range(world)),
+        [ckpt_reshard.LeafSpec(G.shape, "float32", ("dp",))],
+    )
+    srv = KVServer(host="127.0.0.1", port=0)
+
+    def mk():
+        return CoordStore("127.0.0.1", srv.port, timeout=30.0)
+
+    def body(rank):
+        comm = StoreComm(mk(), rank, list(range(world)), timeout=60.0)
+        ex = PeerExchange(mk(), rank, timeout=30.0)
+        ex.start()
+        strat = CliqueReplicationStrategy(
+            comm, ex, replication_jump=1, replication_factor=2
+        )
+        cold = ColdTier(
+            FilesystemStore(os.path.join(base, "cold")), session=0, rank=rank
+        )
+        mgr = LocalCheckpointManager(
+            os.path.join(base, "root"), rank=rank, comm=comm,
+            replication=strat, cold=cold, keep=2,
+        )
+        for it in (1, 2):
+            tree = {
+                "w": ckpt_reshard.slice_local([G], layout, rank)[0]
+                + float(it),
+                "step": it,
+            }
+            mgr.save(it, PyTreeStateDict(tree), is_async=False, layout=layout)
+        assert cold.flush(timeout=60.0), "cold uploads did not drain"
+        # Deliberately no mgr.close()/ex.close(): this job dies by SIGKILL.
+
+    with cf.ThreadPoolExecutor(max_workers=world) as pool:
+        for f in [pool.submit(body, r) for r in range(world)]:
+            f.result(timeout=180)
+    worker = subprocess.Popen(
+        [sys.executable, "-c", "import time\nwhile True: time.sleep(1)"]
+    )
+    tmp = os.path.join(base, "ready.tmp")
+    with open(tmp, "w") as f:
+        f.write(str(worker.pid))
+    os.replace(tmp, os.path.join(base, "ready"))
+    while True:  # "training" — the parent kills the process group here
+        time.sleep(0.05)
+
+
+def _proc_gone(pid: int) -> bool:
+    """Dead-or-zombie (a zombie no longer executes anything; whether it is
+    reaped depends on the container's init)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(")", 1)[1].split()[0] == "Z"
+    except OSError:
+        return True
+
+
+def scenario_cold_start(seed: int, workdir: str):
+    """Checkpoints that outlive the job: SIGKILL an entire job's process tree
+    mid-training, then resume a FRESH world with an EMPTY workdir from the
+    cold tier alone, on a DIFFERENT world size (3 -> 2), byte-identical.
+
+    The seeded bitflip variant corrupts one byte of the newest archived
+    iteration (victim owner and payload offset both derived from the seed):
+    the fresh world must refuse the corrupt bytes fail-closed and agree to
+    climb to the next-older covered iteration. Returns the full outcome
+    tuple (kill signal, resumed iterations, state digests, fault identity) —
+    reproducible run-to-run per seed."""
+    import hashlib
+    import shutil
+    import signal
+
+    import numpy as np
+
+    from tpu_resiliency.checkpoint import reshard as ckpt_reshard
+    from tpu_resiliency.checkpoint.coldtier import (
+        ColdTier,
+        FilesystemStore,
+        artifact_key,
+    )
+    from tpu_resiliency.checkpoint.local_manager import LocalCheckpointManager
+    from tpu_resiliency.utils import events as tpu_events
+
+    base = workdir
+    shutil.rmtree(base, ignore_errors=True)
+    os.makedirs(base)
+    logpath = os.path.join(base, "job.log")
+    with open(logpath, "wb") as logf:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--_cold-job", base],
+            stdout=logf, stderr=subprocess.STDOUT, start_new_session=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    ready = os.path.join(base, "ready")
+    deadline = time.monotonic() + 180.0
+    try:
+        while not os.path.exists(ready):
+            if proc.poll() is not None:
+                with open(logpath, errors="replace") as f:
+                    tail = f.read()[-2000:]
+                raise AssertionError(
+                    f"cold-start job died before readiness (rc="
+                    f"{proc.returncode}):\n{tail}"
+                )
+            if time.monotonic() > deadline:
+                raise AssertionError("cold-start job never became ready")
+            time.sleep(0.05)
+        with open(ready) as f:
+            worker_pid = int(f.read().strip())
+        # The whole tree, not just the leader: the job runs in its own
+        # session/process group, so one killpg takes worker and leader alike.
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        rc = proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert rc == -signal.SIGKILL, f"job exited {rc}, wanted SIGKILL"
+    kill_deadline = time.monotonic() + 10.0
+    while not _proc_gone(worker_pid):
+        assert time.monotonic() < kill_deadline, (
+            f"worker {worker_pid} survived the process-tree kill"
+        )
+        time.sleep(0.05)
+
+    G = _cold_global()
+    ranks = list(COLD_RESUME_RANKS)
+    tgt = ckpt_reshard.TreeLayout(
+        [("dp", len(ranks))], ranks,
+        [ckpt_reshard.LeafSpec(G.shape, "float32", ("dp",))],
+    )
+
+    def restore(tag, gen):
+        """A fresh launcher's view: empty workdir, only the cold tier and a
+        new rendezvous store."""
+        srv = KVServer(host="127.0.0.1", port=0)
+        stores: list = []
+        seen: list = []
+        tpu_events.add_sink(seen.append)
+        fresh = os.path.join(base, f"fresh_{tag}")
+
+        def mk():
+            s = CoordStore("127.0.0.1", srv.port, timeout=30.0)
+            stores.append(s)
+            return s
+
+        def body(rank):
+            comm = StoreComm(mk(), rank, ranks, timeout=60.0, generation=gen)
+            ex = PeerExchange(mk(), rank, timeout=30.0)
+            ex.start()
+            try:
+                mgr = LocalCheckpointManager(
+                    fresh, rank=rank, comm=comm,
+                    cold=ColdTier(
+                        FilesystemStore(os.path.join(base, "cold")),
+                        session=0, rank=rank,
+                    ),
+                )
+                hollow, tensors, meta = mgr.load_resharded()
+                mgr.close()
+                return meta["iteration"], [
+                    np.asarray(t).copy() for t in tensors
+                ]
+            finally:
+                ex.close()
+
+        try:
+            with cf.ThreadPoolExecutor(max_workers=len(ranks)) as pool:
+                out = [
+                    f.result(timeout=180)
+                    for f in [pool.submit(body, r) for r in ranks]
+                ]
+        finally:
+            tpu_events.remove_sink(seen.append)
+            for s in stores:
+                s.close()
+            srv.close()
+        return out, seen
+
+    def digest(out):
+        h = hashlib.sha256()
+        for _, tensors in out:
+            for t in tensors:
+                h.update(t.tobytes())
+        return h.hexdigest()
+
+    # Leg 1: clean restore-anywhere — fresh world 2 resumes the killed
+    # world-3 job's newest keyframe, byte-identical, straight from cold.
+    out_a, seen_a = restore("clean", gen=1)
+    for rank, (it, tensors) in zip(ranks, out_a):
+        assert it == 2, f"rank {rank} resumed iteration {it}, wanted 2"
+        want = ckpt_reshard.slice_local([G], tgt, rank)[0] + 2.0
+        assert np.array_equal(tensors[0], want), (
+            f"rank {rank}: cold restore not byte-identical"
+        )
+    fetches = [e for e in seen_a if e.kind == "coldtier_fetch"]
+    assert fetches and all(
+        e.payload["outcome"] == "ok" for e in fetches
+    ), f"clean leg cold fetches: {[e.payload for e in fetches]}"
+
+    # Leg 2: the seeded cold-tier bitflip — victim owner and offset inside
+    # the sharded "w" payload both derive from the seed; the fresh world must
+    # climb to the next-older covered iteration, never restoring flipped
+    # bytes.
+    colddir = os.path.join(base, "cold")
+    victim = seed % COLD_WORLD
+    probe = ColdTier(FilesystemStore(colddir))
+    doc = probe.manifest(2, victim)
+    assert doc is not None, f"no cold manifest for iter 2 owner {victim}"
+    off = doc["prefix_len"]
+    for leaf in doc["leaves"]:
+        if leaf["nbytes"] == max(l["nbytes"] for l in doc["leaves"]):
+            break
+        off += leaf["nbytes"]
+    flip_at = off + seed % leaf["nbytes"]
+    apath = os.path.join(colddir, artifact_key(0, 2, victim))
+    with open(apath, "r+b") as f:
+        f.seek(flip_at)
+        b = f.read(1)
+        f.seek(flip_at)
+        f.write(bytes([b[0] ^ 0x01]))
+
+    out_b, seen_b = restore("bitflip", gen=2)
+    for rank, (it, tensors) in zip(ranks, out_b):
+        assert it == 1, (
+            f"rank {rank} resumed iteration {it} — must climb below the "
+            f"corrupt iter 2"
+        )
+        want = ckpt_reshard.slice_local([G], tgt, rank)[0] + 1.0
+        assert np.array_equal(tensors[0], want), (
+            f"rank {rank}: climbed restore not byte-identical"
+        )
+    corrupt = [
+        e for e in seen_b
+        if e.kind == "coldtier_fetch" and e.payload["outcome"] == "corrupt"
+    ]
+    assert corrupt, "bitflip leg never surfaced a corrupt cold fetch"
+    # Persist both restore legs' event streams for downstream smoke legs
+    # (metrics_dump must aggregate tpu_coldtier_* from this file).
+    with open(os.path.join(base, "events.jsonl"), "w") as f:
+        for e in seen_a + seen_b:
+            f.write(json.dumps(e.to_record(), default=str) + "\n")
+    return (
+        rc,
+        [it for it, _ in out_a], digest(out_a),
+        victim, flip_at,
+        [it for it, _ in out_b], digest(out_b),
+    )
+
+
 # -- driver ------------------------------------------------------------------
 
 
@@ -2125,6 +2412,18 @@ def run_seed(seed: int, workdir: str, with_launcher: bool = True,
     out["elastic_victim"] = e1[1]
     out["elastic_splits"] = [list(s) for s in e1[2]]
     out["elastic_injections"] = [list(i) for i in e1[0]]
+    # Cold-start: SIGKILL the whole job tree mid-training, fresh empty-workdir
+    # world resumes from the cold tier on a different world size — twice per
+    # seed, and the (kill, resumed iterations, digests, fault identity) tuple
+    # must reproduce exactly, bitflip-climb variant included.
+    cold_dir = os.path.join(workdir, f"cold_{seed}")
+    cs1 = scenario_cold_start(seed, cold_dir)
+    cs2 = scenario_cold_start(seed, cold_dir)
+    assert cs1 == cs2, f"cold-start outcome not reproducible:\n{cs1}\n{cs2}"
+    out["cold_start_resumed"] = {"clean": cs1[1], "bitflip": cs1[5]}
+    out["cold_start_digests"] = {"clean": cs1[2], "bitflip": cs1[6]}
+    out["cold_start_fault"] = {"victim_owner": cs1[3], "flip_at": cs1[4]}
+    out["cold_start_workdir"] = cold_dir
     # Mixed multi-fault campaign (straggler + network + disk), twice per seed:
     # the combined schedule must reproduce exactly like the single-channel ones.
     mixed_dir = os.path.join(workdir, f"mixed_{seed}")
@@ -2181,7 +2480,11 @@ def main(argv=None) -> int:
         help="run under this directory instead of a self-deleting tempdir "
         "(keeps the mixed scenario's events/incident artifacts for "
         "downstream smoke legs)")
+    ap.add_argument("--_cold-job", dest="cold_job", metavar="DIR",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
+    if args.cold_job:
+        return _cold_job_child(args.cold_job)
 
     results = []
     import contextlib

@@ -305,6 +305,54 @@ print(f"store-failover chaos OK: kill_round={run['store_failover_kill_round']} "
       f"victims={run['store_failover_victims']} counter={run['store_failover_counter']}")
 PY
 
+echo "== smoke: cold start (job-tree SIGKILL -> fresh-workdir resume from the cold tier + offline --cold audit)"
+COLD_DIR="$WORKDIR/chaos/cold_1234"
+# The chaos leg already ran scenario_cold_start twice-per-seed: clean restore
+# on a different world size resumed iter 2, the seeded archive bitflip climbed
+# to iter 1, and the two legs restored different bytes.
+python - "$WORKDIR/chaos/report.json" <<'PY'
+import json, sys
+run = json.load(open(sys.argv[1]))["runs"][0]
+assert run["cold_start_resumed"]["clean"] == [2, 2], run["cold_start_resumed"]
+assert run["cold_start_resumed"]["bitflip"] == [1, 1], run["cold_start_resumed"]
+assert run["cold_start_digests"]["clean"] != run["cold_start_digests"]["bitflip"]
+f = run["cold_start_fault"]
+print(f"cold-start chaos OK: clean resume iter 2, seeded bitflip "
+      f"(owner {f['victim_owner']} @ byte {f['flip_at']}) climbed to iter 1")
+PY
+# Offline audit of the killed job's workdir: archived owners join coverage as
+# the third rung and render per iteration.
+python -m tpu_resiliency.tools.ckpt_info "$COLD_DIR/root" --cold "$COLD_DIR/cold" \
+    > "$COLD_DIR/coldinfo.out"
+sed 's/^/    /' "$COLD_DIR/coldinfo.out"
+grep -q "in cold tier" "$COLD_DIR/coldinfo.out" \
+    || { echo "FAIL: --cold audit lost the cold-tier iteration count"; exit 1; }
+grep -q "cold: \[0, 1, 2\]" "$COLD_DIR/coldinfo.out" \
+    || { echo "FAIL: --cold audit lost the archived owners"; exit 1; }
+# Restore-anywhere: an EMPTY workdir still audits what a new job could
+# bootstrap from the object store alone.
+mkdir -p "$COLD_DIR/nowhere"
+python -m tpu_resiliency.tools.ckpt_info "$COLD_DIR/nowhere" --cold "$COLD_DIR/cold" \
+    | grep -q "resumable from: iter" \
+    || { echo "FAIL: empty workdir + --cold found nothing resumable"; exit 1; }
+# --verify must catch the scenario's seeded archive bitflip (exit 1) and name
+# the digest mismatch.
+if python -m tpu_resiliency.tools.ckpt_info "$COLD_DIR/nowhere" --cold "$COLD_DIR/cold" \
+    --verify > "$COLD_DIR/coldverify.out" 2>&1; then
+    echo "FAIL: --cold --verify missed the seeded archive bitflip"; exit 1
+fi
+sed 's/^/    /' "$COLD_DIR/coldverify.out"
+grep -q "digest mismatch" "$COLD_DIR/coldverify.out" \
+    || { echo "FAIL: --cold --verify verdict lost the digest mismatch"; exit 1; }
+# The tpu_coldtier_* families aggregate from the restore legs' event stream.
+python -m tpu_resiliency.tools.metrics_dump "$COLD_DIR/events.jsonl" --format prom | \
+    grep -q "tpu_coldtier_fetch_total" \
+    || { echo "FAIL: tpu_coldtier_fetch_total missing from metrics dump"; exit 1; }
+python -m tpu_resiliency.tools.metrics_dump "$COLD_DIR/events.jsonl" --format prom | \
+    grep -q 'outcome="corrupt"' \
+    || { echo "FAIL: corrupt cold fetch never reached the metrics plane"; exit 1; }
+echo "cold-start smoke OK: offline --cold audit, empty-workdir bootstrap view, archive verify, metrics"
+
 echo "== smoke: incident plane (artifact renders + tpu_incident_*/tpu_remediation_* metrics)"
 MIXED_DIR="$WORKDIR/chaos/mixed_1234"
 python -m tpu_resiliency.tools.incident_report "$MIXED_DIR/incidents" --list

@@ -42,3 +42,15 @@ def test_pipelined_foreground_window_vs_sync_baseline(tmp_path):
     assert size["staging"]["hits"] >= 1, size
     assert size["staging"]["misses"] <= 2, size
     assert results["steady_state_peak_alloc_mb"] < 1.0, results
+    # Cold-tier non-interference (the BENCH_ckpt_save.json foreground-window
+    # gate): attaching the durable cold tier must leave the caller-visible
+    # save window unchanged within noise — a synchronous upload would add
+    # the whole container's write time and fail by a mile — while every
+    # keyframe (world x rounds) still lands in the object store, undegraded.
+    cold = size["cold"]
+    assert cold["spills"] == 2 * 3, cold
+    assert cold["degraded"] == 0, cold
+    assert cold["spilled_bytes"] > 0, cold
+    assert cold["cold_fg_ms"] <= max(
+        cold["base_fg_ms"] * 2.0, cold["base_fg_ms"] + 25.0
+    ), cold

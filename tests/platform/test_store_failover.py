@@ -308,3 +308,55 @@ def test_dual_route_window_covers_both_maps(clique, client):
 
 def extra_ep(server: KVServer) -> tuple:
     return ("127.0.0.1", server.port)
+
+
+class TestAutoReshard:
+    """Automatic shard respawn (launcher --store-auto-reshard): the
+    supervisor notices a SIGKILL'd shard process, spawns a replacement, and
+    drives reshard_clique onto the healed map — the operator runbook as a
+    closed loop, audited as store_auto_reshard events."""
+
+    def test_supervisor_respawns_sigkilled_shard(self, seen):
+        from tpu_resiliency.platform.shardstore import (
+            AutoReshardSupervisor,
+            SpawnedClique,
+        )
+
+        clique = SpawnedClique(2)
+        client = None
+        sup = None
+        try:
+            client = ShardedKVClient(
+                clique.endpoints, timeout=30.0, connect_retries=2,
+                retry_budget=0.3, replicate=True,
+            )
+            for i in range(12):
+                client.set(f"ar/{i}", i)
+            victim = 1
+            old_port = clique.endpoints[victim][1]
+            clique.procs[victim].kill()
+            clique.procs[victim].wait(10.0)
+            sup = AutoReshardSupervisor(clique, client, interval=0.1, grace=0.2)
+            sup.start()
+            deadline = time.monotonic() + 30.0
+            while sup.reshards == 0 and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert sup.reshards == 1, "supervisor never healed the clique"
+            # The keyspace survived the kill + migration intact.
+            assert client.prefix_get("ar/") == {f"ar/{i}": i for i in range(12)}
+            # The replacement is a different server and answers directly.
+            new_port = clique.endpoints[victim][1]
+            assert new_port != old_port
+            assert clique.procs[victim].poll() is None
+            audits = [e for e in seen if e.kind == "store_auto_reshard"]
+            assert audits and audits[-1].payload["outcome"] == "ok"
+            assert audits[-1].payload["shard"] == victim
+            # A healthy clique is left alone.
+            time.sleep(0.5)
+            assert sup.reshards == 1
+        finally:
+            if sup is not None:
+                sup.stop()
+            if client is not None:
+                client.close()
+            clique.close()

@@ -197,3 +197,104 @@ def test_plan_without_layout_meta_exits_one(tmp_path, capsys):
     mgr.close()
     assert ckpt_info.main([root, "--world", "0", "--plan"]) == 1
     assert "no containers carry reshard layout" in capsys.readouterr().out
+
+
+def _spill_to_cold(root, cold_dir, pairs):
+    """Archive ``[(iteration, owner)]`` from a manager-written ``root`` into
+    a cold tier at ``cold_dir`` (the spiller's real path, drained)."""
+    from tpu_resiliency.checkpoint.coldtier import ColdTier, FilesystemStore
+
+    tier = ColdTier(FilesystemStore(cold_dir), session=0, rank=0)
+    try:
+        for it, owner in pairs:
+            path = os.path.join(
+                root, "s0", f"r{owner}", f"iter_{it:07d}_{owner}_local.ckpt"
+            )
+            assert tier.spill(it, owner, path)
+        assert tier.flush(timeout=30.0)
+    finally:
+        tier.close()
+    return tier
+
+
+def test_cold_coverage_joins_render(tmp_path, capsys):
+    """A shard lost after it was archived: local coverage alone is NOTHING,
+    but --cold restores the verdict through the third rung."""
+    root = str(tmp_path / "root")
+    cold = str(tmp_path / "cold")
+    for rank in (0, 1):
+        mgr = LocalCheckpointManager(root, rank=rank)
+        _save(mgr, 2, float(rank))
+        mgr.close()
+    _spill_to_cold(root, cold, [(2, 0), (2, 1)])
+    # Lose rank 1's container but keep its rank dir (disk scrub, not shrink):
+    # the audited world stays [0, 1] with owner 1's shard gone locally.
+    os.unlink(
+        os.path.join(root, "s0", "r1", "iter_0000002_1_local.ckpt")
+    )
+
+    # Without --cold: owner 1's shard is gone everywhere.
+    assert ckpt_info.main([root]) == 0
+    text = capsys.readouterr().out
+    assert "resumable from: NOTHING for world [0, 1]" in text
+
+    assert ckpt_info.main([root, "--cold", cold]) == 0
+    text = capsys.readouterr().out
+    assert "1 in cold tier" in text
+    assert "cold: [0, 1]" in text
+    assert "[COVERED]" in text
+    assert "resumable from: iter 2 (newest covered for world [0, 1])" in text
+
+
+def test_cold_only_session_audits_from_empty_workdir(tmp_path, capsys):
+    """The restore-anywhere audit: a freshly provisioned (empty) workdir plus
+    --cold still names what a new job could bootstrap from."""
+    root = str(tmp_path / "root")
+    cold = str(tmp_path / "cold")
+    mgr = LocalCheckpointManager(root, rank=0)
+    _save(mgr, 3, 1.5)
+    mgr.close()
+    _spill_to_cold(root, cold, [(3, 0)])
+
+    empty = str(tmp_path / "fresh")
+    os.makedirs(empty)
+    assert ckpt_info.main([empty]) == 1  # no sessions without the cold rung
+    capsys.readouterr()
+    assert ckpt_info.main([empty, "--cold", cold]) == 0
+    text = capsys.readouterr().out
+    assert "session 0" in text and "cold: [0]" in text
+    assert "resumable from: iter 3" in text
+
+
+def test_cold_verify_catches_archived_corruption(tmp_path, capsys):
+    root = str(tmp_path / "root")
+    cold = str(tmp_path / "cold")
+    mgr = LocalCheckpointManager(root, rank=0)
+    _save(mgr, 4, 2.0)
+    mgr.close()
+    _spill_to_cold(root, cold, [(4, 0)])
+
+    assert ckpt_info.main([root, "--cold", cold, "--verify"]) == 0
+    text = capsys.readouterr().out
+    assert "verifying 1 cold artifact(s)" in text
+    assert "cold s0/iter 4 owner 0" in text and "[OK" in text
+
+    # Flip one payload byte in the archived object: the manifest digest must
+    # fail the artifact and the CLI must exit 1.
+    akey = os.path.join(cold, "s0", "iter_0000004", "owner_0.ckpt")
+    blob = bytearray(open(akey, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    with open(akey, "wb") as f:
+        f.write(bytes(blob))
+    assert ckpt_info.main([root, "--cold", cold, "--verify"]) == 1
+    text = capsys.readouterr().out
+    assert "digest mismatch" in text
+
+
+def test_cold_missing_dir_is_an_error(tmp_path, capsys):
+    root = str(tmp_path)
+    mgr = LocalCheckpointManager(root, rank=0)
+    _save(mgr, 1, 0.0)
+    mgr.close()
+    assert ckpt_info.main([root, "--cold", str(tmp_path / "nope")]) == 1
+    assert "not a cold-tier root" in capsys.readouterr().err

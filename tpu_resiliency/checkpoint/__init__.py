@@ -18,9 +18,18 @@ TPU-native re-design of the reference's ``checkpointing/`` package (SURVEY §2.6
 - :mod:`~tpu_resiliency.checkpoint.coding` — byte economy: Reed-Solomon
   erasure replication (k-of-n blocks instead of full mirrors) and delta
   checkpoints (chunk-diff frames between keyframes).
+- :mod:`~tpu_resiliency.checkpoint.coldtier` — durable cold tier: async
+  spill of finalized keyframe containers to a pluggable object store,
+  manifest-verified restore-anywhere bootstrap.
 """
 
 from tpu_resiliency.checkpoint.async_ckpt import AsyncCheckpointer
+from tpu_resiliency.checkpoint.coldtier import (
+    ColdTier,
+    FilesystemStore,
+    ObjectStore,
+    cold_from_env,
+)
 from tpu_resiliency.checkpoint.async_core import (
     AsyncCallsQueue,
     AsyncRequest,
@@ -66,6 +75,10 @@ __all__ = [
     "PeerExchange",
     "CkptID",
     "LocalCheckpointManager",
+    "ColdTier",
+    "FilesystemStore",
+    "ObjectStore",
+    "cold_from_env",
     "CliqueReplicationStrategy",
     "LazyCliqueReplicationStrategy",
     "ErasureReplicationStrategy",

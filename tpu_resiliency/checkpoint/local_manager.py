@@ -26,7 +26,12 @@ whose copy fails climbs a ladder instead of raising —
 2. **peer retrieve**: the existing collective exchange routes the shard from a
    clique mirror, verify-on-receive (a corrupt mirror is treated like PR 4's
    degraded peer — dropped, not loaded);
-3. **fall back** to the next older iteration whose shards pass, agreed across
+3. **cold-tier fetch** (``checkpoint/coldtier.py``): when no live peer can
+   serve the shard — including a FRESH job with an empty workdir after a
+   correlated failure — the durable object-store archive supplies it, every
+   fetched byte verified fail-closed against the ``tpu-coldtier-1`` manifest
+   digests before the container's own verify;
+4. **fall back** to the next older iteration whose shards pass, agreed across
    the group with a :class:`StoreComm` round (``all_reduce_min``) so every rank
    loads the SAME iteration instead of diverging.
 
@@ -46,6 +51,7 @@ import threading
 import time
 from typing import Any, Optional
 
+from tpu_resiliency.checkpoint import coldtier as coldtier_mod
 from tpu_resiliency.checkpoint import format as ckpt_format
 from tpu_resiliency.checkpoint import reshard as reshard_mod
 from tpu_resiliency.checkpoint.async_core import AsyncCallsQueue, AsyncRequest
@@ -197,6 +203,7 @@ class LocalCheckpointManager:
         staging: Optional[HostStagingPool] = None,
         keep: int = 1,
         delta_interval: Optional[int] = None,
+        cold: Optional[Any] = None,
     ):
         self.root = root
         self.rank = rank
@@ -204,6 +211,15 @@ class LocalCheckpointManager:
         self.comm = comm
         self.replication = replication
         self._caller_kind = caller
+        #: Durable cold tier (``checkpoint/coldtier.py``): ``None`` wires from
+        #: ``$TPU_RESILIENCY_COLD_DIR`` (off when unset), ``False`` forces off,
+        #: or pass a :class:`~tpu_resiliency.checkpoint.coldtier.ColdTier`.
+        #: Finalized keyframe saves spill asynchronously; coverage agreement
+        #: and the recovery ladder gain a third rung below reconstruct-from-
+        #: parity — fetch-from-cold-tier.
+        if cold is None:
+            cold = coldtier_mod.cold_from_env(session=session, rank=rank)
+        self.cold = cold or None
         #: Delta-checkpoint chain state (``checkpoint/coding/delta.py``):
         #: ``delta_interval`` N > 1 ships up to N-1 chunk-diff replication
         #: rounds between full keyframes (default: ``$TPU_RESILIENCY_CKPT_DELTA``,
@@ -476,7 +492,10 @@ class LocalCheckpointManager:
                 ),
                 cleanup_fns=(snapshot.release,),
                 finalize_fns=(
-                    lambda: self._finalize_save(iteration, sizes.get("bytes")),
+                    lambda: self._finalize_save(
+                        iteration, sizes.get("bytes"),
+                        keyframe=sizes.get("keyframe", True),
+                    ),
                 ),
             )
             try:
@@ -596,6 +615,7 @@ class LocalCheckpointManager:
                     ckpt_format._U32.unpack(state["trailer"][-4:])[0],
                     keyframe=not sent_delta,
                 )
+            sizes["keyframe"] = not sent_delta
             items = self._received_items(iteration, received)
             if items:
                 _persist_artifacts(items)
@@ -680,7 +700,11 @@ class LocalCheckpointManager:
         req = AsyncRequest(
             async_fn=_persist_artifacts,
             async_fn_args=(items,),
-            finalize_fns=(lambda: self._finalize_save(iteration, total_bytes),),
+            finalize_fns=(
+                lambda: self._finalize_save(
+                    iteration, total_bytes, keyframe=frame is None
+                ),
+            ),
         )
         if is_async:
             self.queue.schedule_async_request(req)
@@ -789,7 +813,10 @@ class LocalCheckpointManager:
                 ))
         return items
 
-    def _finalize_save(self, iteration: int, total_bytes: Optional[int] = None) -> None:
+    def _finalize_save(
+        self, iteration: int, total_bytes: Optional[int] = None,
+        keyframe: bool = True,
+    ) -> None:
         """Verify coverage of ``iteration`` across ranks, then prune older iterations."""
         covered = self._covered_iterations()
         if iteration not in covered:
@@ -809,6 +836,16 @@ class LocalCheckpointManager:
             held=sorted(i.owner for i in self.local_ids() if i.iteration == iteration),
             **({"bytes": total_bytes} if total_bytes is not None else {}),
         )
+        # Cold-tier spill: enqueue-only (the spiller's daemon thread ships the
+        # bytes), so the save path pays a queue put and nothing else. Own
+        # shards are always self-contained containers; the keyframe flag
+        # carries the delta chain's cadence — delta rounds skip the upload.
+        if self.cold is not None:
+            own = self._path(CkptID(iteration, self.rank, self.session))
+            if os.path.exists(own):
+                self.cold.spill(
+                    iteration, self.rank, own, keyframe=keyframe,
+                )
         # Keep the newest ``keep`` iterations (the reference's retention policy
         # is keep=1 — local ckpts are a recovery buffer, not an archive;
         # keep>=2 funds the recovery ladder's fallback rung).
@@ -831,30 +868,57 @@ class LocalCheckpointManager:
 
     # -- coverage / find_latest -------------------------------------------
 
+    def _cold_pairs(self) -> list[tuple[int, int]]:
+        """``(iteration, owner)`` shards the cold tier archives — the
+        coverage ladder's third rung input. Empty on any store failure (a
+        dead backend degrades coverage to the local tiers, never raises)."""
+        if self.cold is None:
+            return []
+        try:
+            return sorted(
+                (it, o)
+                for it, owners in self.cold.coverage().items()
+                for o in owners
+            )
+        except OSError as e:
+            log.warning(f"cold tier: coverage scan failed: {e!r}")
+            return []
+
     def _covered_iterations(self) -> set[int]:
         """Iterations for which the union of all ranks' holdings covers every
         rank — where "covers" means a full container somewhere OR enough
         erasure blocks (≥ k distinct indices of one generation) to
-        reconstruct one, so a k-of-n clique's coverage math matches what the
-        recovery ladder can actually deliver."""
+        reconstruct one, OR an archived cold-tier container (the third rung:
+        fetchable by any rank, including a fresh workdir that holds
+        nothing), so coverage math matches what the recovery ladder can
+        actually deliver."""
         if self.comm is None:
-            return {i.iteration for i in self.local_ids() if i.owner == self.rank}
+            out = {i.iteration for i in self.local_ids() if i.owner == self.rank}
+            out.update(
+                it for it, o in self._cold_pairs() if o == self.rank
+            )
+            return out
         gathered = self.comm.all_gather(
             (
                 sorted((i.iteration, i.owner) for i in self.local_ids()),
                 sorted(self.block_ids()),
+                self._cold_pairs(),
             ),
             tag="coverage",
         )
         by_iter: dict[int, set[int]] = {}
         blocks: dict[tuple[int, int], set[int]] = {}
         kof: dict[tuple[int, int], int] = {}
-        for holdings, block_holdings in gathered:
+        for holdings, block_holdings, cold_pairs in gathered:
             for it, owner in holdings:
                 by_iter.setdefault(it, set()).add(owner)
             for it, owner, index, k, m in (tuple(b) for b in block_holdings):
                 blocks.setdefault((it, owner), set()).add(index)
                 kof[(it, owner)] = k
+            # Union across ranks: a manifest any ONE rank observed counts (the
+            # store is shared; scans may race an in-flight upload).
+            for it, owner in (tuple(p) for p in cold_pairs):
+                by_iter.setdefault(it, set()).add(owner)
         for (it, owner), indices in blocks.items():
             if len(indices) >= kof[(it, owner)]:
                 by_iter.setdefault(it, set()).add(owner)
@@ -1016,9 +1080,12 @@ class LocalCheckpointManager:
         else:
             needed = self.rank
         if self.comm is None or self.replication is None:
-            # No group/no replication: the local verdict is final for this
-            # rung (a distributed-but-unreplicated group still runs the
-            # agreement round in _load, so ranks fall back in lockstep).
+            # No group/no replication: the cold tier is the only rung below
+            # the local verdict (a distributed-but-unreplicated group still
+            # runs the agreement round in _load, so ranks fall back in
+            # lockstep).
+            if result is None:
+                result = self._cold_restore(iteration)
             return result, result is not None
         try:
             # The coded strategy's retrieve runs the reconstruct-from-parity
@@ -1052,7 +1119,11 @@ class LocalCheckpointManager:
         if needed is None:
             return result, result is not None
         if blob is None:
-            return None, False
+            # Third rung: no live holder and no reconstructible parity — a
+            # cold-tier archive (verified fail-closed against its manifest)
+            # still satisfies this rank before the group falls back.
+            result = self._cold_restore(iteration)
+            return result, result is not None
         if ckpt_delta.is_delta(blob):
             # A coded delta generation reconstructs to the FRAME; materialize
             # the container by applying it against this rank's own base
@@ -1111,6 +1182,34 @@ class LocalCheckpointManager:
         except OSError as e:
             log.warning(f"could not re-persist recovered shard {path}: {e!r}")
         return result, True
+
+    def _cold_restore(self, iteration: int) -> Optional[tuple]:
+        """Fetch this rank's shard for ``iteration`` from the cold tier into
+        the local directory and read it back through the normal verify path.
+        Returns the ``(hollow, tensors, meta)`` result or ``None`` — never
+        raises (the ladder's agreement round owns the fallback decision).
+        Both gates are fail-closed: the fetch verifies the manifest's
+        whole-file digest before a byte becomes visible, and the local read
+        re-verifies the container's own integrity record."""
+        if self.cold is None:
+            return None
+        path = self._path(CkptID(iteration, self.rank, self.session))
+        try:
+            if self.cold.manifest(iteration, self.rank) is None:
+                return None
+            self.cold.fetch(iteration, self.rank, path)
+            return self._read_local_shard(iteration, self.rank)
+        except (CheckpointError, OSError) as e:
+            log.warning(
+                f"rank {self.rank}: cold-tier restore of iteration "
+                f"{iteration} failed: {e}"
+            )
+            if os.path.exists(path):
+                self._quarantine(
+                    path, stage="cold-fetch", iteration=iteration,
+                    owner=self.rank, error=e,
+                )
+            return None
 
     def _agree_fallback(self, failed_iteration: int) -> Optional[int]:
         """The fallback rung every rank agrees on: the newest covered iteration
@@ -1503,16 +1602,26 @@ class LocalCheckpointManager:
         t0 = time.perf_counter()
         held = sorted((i.iteration, i.owner) for i in self.local_ids())
         if self.comm is None:
-            gathered = [(self.rank, held)]
+            gathered = [(self.rank, held, self._cold_pairs())]
             world = [self.rank]
         else:
-            gathered = self.comm.all_gather((self.rank, held), tag="reshard-meta")
+            gathered = self.comm.all_gather(
+                (self.rank, held, self._cold_pairs()), tag="reshard-meta"
+            )
             world = list(self.comm.ranks)
         holders: dict[tuple[int, int], list[int]] = {}
-        for r, pairs in gathered:
+        # (iteration -> owners) archived in the cold tier, unioned across the
+        # gather so every rank reasons from the same third-rung inventory —
+        # this is what lets a FRESH world with empty workdirs bootstrap.
+        cold_owners: dict[int, set[int]] = {}
+        for r, pairs, cold_pairs in gathered:
             for it, owner in pairs:
                 holders.setdefault((int(it), int(owner)), []).append(int(r))
-        candidates = sorted({it for it, _ in holders}, reverse=True)
+            for it, owner in (tuple(p) for p in cold_pairs):
+                cold_owners.setdefault(int(it), set()).add(int(owner))
+        candidates = sorted(
+            {it for it, _ in holders} | set(cold_owners), reverse=True
+        )
         if iteration is not None:
             candidates = [it for it in candidates if it == iteration]
             if not candidates:
@@ -1523,7 +1632,7 @@ class LocalCheckpointManager:
         errors: list[str] = []
         for it in candidates:
             picked = self._reshard_candidate(
-                it, holders, world, target, axes, errors
+                it, holders, world, target, axes, errors, cold_owners
             )
             if picked is None:
                 if iteration is not None:
@@ -1554,11 +1663,41 @@ class LocalCheckpointManager:
                 peer_bytes=summary["peer_bytes"],
                 ranges=summary["ranges"],
             )
-            tensors = self._execute_reshard(plan, it, holders)
+            try:
+                tensors = self._execute_reshard(plan, it, holders, cold_owners)
+                exec_err: Optional[CheckpointError] = None
+            except CheckpointError as e:
+                tensors, exec_err = None, e
             if self.comm is not None:
                 # Exit barrier: a rank whose assembly was all-local must keep
                 # serving ranged reads until every peer has fetched its share.
                 self.comm.barrier(tag="reshard-done")
+                # Commit agreement: assembly is all-or-nothing across the
+                # group. A rank whose fetch failed fail-closed (a cold
+                # artifact flunking its manifest digest, every holder of a
+                # segment dead) votes no and EVERY rank discards and climbs
+                # to the next older candidate — corrupt bytes are never
+                # restored, and no rank diverges onto a different iteration.
+                oks = self.comm.all_gather(exec_err is None, tag="reshard-commit")
+                if not all(oks):
+                    errors.append(
+                        f"iter {it}: assembly failed on some rank"
+                        + (f" ({exec_err})" if exec_err is not None else "")
+                    )
+                    if iteration is not None:
+                        raise CheckpointError(
+                            f"reshard: iteration {iteration} not assemblable "
+                            f"on world {world}: {'; '.join(errors)}"
+                        )
+                    continue
+            elif exec_err is not None:
+                errors.append(f"iter {it}: {exec_err}")
+                if iteration is not None:
+                    raise CheckpointError(
+                        f"reshard: iteration {iteration} not assemblable: "
+                        f"{'; '.join(errors)}"
+                    )
+                continue
             meta = {
                 **meta,
                 "iteration": meta.get("iteration", it),
@@ -1588,15 +1727,22 @@ class LocalCheckpointManager:
                "containers on any rank — save with save(..., layout=...))")
         )
 
-    def _reshard_candidate(self, it, holders, world, target, axes, errors):
+    def _reshard_candidate(
+        self, it, holders, world, target, axes, errors, cold_owners=None
+    ):
         """One collective attempt at iteration ``it``: the lowest holder rank
-        reads+broadcasts a container's layout/hollow/meta; every rank builds
-        the same plan and the same coverage verdict. Returns ``(plan, target,
-        hollow, meta)`` or None (verdict recorded in ``errors``)."""
+        (or, when NO rank holds a container — the fresh-bootstrap case — the
+        lowest live rank) reads+broadcasts a container's layout/hollow/meta;
+        every rank builds the same plan and the same coverage verdict. The
+        designated rank sources the header from a held container first, then
+        from a cold-tier ranged header fetch (manifest-digest verified, paid
+        in O(header) bytes). Returns ``(plan, target, hollow, meta)`` or None
+        (verdict recorded in ``errors``)."""
+        cold = (cold_owners or {}).get(it, set())
         holder_ranks = sorted(
             {r for (i2, _), rs in holders.items() if i2 == it for r in rs}
         )
-        designated = holder_ranks[0]
+        designated = holder_ranks[0] if holder_ranks else min(world)
         payload: dict = {}
         if self.rank == designated:
             owned = sorted(
@@ -1628,7 +1774,7 @@ class LocalCheckpointManager:
                 }
                 break
             else:
-                payload = {"error": last_err}
+                payload = self._cold_header_payload(it, sorted(cold), last_err)
         if self.comm is not None:
             payload = self.comm.broadcast(
                 payload, src=designated, tag="reshard-hdr"
@@ -1644,12 +1790,45 @@ class LocalCheckpointManager:
                 else source.retarget(world, axes=axes)
             )
             plan = reshard_mod.build_plan(source, tgt)
-            available = {o for (i2, o) in holders if i2 == it}
+            available = {o for (i2, o) in holders if i2 == it} | cold
             plan.require_available(available)
         except CheckpointError as e:
             errors.append(f"iter {it}: {e}")
             return None
         return plan, tgt, payload["hollow"], dict(payload.get("meta") or {})
+
+    def _cold_header_payload(
+        self, it: int, cold_sorted: list, last_err: str
+    ) -> dict:
+        """The designated rank's cold-tier header source: ranged-fetch one
+        archived owner's container head, cross-check its layout meta against
+        the manifest's leaf sizes. Returns the broadcast payload (or an
+        ``{"error": ...}`` verdict)."""
+        if self.cold is None or not cold_sorted:
+            return {"error": last_err}
+        for owner in cold_sorted:
+            try:
+                doc, header = self.cold.fetch_header(it, owner)
+            except (CheckpointError, OSError) as e:
+                last_err = f"iteration {it}: cold header fetch failed ({e})"
+                continue
+            raw = (header.get("meta") or {}).get(reshard_mod.LAYOUT_META_KEY)
+            if raw is None:
+                last_err = (
+                    f"iteration {it}: cold containers carry no layout meta"
+                )
+                continue
+            mismatch = self._layout_header_mismatch(
+                raw, {"leaf_specs": header["leaves"]}, owner
+            )
+            if mismatch:
+                last_err = f"iteration {it}: {mismatch}"
+                continue
+            return {
+                "layout": raw, "hollow": header["hollow"],
+                "meta": dict(header.get("meta") or {}),
+            }
+        return {"error": last_err}
 
     @staticmethod
     def _layout_header_mismatch(raw_layout, geom: dict, owner: int):
@@ -1680,10 +1859,15 @@ class LocalCheckpointManager:
         return None
 
     def _execute_reshard(
-        self, plan: "reshard_mod.ReshardPlan", it: int, holders: dict
+        self, plan: "reshard_mod.ReshardPlan", it: int, holders: dict,
+        cold_owners: Optional[dict] = None,
     ) -> list:
         """Assemble this rank's target-local leaves: local pread for ranges a
-        held container covers, ranged peer fetch for the rest.
+        held container covers, ranged peer fetch for the rest, ranged
+        cold-tier fetch (manifest chunk CRCs verified per covering chunk —
+        O(needed bytes)) when no live peer holds a source. The cold rung is
+        how a fresh world with empty workdirs assembles at all: every
+        segment routes to the archive.
 
         Peer fetches run over a bounded worker pool and OVERLAP the local
         pread/assembly pass — the wire drains while this thread slices its
@@ -1692,7 +1876,8 @@ class LocalCheckpointManager:
         load-balanced ``min(pairs, ...)`` choice as the serial path, byte
         for byte), workers only move bytes into disjoint buffer slices, and
         failed holders are re-placed round-by-round in sorted batch order —
-        never in wall-clock completion order."""
+        never in wall-clock completion order. Cold batches ride the same
+        pool under the sentinel holder ``-1``."""
         import numpy as np
 
         rp = plan.for_rank(self.rank)
@@ -1704,19 +1889,22 @@ class LocalCheckpointManager:
         my_owners = {
             o for (i2, o), rs in holders.items() if i2 == it and self.rank in rs
         }
+        cold = set((cold_owners or {}).get(it, set())) if self.cold is not None else set()
         local_bytes = 0
-        # (holder, owner) -> [segments]
+        # (holder, owner) -> [segments]; holder -1 = the cold tier
         remote: dict[tuple[int, int], list] = {}
         load: dict[int, int] = {}
         dead: set[int] = set()
+        dead_cold: set[int] = set()
         avoid = set(
             self.replication.last_degraded if self.replication is not None else ()
         )
 
         def assign(seg) -> bool:
             """Route one segment: local queue when a held container covers it,
-            else the deterministic load-balanced holder choice. No I/O —
-            returns True for local, False for remote."""
+            the deterministic load-balanced holder choice when a live peer
+            has one, else the cold tier. No I/O — returns True for local,
+            False for remote/cold."""
             if set(seg.owners) & my_owners:
                 return True
             pairs = sorted(
@@ -1724,17 +1912,26 @@ class LocalCheckpointManager:
                 for o in seg.owners
                 for h in holders.get((it, o), [])
                 if h != self.rank and h not in dead
-            )
+            ) if self.replication is not None else []
             if not pairs:
+                cold_avail = sorted((set(seg.owners) & cold) - dead_cold)
+                if cold_avail:
+                    o = cold_avail[0]
+                    load[-1] = load.get(-1, 0) + len(seg.ranges)
+                    remote.setdefault((-1, o), []).append(seg)
+                    return False
+                if self.replication is None and any(
+                    holders.get((it, o)) for o in seg.owners
+                ):
+                    raise CheckpointError(
+                        f"reshard: leaf {seg.leaf} cell owned by "
+                        f"{list(seg.owners)} is only on peer ranks and this "
+                        f"manager has no replication exchange to fetch over"
+                    )
                 raise CheckpointError(
                     f"reshard: no live holder left for leaf {seg.leaf} cell "
-                    f"owned by {list(seg.owners)} @ iteration {it}"
-                )
-            if self.replication is None:
-                raise CheckpointError(
-                    f"reshard: leaf {seg.leaf} cell owned by "
-                    f"{list(seg.owners)} is only on peer ranks and this "
-                    f"manager has no replication exchange to fetch over"
+                    f"owned by {list(seg.owners)} @ iteration {it} (cold "
+                    f"tier: {'exhausted' if dead_cold else 'no copy'})"
                 )
             h, o = min(
                 pairs, key=lambda p: (p[0] in avoid, load.get(p[0], 0), p)
@@ -1776,6 +1973,10 @@ class LocalCheckpointManager:
                 (seg.leaf, r.src_off, r.nbytes)
                 for seg in segs for r in seg.ranges
             ]
+            if holder < 0:
+                # Cold rung: every covering chunk verified against the
+                # manifest before its slice comes back — fail-closed.
+                return self.cold.fetch_ranges(it, owner, ranges)
             _, parts = self.replication.fetch_ranges(
                 holder,
                 {"session": self.session, "iteration": it, "owner": owner,
@@ -1818,16 +2019,22 @@ class LocalCheckpointManager:
                         parts = fut.result()
                     except CheckpointError as e:
                         log.warning(
-                            f"rank {self.rank}: reshard fetch from holder "
-                            f"{holder} (owner {owner}) failed: {e}; trying "
-                            f"another holder"
+                            f"rank {self.rank}: reshard fetch from "
+                            f"{'cold tier' if holder < 0 else f'holder {holder}'}"
+                            f" (owner {owner}) failed: {e}; trying "
+                            f"another source"
                         )
                         record_event(
                             "checkpoint", "ckpt_integrity_failure",
-                            stage="reshard-fetch", iteration=it, owner=owner,
+                            stage="cold-reshard-fetch" if holder < 0
+                            else "reshard-fetch",
+                            iteration=it, owner=owner,
                             rank=self.rank, error=repr(e),
                         )
-                        dead.add(holder)
+                        if holder < 0:
+                            dead_cold.add(owner)
+                        else:
+                            dead.add(holder)
                         for seg in segs:
                             if assign(seg):
                                 local_q.append(seg)
@@ -1849,7 +2056,8 @@ class LocalCheckpointManager:
                             )
                             nbytes += r.nbytes
                     record_event(
-                        "checkpoint", "reshard_fetch", via="peer",
+                        "checkpoint", "reshard_fetch",
+                        via="cold" if holder < 0 else "peer",
                         rank=self.rank, iteration=it, holder=holder,
                         owner=owner, bytes=nbytes,
                     )

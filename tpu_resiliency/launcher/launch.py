@@ -96,6 +96,16 @@ def build_parser() -> argparse.ArgumentParser:
         "(successor == primary: the degenerate clique replicates nothing)",
     )
     p.add_argument(
+        "--store-auto-reshard", action="store_true",
+        help="automatic shard respawn for a job-hosted store clique: the "
+        "launcher watches each shard's process + circuit-breaker telemetry "
+        "and, when one stays dead past a grace window, spawns a replacement "
+        "KVServer and drives reshard_clique onto the healed map (audited as "
+        "store_auto_reshard events); operator-initiated resharding is "
+        "unchanged. No effect unless this launcher hosts the clique "
+        "(--store-shards > 1)",
+    )
+    p.add_argument(
         "--standalone",
         action="store_true",
         help="single-node convenience: host the store on an ephemeral local port "
@@ -180,6 +190,28 @@ def build_parser() -> argparse.ArgumentParser:
         "between full keyframes, up to N-1 replication rounds ship only the "
         "chunks whose manifest CRCs changed since the previous save; 0/1 "
         "disables (mirror strategy only)",
+    )
+    p.add_argument(
+        "--cold-dir",
+        default=None,
+        metavar="DIR",
+        help="durable cold tier root (exports $TPU_RESILIENCY_COLD_DIR; "
+        "workers' LocalCheckpointManager picks it up via "
+        "checkpoint.coldtier.cold_from_env): finalized keyframe containers "
+        "are spilled there asynchronously — off the save critical path — "
+        "and a FRESH job with an empty workdir can bootstrap from it on any "
+        "world size. A dead/full backend degrades to local-only "
+        "(coldtier_degraded events), never a failed save",
+    )
+    p.add_argument(
+        "--cold-keep",
+        type=int,
+        default=None,
+        metavar="N",
+        help="cold-tier retention: keep the newest N archived iterations "
+        "(exports $TPU_RESILIENCY_COLD_KEEP); pruning is keyframe-aware — "
+        "an iteration a retained delta chain names as its base is never "
+        "orphaned. Default: keep everything",
     )
     p.add_argument("--term-grace", type=float, default=15.0)
     p.add_argument("--log-dir", default=None, help="capture per-round/per-rank worker logs")
@@ -272,6 +304,8 @@ def build_parser() -> argparse.ArgumentParser:
 #: launcher flags that take no value — keep in sync with build_parser(); needed to
 #: find where the user's script starts without invoking argparse
 _STORE_TRUE_FLAGS = {
+    "--store-auto-reshard",
+    "--store-replicate",
     "--upscaling-enabled",
     "--no-ft-monitors",
     "--no-python",
@@ -529,6 +563,19 @@ def main(argv: Optional[list[str]] = None) -> int:
         from tpu_resiliency.checkpoint.coding.delta import DELTA_ENV
 
         os.environ[DELTA_ENV] = str(args.ckpt_delta_interval)
+    if args.cold_dir:
+        from tpu_resiliency.checkpoint.coldtier import COLD_DIR_ENV, COLD_KEEP_ENV
+
+        # One exported variable wires the whole tree, like the coding knobs:
+        # every worker's LocalCheckpointManager builds its ColdTier from it
+        # (checkpoint.coldtier.cold_from_env) — spills ride save-finalize,
+        # restores grow the coverage ladder's cold rung.
+        os.environ[COLD_DIR_ENV] = os.path.abspath(args.cold_dir)
+        os.makedirs(os.path.abspath(args.cold_dir), exist_ok=True)
+        if args.cold_keep is not None:
+            os.environ[COLD_KEEP_ENV] = str(args.cold_keep)
+    elif args.cold_keep is not None:
+        log.warning("--cold-keep has no effect without --cold-dir")
     if args.compile_cache_dir:
         from tpu_resiliency.platform import compile_cache
 
@@ -625,6 +672,26 @@ def main(argv: Optional[list[str]] = None) -> int:
         metrics_push_prefix=f"jobmetrics/{args.rdzv_id}/",
     )
     agent = ElasticAgent(cfg, ft_cfg, store)
+    auto_reshard = None
+    if args.store_auto_reshard:
+        from tpu_resiliency.platform.shardstore import (
+            AutoReshardSupervisor,
+            CliqueStore,
+            SpawnedClique,
+        )
+
+        if isinstance(server, SpawnedClique) and isinstance(store, CliqueStore):
+            auto_reshard = AutoReshardSupervisor(server, store.client)
+            auto_reshard.start()
+            log.info(
+                f"store auto-reshard supervisor watching "
+                f"{len(server.endpoints)} shards"
+            )
+        else:
+            log.warning(
+                "--store-auto-reshard needs a job-hosted clique "
+                "(--store-shards > 1); ignoring"
+            )
     try:
         # The root span of the whole run: every round/rendezvous/worker span
         # parents (transitively) under it.
@@ -636,6 +703,8 @@ def main(argv: Optional[list[str]] = None) -> int:
         log.error(f"workload failed: {e}")
         return 1
     finally:
+        if auto_reshard is not None:
+            auto_reshard.stop()
         if server is not None:
             # We host the control plane: closing it while peers still coordinate
             # would rip the store out from under them — wait for their exit marks.

@@ -27,6 +27,14 @@ corrupted while its clique mirrors stay intact. Disk call indices (``at=``)
 count per *file*, not per process — each container is written sequentially by
 one thread, so disk schedules reproduce even under racy cross-rank timing.
 
+A fifth channel, **cold**, mirrors the disk channel for the durable cold tier
+(``checkpoint/coldtier.py``'s :class:`ObjectStore` backends): same ``write``/
+``commit`` ops and fault kinds, but ``peer=`` names the *object key* (e.g.
+``peer=s0/iter_0000002/owner_0.ckpt``) and — like disk — call indices count
+per key, so one artifact upload can be corrupted while the manifest beside it
+lands intact. Uploads stream in fixed-size slices, so ``at=N`` picks the
+N-th slice of one object deterministically.
+
 Faults are *planned*, not sprayed: a :class:`ChaosPlan` is parsed from
 ``$TPU_RESILIENCY_CHAOS`` (``"<seed>:<rule>[;<rule>...]"``) or installed
 programmatically, holds a seeded RNG, and decides per channel, per op, by
@@ -41,7 +49,7 @@ per operation regardless of thread interleaving.
 Rule grammar (see ``docs/chaos.md`` for the channel × fault coverage matrix)::
 
     rule    := <channel>.<op>.<kind>[@param[,param...]]
-    channel := store | p2p | ipc | disk | *
+    channel := store | p2p | ipc | disk | cold | *
     op      := connect | accept | send | recv | write | commit | *
     kind    := reset | truncate | eof | delay | stall | partition
              | bitflip | torn-rename | enospc | slow-io
@@ -79,7 +87,7 @@ log = get_logger(__name__)
 
 CHAOS_ENV = "TPU_RESILIENCY_CHAOS"
 
-CHANNELS = ("store", "p2p", "ipc", "disk")
+CHANNELS = ("store", "p2p", "ipc", "disk", "cold")
 OPS = ("connect", "accept", "send", "recv", "write", "commit")
 KINDS = (
     "reset", "truncate", "eof", "delay", "stall", "partition",
@@ -214,12 +222,13 @@ class ChaosPlan:
         key them off the injection's ``(peer, index)`` identity.
 
         Counter scope: network channels count per ``(channel, op)`` process-
-        wide; the ``disk`` channel counts per ``(channel, op, peer)`` — i.e.
-        per target file — because each container is written sequentially by
-        one thread, which makes per-file ``at=`` schedules deterministic where
-        a process-global write counter would race across ranks."""
+        wide; the ``disk`` and ``cold`` channels count per ``(channel, op,
+        peer)`` — i.e. per target file / object key — because each container
+        (or upload) is written sequentially by one thread, which makes
+        per-file ``at=`` schedules deterministic where a process-global write
+        counter would race across ranks."""
         with self._lock:
-            key = (channel, op, peer) if channel == "disk" else (channel, op)
+            key = (channel, op, peer) if channel in ("disk", "cold") else (channel, op)
             idx = self._counters.get(key, 0)
             self._counters[key] = idx + 1
             for rule in self.rules:
@@ -374,17 +383,14 @@ def _deterministic_rng(plan: ChaosPlan, inj: Injection) -> random.Random:
     return random.Random((plan.seed, inj.peer, inj.index))
 
 
-def on_disk_write(path: str, data):
-    """Chaos hook for one container write call (header prefix, a leaf, the
-    trailer, or one striped pwrite range). Returns the buffer to actually put
-    on disk — a copy with one deterministically chosen bit flipped under
-    ``bitflip`` — sleeps under ``slow-io``/``delay``, raises
-    ``OSError(ENOSPC)`` under ``enospc``. Identity when no plan is active."""
+def _on_storage_write(channel: str, peer: str, path: str, data):
+    """Shared body of :func:`on_disk_write` / :func:`on_cold_write` — the two
+    channels differ only in how the rule-targetable peer name is derived."""
     plan = active_plan()
     if plan is None:
         return data
     rule, inj = plan.check_injection(
-        "disk", "write", peer=disk_peer(path), kinds=DISK_WRITE_KINDS
+        channel, "write", peer=peer, kinds=DISK_WRITE_KINDS
     )
     if rule is None:
         return data
@@ -406,6 +412,18 @@ def on_disk_write(path: str, data):
     return out
 
 
+def on_disk_write(path: str, data):
+    """Chaos hook for one container write call (header prefix, a leaf, the
+    trailer, or one striped pwrite range). Returns the buffer to actually put
+    on disk — a copy with one deterministically chosen bit flipped under
+    ``bitflip`` — sleeps under ``slow-io``/``delay``, raises
+    ``OSError(ENOSPC)`` under ``enospc``. Identity when no plan is active."""
+    plan = active_plan()
+    if plan is None:
+        return data
+    return _on_storage_write("disk", disk_peer(path), path, data)
+
+
 def on_disk_commit(tmp: str, path: str):
     """Chaos hook before the ``.dirty``→visible rename. ``torn-rename``
     truncates the temp file before the rename lands (the rename was journaled
@@ -418,6 +436,37 @@ def on_disk_commit(tmp: str, path: str):
         return None
     rule, inj = plan.check_injection(
         "disk", "commit", peer=disk_peer(path), kinds=DISK_COMMIT_KINDS
+    )
+    if rule is None:
+        return None
+    if rule.kind in ("slow-io", "delay"):
+        time.sleep(rule.delay + rule.jitter * random.random())
+        return None
+    rng = _deterministic_rng(plan, inj)
+    if rule.kind == "torn-rename":
+        _truncate_tail(tmp, rng)
+        return None
+    return lambda: _truncate_tail(path, rng)  # post-commit truncate
+
+
+def on_cold_write(key: str, path: str, data):
+    """Chaos hook for one cold-tier upload slice. ``key`` is the object key
+    (the rule's ``peer=`` target); ``path`` is the backend's physical temp
+    path, only used for error text. Same fault kinds and semantics as
+    :func:`on_disk_write`."""
+    return _on_storage_write("cold", key, path, data)
+
+
+def on_cold_commit(tmp: str, key: str, path: str):
+    """Chaos hook before a cold-tier upload's tmp→visible rename. Mirrors
+    :func:`on_disk_commit`, with rules targeting the object ``key``; the
+    returned post-commit action (under ``truncate``) cuts the tail of the
+    visible ``path``."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    rule, inj = plan.check_injection(
+        "cold", "commit", peer=key, kinds=DISK_COMMIT_KINDS
     )
     if rule is None:
         return None

@@ -1323,3 +1323,146 @@ class SpawnedClique:
                 except subprocess.TimeoutExpired:
                     p.kill()
                     p.wait(timeout)
+
+    def respawn_shard(self, shard: int, spawn_timeout: float = 20.0) -> tuple[str, int]:
+        """Replace one (dead) shard process with a fresh ``KVServer`` on an
+        ephemeral port; returns the new endpoint. The caller still owns the
+        epoch transition — pair with :func:`reshard_clique` to route the
+        keyspace onto the replacement."""
+        old = self.procs[shard]
+        try:
+            if old.poll() is None:
+                old.terminate()
+            old.wait(spawn_timeout)
+        except (OSError, subprocess.TimeoutExpired):
+            try:
+                old.kill()
+            except OSError:
+                pass
+        p = subprocess.Popen(
+            [sys.executable, "-m", "tpu_resiliency.platform.store",
+             "127.0.0.1:0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=dict(os.environ),
+        )
+        banner = p.stdout.readline().strip()
+        try:
+            bound = int(banner.rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            p.kill()
+            raise StoreError(
+                f"replacement for shard {shard} failed to start "
+                f"(banner {banner!r})"
+            )
+        deadline = time.monotonic() + spawn_timeout
+        while not store_answers("127.0.0.1", bound, timeout=1.0):
+            if time.monotonic() >= deadline:
+                p.kill()
+                raise StoreError(
+                    f"replacement shard 127.0.0.1:{bound} never answered ping"
+                )
+            time.sleep(0.05)
+        adv = self.endpoints[shard][0]
+        self.procs[shard] = p
+        self.endpoints[shard] = (adv, bound)
+        return (adv, bound)
+
+
+class AutoReshardSupervisor:
+    """Automatic shard respawn: the launcher-side watcher that turns the
+    operator runbook (notice a dead shard, spawn a replacement, run
+    ``reshard_clique``) into a closed loop.
+
+    Polls each shard of a job-hosted :class:`SpawnedClique` — a shard is a
+    respawn candidate when its *process* has exited or its client-side
+    circuit breaker is open AND a direct liveness probe fails (the breaker
+    alone can reflect a transient blip; the probe confirms the shard is
+    really gone). A candidate that stays dead past ``grace`` seconds is
+    replaced: :meth:`SpawnedClique.respawn_shard` spawns the new server and
+    :func:`reshard_clique` migrates the keyspace onto the healed map. Every
+    attempt is audited as a ``store_auto_reshard`` event
+    (``outcome=ok|failed``); the operator-initiated path is untouched."""
+
+    def __init__(
+        self,
+        clique: SpawnedClique,
+        client: ShardedKVClient,
+        *,
+        interval: float = 1.0,
+        grace: float = 3.0,
+    ):
+        self.clique = clique
+        self.client = client
+        self.interval = interval
+        self.grace = grace
+        self._dead_since: dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: successful automatic reshards (observable for tests/telemetry)
+        self.reshards = 0
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="store-auto-reshard"
+            )
+            self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception as e:  # supervision must outlive any one probe
+                log.warning(f"store auto-reshard tick failed: {e!r}")
+
+    def _shard_dead(self, shard: int) -> bool:
+        if self.clique.procs[shard].poll() is not None:
+            return True
+        host, port = self.clique.endpoints[shard]
+        if not breaker_open(host, port):
+            return False
+        return not store_answers("127.0.0.1", port, timeout=1.0)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        for shard in range(len(self.clique.endpoints)):
+            if not self._shard_dead(shard):
+                self._dead_since.pop(shard, None)
+                continue
+            since = self._dead_since.setdefault(shard, now)
+            if now - since < self.grace:
+                continue
+            self._respawn(shard)
+            self._dead_since.pop(shard, None)
+
+    def _respawn(self, shard: int) -> None:
+        old = self.clique.endpoints[shard]
+        try:
+            new_ep = self.clique.respawn_shard(shard)
+            doc = reshard_clique(self.client, list(self.clique.endpoints))
+        except (StoreError, OSError) as e:
+            log.warning(
+                f"store auto-reshard of shard {shard} "
+                f"({old[0]}:{old[1]}) failed: {e!r}"
+            )
+            record_event(
+                "store", "store_auto_reshard", shard=shard,
+                old=f"{old[0]}:{old[1]}", outcome="failed", error=repr(e),
+            )
+            return
+        self.reshards += 1
+        log.info(
+            f"store auto-reshard: shard {shard} {old[0]}:{old[1]} -> "
+            f"{new_ep[0]}:{new_ep[1]} (epoch {doc.get('epoch')})"
+        )
+        record_event(
+            "store", "store_auto_reshard", shard=shard,
+            old=f"{old[0]}:{old[1]}", new=f"{new_ep[0]}:{new_ep[1]}",
+            epoch=doc.get("epoch"), outcome="ok",
+        )

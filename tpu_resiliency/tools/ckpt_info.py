@@ -8,6 +8,13 @@ audited offline from the filesystem alone, without the job's comm group. This
 is the post-mortem twin of the in-job coverage check: "which iteration could a
 restarted world actually resume from, and what is replication costing me?"
 
+``--cold <dir>`` joins the durable cold tier (``checkpoint/coldtier.py``) to
+the audit: archived owners count toward per-iteration coverage (the in-job
+ladder's third rung, rendered per iteration as local / erasure-reconstructible
+/ cold), sessions that exist only in the object store are auditable from an
+empty workdir, and ``--verify`` re-checks every archived artifact against its
+cold manifest's whole-file digest.
+
 ``--verify`` additionally stream-verifies every container's checksums
 (format v2 per-leaf CRCs + trailer digest, ``checkpoint/format.py``), prints a
 per-file verdict, and exits 1 on any mismatch — an operator preflight before
@@ -25,6 +32,8 @@ Usage::
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --session 1
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --verify
+    python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root \
+        --cold /backup/cold --verify
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --world 0,1,2 --plan
     python -m tpu_resiliency.tools.ckpt_info /ssd/ckpt-root --world 0,1,2,3 \
         --plan --axes dp=2,tp=2
@@ -72,6 +81,9 @@ class SessionInfo:
     block_k: dict = dataclasses.field(default_factory=dict)
     #: block artifact files: [(path, holder, iter, owner, index)]
     block_files: list = dataclasses.field(default_factory=list)
+    #: cold-tier coverage (``--cold``): iteration -> set of owners whose
+    #: containers the object store archives with a valid manifest
+    cold: dict = dataclasses.field(default_factory=dict)
 
     @property
     def owners(self) -> set:
@@ -80,6 +92,8 @@ class SessionInfo:
             out |= set(by_owner)
         for by_owner in self.blocks.values():
             out |= set(by_owner)
+        for owners in self.cold.values():
+            out |= set(owners)
         return out
 
     def reconstructible(self, it: int) -> set:
@@ -93,9 +107,10 @@ class SessionInfo:
 
     def covered_iterations(self, world: Optional[set] = None) -> list:
         """Iterations where every rank of ``world`` finds its shard held
-        somewhere — a full container on some holder, or enough erasure
-        blocks to reconstruct one (the offline analogue of
-        ``_covered_iterations``).
+        somewhere — a full container on some holder, enough erasure blocks
+        to reconstruct one, or (with ``--cold``) an archived copy in the
+        cold tier (the offline analogue of ``_covered_iterations`` with its
+        third rung).
 
         Coverage is **group-relative**: a restarted group resumes from the
         newest iteration whose owner set covers *that group* — after an
@@ -104,11 +119,16 @@ class SessionInfo:
         filesystem shows (rank dirs plus every owner ever named), i.e. the
         original full world."""
         world = (self.ranks | self.owners) if world is None else set(world)
-        its = set(self.holdings) | set(self.blocks)
+        its = set(self.holdings) | set(self.blocks) | set(self.cold)
         return sorted(
             it
             for it in its
-            if world <= (set(self.holdings.get(it, ())) | self.reconstructible(it))
+            if world
+            <= (
+                set(self.holdings.get(it, ()))
+                | self.reconstructible(it)
+                | set(self.cold.get(it, ()))
+            )
         )
 
 
@@ -184,15 +204,19 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
     out = sys.stdout if out is None else out
     audit_world = sorted((info.ranks | info.owners) if world is None else world)
     covered = info.covered_iterations(set(audit_world))
+    cold_note = (
+        f", {len(info.cold)} in cold tier" if info.cold else ""
+    )
     print(
         f"session {info.session}: auditing world={audit_world} "
-        f"({len(info.holdings)} iterations on disk)",
+        f"({len(info.holdings)} iterations on disk{cold_note})",
         file=out,
     )
-    for it in sorted(set(info.holdings) | set(info.blocks)):
+    for it in sorted(set(info.holdings) | set(info.blocks) | set(info.cold)):
         by_owner = info.holdings.get(it, {})
         recon = info.reconstructible(it)
-        missing = sorted(set(audit_world) - set(by_owner) - recon)
+        cold_owners = set(info.cold.get(it, ()))
+        missing = sorted(set(audit_world) - set(by_owner) - recon - cold_owners)
         copies = sum(len(h) for h in by_owner.values())
         mb = info.bytes_by_iter.get(it, 0) / 1e6
         status = "COVERED" if it in covered else f"missing owners {missing}"
@@ -206,9 +230,10 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
             f", {nblocks} erasure blocks"
             f" (reconstructible: {sorted(recon)})" if nblocks else ""
         )
+        cd = f", cold: {sorted(cold_owners)}" if cold_owners else ""
         print(
             f"  iter {it:7d}: owners {sorted(by_owner)}, "
-            f"{mirrors} mirror copies{ec}, {mb:.1f} MB  [{status}]",
+            f"{mirrors} mirror copies{ec}{cd}, {mb:.1f} MB  [{status}]",
             file=out,
         )
     if covered:
@@ -239,12 +264,14 @@ def render(info: SessionInfo, out=None, world: Optional[set] = None) -> None:
         print(f"  WARNING quarantined corrupt container: {path}", file=out)
 
 
-def verify(sessions: list[SessionInfo], out=None) -> int:
+def verify(sessions: list[SessionInfo], out=None, cold=None) -> int:
     """Stream-verify every container (and erasure block artifact) in
     ``sessions`` (bounded memory, one line per file); returns the number of
     corrupt files. v3 container verdicts are chunk-granular: a corrupt file
     names the exact ``leaf/chunk`` that failed, an intact one reports its
-    manifest geometry."""
+    manifest geometry. With ``cold`` (``{session: ColdTier}``, the ``--cold``
+    wiring) every archived artifact is additionally checked against its cold
+    manifest's whole-file digest."""
     from tpu_resiliency.checkpoint import format as ckpt_format
     from tpu_resiliency.checkpoint.coding import strategy as ckpt_coding
     from tpu_resiliency.exceptions import CheckpointError
@@ -273,6 +300,24 @@ def verify(sessions: list[SessionInfo], out=None) -> int:
                 status, detail = "corrupt", str(e)
             counts[status] += 1
             print(f"  [{status.upper():10s}] {path}: {detail}", file=out)
+        tier = (cold or {}).get(info.session)
+        if tier is not None:
+            mans = tier.manifests()
+            narts = sum(len(per) for per in mans.values())
+            print(
+                f"session {info.session}: verifying {narts} cold "
+                f"artifact(s)",
+                file=out,
+            )
+            for it in sorted(mans):
+                for owner in sorted(mans[it]):
+                    status, detail = tier.verify(it, owner)
+                    counts[status] += 1
+                    print(
+                        f"  [{status.upper():10s}] cold "
+                        f"s{info.session}/iter {it} owner {owner}: {detail}",
+                        file=out,
+                    )
     print(
         f"verified: {counts['ok']} ok, {counts['unverified']} unverified, "
         f"{counts['corrupt']} corrupt",
@@ -475,6 +520,15 @@ def main(argv: Optional[list[str]] = None) -> int:
         "print per-file verdicts; exit 1 on any mismatch",
     )
     ap.add_argument(
+        "--cold",
+        metavar="DIR",
+        help="also scan this cold-tier object-store root (the launcher's "
+        "--cold-dir): archived owners join the per-iteration coverage "
+        "ledger as a third rung, cold-only sessions become auditable from "
+        "an empty workdir, and --verify re-checks every archived artifact "
+        "against its cold manifest digest",
+    )
+    ap.add_argument(
         "--chunks",
         action="store_true",
         help="render per-container chunk-manifest verdicts (chunk size, "
@@ -525,6 +579,42 @@ def main(argv: Optional[list[str]] = None) -> int:
         print(f"not a checkpoint root: {args.root}", file=sys.stderr)
         return 1
     sessions = scan(args.root, session=args.session)
+    cold_tiers = {}
+    if args.cold:
+        if not os.path.isdir(args.cold):
+            print(f"not a cold-tier root: {args.cold}", file=sys.stderr)
+            return 1
+        from tpu_resiliency.checkpoint.coldtier import (
+            ColdTier,
+            FilesystemStore,
+        )
+
+        store = FilesystemStore(args.cold)
+        cold_ids = set()
+        for key in store.list():
+            km = re.match(r"^s(\d+)/", key)
+            if km:
+                cold_ids.add(int(km.group(1)))
+        for sid in sorted(cold_ids):
+            if args.session is not None and sid != args.session:
+                continue
+            tier = ColdTier(store, session=sid)
+            coverage = tier.coverage()
+            if not coverage:
+                continue  # keys but no valid manifest: nothing trustworthy
+            cold_tiers[sid] = tier
+            for info in sessions:
+                if info.session == sid:
+                    info.cold = coverage
+                    break
+            else:
+                # Cold-only session — the restore-anywhere case: an empty
+                # (or freshly provisioned) workdir still audits what a new
+                # job could bootstrap from the object store.
+                stub = SessionInfo(sid, set(), {}, {}, [])
+                stub.cold = coverage
+                sessions.append(stub)
+        sessions.sort(key=lambda s: s.session)
     if not sessions:
         print("no sessions found", file=sys.stderr)
         return 1
@@ -547,7 +637,7 @@ def main(argv: Optional[list[str]] = None) -> int:
         corrupt = [0]
 
         def emit_verify():
-            corrupt[0] = verify(sessions)
+            corrupt[0] = verify(sessions, cold=cold_tiers)
 
         if pipe_safe(emit_verify):
             return SIGPIPE_EXIT

@@ -983,6 +983,47 @@ def observe_record(rec: dict, reg: MetricsRegistry) -> None:
                 "current clique shard-map epoch (0 = launch map, never "
                 "resharded)",
             ).set(rec["epoch"])
+    elif kind == "store_auto_reshard":
+        reg.counter(
+            "tpu_store_auto_reshards_total",
+            "automatic shard respawns driven by the launcher supervisor "
+            "(--store-auto-reshard), by outcome (ok | failed)",
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
+    elif kind == "coldtier_spilled":
+        reg.counter(
+            "tpu_coldtier_spills_total",
+            "keyframe containers archived to the cold tier by the async "
+            "spiller (one per finalized owner shard)",
+        ).inc()
+        if isinstance(rec.get("bytes"), (int, float)):
+            reg.counter(
+                "tpu_coldtier_bytes_total",
+                "bytes shipped to the cold tier by the async spiller",
+            ).inc(rec["bytes"])
+    elif kind == "coldtier_degraded":
+        reg.counter(
+            "tpu_coldtier_degraded_total",
+            "cold-tier spills dropped to local-only, by reason "
+            "(upload-failed after retry exhaustion | breaker-open while the "
+            "backend circuit breaker cools down); the save itself succeeded",
+            reason=str(rec.get("reason", "?")),
+        ).inc()
+    elif kind == "coldtier_pruned":
+        reg.counter(
+            "tpu_coldtier_pruned_total",
+            "cold-tier artifacts removed by keyframe-aware retention "
+            "(--cold-keep), one per (iteration, owner)",
+        ).inc()
+    elif kind == "coldtier_fetch":
+        reg.counter(
+            "tpu_coldtier_fetch_total",
+            "cold-tier restore fetches by mode (full | header | ranged) and "
+            "outcome (ok | corrupt: manifest digest mismatch, restore "
+            "refused fail-closed)",
+            mode=str(rec.get("mode", "?")),
+            outcome=str(rec.get("outcome", "?")),
+        ).inc()
     elif kind == "peer_degraded":
         reg.counter(
             "tpu_replication_peer_degraded_total",
